@@ -1,0 +1,47 @@
+"""mlsl_tpu — a TPU-native ML scaling framework with the capabilities of Intel MLSL.
+
+A brand-new design, idiomatic to JAX/XLA/Pallas, providing the semantic model of the
+reference (``/root/reference``, intel/MLSL): ``Environment`` / ``Session`` + ``Operation``
+graph / ``Distribution`` (data x model process grid) / ``Activation`` + ``ParameterSet``
+handles with asynchronous Start/Wait/Test collectives, distributed-update gradient sync,
+activation redistribution, int8 gradient-quantized allreduce, priority scheduling and
+built-in statistics — implemented over a ``jax.sharding.Mesh`` with XLA collectives over
+ICI/DCN instead of MPI communicators (reference API surface: include/mlsl.hpp:85-915).
+"""
+
+from mlsl_tpu.types import (
+    DataType,
+    PhaseType,
+    GroupType,
+    ReductionType,
+    OpType,
+    CompressionType,
+    QuantParams,
+)
+from mlsl_tpu.core.environment import Environment
+from mlsl_tpu.core.distribution import Distribution
+from mlsl_tpu.core.session import Session, Operation, OperationRegInfo
+from mlsl_tpu.core.activation import Activation, CommBlockInfo
+from mlsl_tpu.core.parameter_set import ParameterSet
+from mlsl_tpu.core.stats import Statistics
+
+__version__ = "0.1.0"
+
+__all__ = [
+    "DataType",
+    "PhaseType",
+    "GroupType",
+    "ReductionType",
+    "OpType",
+    "CompressionType",
+    "QuantParams",
+    "Environment",
+    "Distribution",
+    "Session",
+    "Operation",
+    "OperationRegInfo",
+    "Activation",
+    "CommBlockInfo",
+    "ParameterSet",
+    "Statistics",
+]

@@ -1,0 +1,13 @@
+"""Communication core: device mesh, process groups, collectives, async requests.
+
+This is the TPU-native replacement for the reference's comm stack (src/comm.hpp +
+src/comm_ep.cpp / src/comm_handoff.cpp + eplib/*): a ``jax.sharding.Mesh`` replaces MPI
+communicators, cached jit-compiled ``shard_map`` collectives replace endpoint servers,
+and async XLA dispatch with host-side request handles replaces the shared-memory command
+queue.
+"""
+
+from mlsl_tpu.comm.mesh import Topology, ProcessGroup
+from mlsl_tpu.comm.request import CommRequest, RequestStorage
+
+__all__ = ["Topology", "ProcessGroup", "CommRequest", "RequestStorage"]

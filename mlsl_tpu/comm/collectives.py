@@ -1,0 +1,348 @@
+"""The collective library: cached jit-compiled shard_map programs over the mesh.
+
+This replaces the reference's per-backend collective dispatch (src/comm_ep.cpp:768-1378,
+src/comm_handoff.cpp:491-564). Design:
+
+- A "distributed buffer" is one global jax.Array of shape (R, D, M, n): the (r, d, m)
+  slice is rank (r,d,m)'s local buffer (what each MPI rank would hold). Collectives are
+  pure functions global-buffer -> global-buffer, built with ``shard_map`` so XLA sees
+  the per-device program and lowers group operations onto ICI collectives.
+
+- Axis-aligned groups use native XLA collective ops (psum / psum_scatter / all_gather /
+  all_to_all) — the fast path, equivalent to how the reference leans on MPI's optimized
+  collectives rather than hand-rolling (eplib routes to PMPI_I* in cqueue.c:1906-2026).
+
+- Color groups (arbitrary MPI_Comm_split-style subgroups, reference
+  src/mlsl.cpp:620-647) and exotic shapes (AlltoAllv) fall back to a gather+mask
+  emulation: correct everywhere, efficient enough for cold paths.
+
+- Every built program is cached per (kind, group, count(s), dtype, op, root) — the
+  analog of the reference caching CommRequests per graph edge, and the key to the perf
+  target: the hot loop re-dispatches an already-compiled XLA executable with zero
+  retracing.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Callable, Optional, Sequence, Tuple
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+try:  # JAX >= 0.4.35 exposes shard_map at top level
+    from jax import shard_map as _shard_map
+except ImportError:  # pragma: no cover
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+from mlsl_tpu.comm.mesh import ProcessGroup, REPLICA_AXIS, DATA_AXIS, MODEL_AXIS
+from mlsl_tpu.log import mlsl_assert
+from mlsl_tpu.types import ReductionType
+
+ALL_AXES = (REPLICA_AXIS, DATA_AXIS, MODEL_AXIS)
+_BUF_SPEC = P(REPLICA_AXIS, DATA_AXIS, MODEL_AXIS, None)
+
+
+def _axis_sizes(mesh) -> dict:
+    return dict(zip(mesh.axis_names, mesh.devices.shape))
+
+
+def _group_rank(axes: Sequence[str], sizes: dict):
+    """Flattened member index over ``axes`` (major -> minor), as a traced value."""
+    idx = jnp.int32(0)
+    for a in axes:
+        idx = idx * sizes[a] + lax.axis_index(a)
+    return idx
+
+
+def _gather_group(x, axes: Sequence[str]):
+    """Local (n, ...) -> (G, n, ...): every member's block, in group-rank order.
+
+    Built from nested tiled all_gathers (minor axis first) so multi-axis groups work on
+    every JAX version; XLA fuses the nest into one gather on a single axis.
+    """
+    y = x[None]
+    for a in reversed(tuple(axes)):
+        y = lax.all_gather(y, a, axis=0, tiled=True)
+    return y
+
+
+def _reduce_local(vals, op: ReductionType, axis=0):
+    if op == ReductionType.SUM:
+        return jnp.sum(vals, axis=axis)
+    if op == ReductionType.MIN:
+        return jnp.min(vals, axis=axis)
+    return jnp.max(vals, axis=axis)
+
+
+def _preduce(x, axes, op: ReductionType):
+    axes = tuple(axes)
+    if op == ReductionType.SUM:
+        return lax.psum(x, axes)
+    if op == ReductionType.MIN:
+        return lax.pmin(x, axes)
+    return lax.pmax(x, axes)
+
+
+# ---------------------------------------------------------------------------
+# Local (per-shard) collective bodies. Each takes the squeezed local buffer
+# (shape (n,)) and returns the squeezed local result.
+# ---------------------------------------------------------------------------
+
+
+def _body_allreduce(x, *, axes, sizes, op, **_):
+    return _preduce(x, axes, op)
+
+
+def _body_reduce(x, *, axes, sizes, op, root, **_):
+    # MPI semantics: result meaningful only at root. Returning the reduction on every
+    # member is a strict superset and lets XLA use the same allreduce lowering.
+    return _preduce(x, axes, op)
+
+
+def _body_bcast(x, *, axes, sizes, root, **_):
+    members = _gather_group(x, axes)
+    return members[root]
+
+
+def _body_allgather(x, *, axes, sizes, **_):
+    g = _gather_group(x, axes)           # (G, n)
+    return g.reshape((-1,) + x.shape[1:])
+
+
+def _body_allgatherv(x, *, axes, sizes, recv_counts, **_):
+    g = _gather_group(x, axes)           # (G, maxcount)
+    parts = [g[i, : recv_counts[i]] for i in range(len(recv_counts))]
+    return jnp.concatenate(parts, axis=0)
+
+
+def _body_gather(x, *, axes, sizes, root, **_):
+    # Root-only semantics; full concatenation returned on every member (superset).
+    return _body_allgather(x, axes=axes, sizes=sizes)
+
+
+def _body_scatter(x, *, axes, sizes, root, recv_count, **_):
+    members = _gather_group(x, axes)     # (G, G*recv_count)
+    me = _group_rank(axes, sizes)
+    return lax.dynamic_slice_in_dim(members[root], me * recv_count, recv_count, axis=0)
+
+
+def _body_reduce_scatter(x, *, axes, sizes, op, recv_count, **_):
+    if op == ReductionType.SUM and len(axes) == 1:
+        return lax.psum_scatter(x, axes[0], scatter_dimension=0, tiled=True)
+    red = _preduce(x, axes, op)          # (G*recv_count,)
+    me = _group_rank(axes, sizes)
+    return lax.dynamic_slice_in_dim(red, me * recv_count, recv_count, axis=0)
+
+
+def _body_alltoall(x, *, axes, sizes, send_count, **_):
+    if len(axes) == 1:
+        return lax.all_to_all(x, axes[0], split_axis=0, concat_axis=0, tiled=True)
+    g = sizes_prod(axes, sizes)
+    blocks = _gather_group(x.reshape(g, send_count), axes)  # (G, G, send_count)
+    me = _group_rank(axes, sizes)
+    mine = lax.dynamic_index_in_dim(blocks, me, axis=1, keepdims=False)  # (G, count)
+    return mine.reshape(g * send_count)
+
+
+def _body_alltoallv(x, *, axes, sizes, S, Soff, Roff, recv_len, **_):
+    """Emulated AlltoAllv with full static count matrices (MPI semantics).
+
+    S[i][j] = elements rank i sends to member j; Soff[i][j] = offset of that segment in
+    i's send buffer; Roff[i][j] = offset in i's receive buffer where data from j lands.
+    The reference expresses this with per-rank count arrays passed to pairwise
+    Isend/Irecv (src/comm_ep.cpp:1188-1265); SPMD needs the whole matrix statically.
+    Segment lengths vary per (j, me) pair, so slices use a static max length with a
+    validity mask.
+    """
+    g = len(S)
+    g_members = _gather_group(x, axes)   # (G, send_len)
+    me = _group_rank(axes, sizes)
+    s_m = jnp.asarray(S, dtype=jnp.int32)
+    soff_m = jnp.asarray(Soff, dtype=jnp.int32)
+    roff_m = jnp.asarray(Roff, dtype=jnp.int32)
+    lmax = int(np.max(S)) if np.max(S) > 0 else 1
+    pos = jnp.arange(lmax)
+    pad = jnp.zeros((lmax,), dtype=x.dtype)
+    out = jnp.zeros((recv_len + lmax,), dtype=x.dtype)
+    for j in range(g):
+        cnt = s_m[j, me]
+        src = lax.dynamic_slice_in_dim(
+            jnp.concatenate([g_members[j], pad]), soff_m[j, me], lmax, axis=0
+        )
+        roff = roff_m[me, j]
+        window = lax.dynamic_slice_in_dim(out, roff, lmax, axis=0)
+        merged = jnp.where(pos < cnt, src, window)
+        out = lax.dynamic_update_slice_in_dim(out, merged, roff, axis=0)
+    return out[:recv_len]
+
+
+def sizes_prod(axes, sizes) -> int:
+    g = 1
+    for a in axes:
+        g *= sizes[a]
+    return g
+
+
+# ---------------------------------------------------------------------------
+# Color-group (arbitrary subgroup) bodies: world-gather + static member tables.
+# ---------------------------------------------------------------------------
+
+
+def _color_tables(group: ProcessGroup):
+    """(member_matrix (W,G): row p = world ranks of p's group in order;
+    my_pos (W,): p's index within its group)."""
+    w = group.topology.world_size
+    g = group.size
+    member = np.zeros((w, g), dtype=np.int32)
+    pos = np.zeros((w,), dtype=np.int32)
+    for p in range(w):
+        ranks = group.member_world_ranks(group.colors[p])
+        member[p] = ranks
+        pos[p] = ranks.index(p)
+    return member, pos
+
+
+def _make_color_body(kind: str, group: ProcessGroup, *, op=None, root=None, recv_count=None):
+    member_np, pos_np = _color_tables(group)
+    sizes = _axis_sizes(group.topology.mesh)
+
+    def body(x):
+        full = _gather_group(x, ALL_AXES)                      # (W, n)
+        me = _group_rank(ALL_AXES, sizes)                      # world rank
+        members = jnp.take(jnp.asarray(member_np), me, axis=0)  # (G,)
+        vals = jnp.take(full, members, axis=0)                  # (G, n)
+        if kind in ("allreduce", "reduce"):
+            return _reduce_local(vals, op)
+        if kind == "bcast":
+            return vals[root]
+        if kind in ("allgather", "gather"):
+            return vals.reshape(-1)
+        if kind == "scatter":
+            mypos = jnp.take(jnp.asarray(pos_np), me)
+            return lax.dynamic_slice_in_dim(
+                vals[root], mypos * recv_count, recv_count, axis=0
+            )
+        if kind == "reduce_scatter":
+            red = _reduce_local(vals, op)                      # (G*recv_count,)
+            mypos = jnp.take(jnp.asarray(pos_np), me)
+            return lax.dynamic_slice_in_dim(red, mypos * recv_count, recv_count, axis=0)
+        if kind == "alltoall":
+            g = member_np.shape[1]
+            mypos = jnp.take(jnp.asarray(pos_np), me)
+            blocks = vals.reshape(g, g, -1)                    # (G, G, count)
+            mine = lax.dynamic_index_in_dim(blocks, mypos, axis=1, keepdims=False)
+            return mine.reshape(-1)
+        raise NotImplementedError(kind)
+
+    return body
+
+
+_AXIS_BODIES = {
+    "allreduce": _body_allreduce,
+    "reduce": _body_reduce,
+    "bcast": _body_bcast,
+    "allgather": _body_allgather,
+    "allgatherv": _body_allgatherv,
+    "gather": _body_gather,
+    "scatter": _body_scatter,
+    "reduce_scatter": _body_reduce_scatter,
+    "alltoall": _body_alltoall,
+    "alltoallv": _body_alltoallv,
+}
+
+
+# ---------------------------------------------------------------------------
+# Builder + cache
+# ---------------------------------------------------------------------------
+
+_cache: dict = {}
+
+
+def clear_cache() -> None:
+    _cache.clear()
+
+
+def _group_key(group: ProcessGroup):
+    # Stable identity: mesh shape + device ids (NOT id(mesh) — a GC'd mesh's address
+    # can be reused by a different mesh, which would alias cache entries).
+    mesh = group.topology.mesh
+    dev_ids = tuple(int(d.id) for d in mesh.devices.flat)
+    return (mesh.devices.shape, dev_ids, group.axes, group.colors)
+
+
+def build_collective(kind: str, group: ProcessGroup, dtype, **kw) -> Callable:
+    """Return a compiled fn: global buffer (R,D,M,n) -> global result buffer.
+
+    Static kwargs per kind: op, root, recv_count, send_count, recv_counts (tuple),
+    send_counts/send_offsets/recv_offsets/recv_len (alltoallv).
+    """
+    key = (kind, _group_key(group), np.dtype(dtype).str, tuple(sorted(kw.items())))
+    fn = _cache.get(key)
+    if fn is not None:
+        return fn
+
+    topo = group.topology
+    mesh = topo.mesh
+    sizes = _axis_sizes(mesh)
+
+    if group.is_self or (group.colors is None and sizes_prod(group.axes, sizes) == 1):
+        # Single-member group: every collective is the identity (or local reshape).
+        def body(x, _kind=kind, _kw=kw):
+            if _kind == "alltoallv":
+                return x[: _kw["recv_len"]]
+            if _kind in ("scatter", "reduce_scatter"):
+                return x[: _kw["recv_count"]]
+            if _kind == "allgatherv":
+                return x[: _kw["recv_counts"][0]]
+            return x
+
+    elif group.colors is not None:
+        body = _make_color_body(
+            kind,
+            group,
+            op=kw.get("op"),
+            root=kw.get("root"),
+            recv_count=kw.get("recv_count"),
+        )
+    else:
+        raw = _AXIS_BODIES[kind]
+        body = functools.partial(raw, axes=group.axes, sizes=sizes, **kw)
+
+    def local_fn(x):  # x: (1, 1, 1, n)
+        out = body(x.reshape(x.shape[3:] or (1,)) if x.ndim == 4 else x)
+        return out[None, None, None]
+
+    sm = _shard_map(local_fn, mesh=mesh, in_specs=_BUF_SPEC, out_specs=_BUF_SPEC)
+    fn = jax.jit(sm)
+    _cache[key] = fn
+    return fn
+
+
+def build_barrier(group: ProcessGroup) -> Callable:
+    """A tiny psum over the group; Wait-ing its result is the barrier
+    (reference Distribution::Barrier src/mlsl.cpp; EP backend uses MPI_Barrier)."""
+    key = ("barrier", _group_key(group))
+    fn = _cache.get(key)
+    if fn is None:
+        if group.colors is not None or not group.axes:
+            axes = ALL_AXES
+        else:
+            axes = group.axes
+
+        def local_fn(x):
+            return lax.psum(x, axes)[None, None, None]
+
+        topo = group.topology
+        sm = _shard_map(
+            lambda x: local_fn(x.reshape(x.shape[3:])),
+            mesh=topo.mesh,
+            in_specs=_BUF_SPEC,
+            out_specs=_BUF_SPEC,
+        )
+        fn = jax.jit(sm)
+        _cache[key] = fn
+    return fn

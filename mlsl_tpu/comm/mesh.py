@@ -1,0 +1,176 @@
+"""Device mesh topology and process groups.
+
+Replaces the reference's ProcessGroup-over-MPI_Comm (src/comm.hpp:33-46, backend
+ProcessGroupImpl src/comm_ep.cpp:144-200): the "world" is the set of JAX devices,
+arranged as a ``jax.sharding.Mesh`` of shape (replica, data, model). A ProcessGroup is a
+*descriptor* — either an axis-aligned subgroup (named mesh axes, the fast path: XLA
+collectives ride ICI rings directly) or a color partition (arbitrary subgroups, the
+analog of MPI_Comm_split color, reference src/mlsl.cpp:620-647), executed via a
+gather+mask fallback.
+
+Rank layout matches the reference grid math (src/mlsl_impl.hpp:224-266):
+    global rank p  =  replicaIdx * (D*M) + dataIdx * M + modelIdx
+i.e. the model axis is minor (consecutive ranks form a model group), the data axis is
+strided by M, replicas are outermost blocks.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional, Sequence, Tuple
+
+import numpy as np
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from mlsl_tpu.log import mlsl_assert
+
+REPLICA_AXIS = "replica"
+DATA_AXIS = "data"
+MODEL_AXIS = "model"
+
+
+class Topology:
+    """The device world arranged as a (replica, data, model) mesh.
+
+    One Topology per (Environment, Distribution-shape). The mesh is built so that the
+    flattened device order follows the reference's rank layout; group indices derived
+    from mesh coordinates therefore match the reference's color math exactly.
+    """
+
+    def __init__(
+        self,
+        data_parts: int,
+        model_parts: int,
+        devices: Optional[Sequence[jax.Device]] = None,
+    ):
+        if devices is None:
+            devices = jax.devices()
+        n = len(devices)
+        mlsl_assert(
+            data_parts > 0 and model_parts > 0,
+            "numbers for data and model groups must be positive",
+        )
+        l_size = data_parts * model_parts
+        mlsl_assert(
+            n % l_size == 0,
+            "device count %d not divisible by dataParts*modelParts %d",
+            n,
+            l_size,
+        )
+        self.data_parts = data_parts
+        self.model_parts = model_parts
+        self.replica_count = n // l_size
+        self.world_size = n
+        dev_array = np.array(list(devices), dtype=object).reshape(
+            self.replica_count, data_parts, model_parts
+        )
+        self.mesh = Mesh(dev_array, (REPLICA_AXIS, DATA_AXIS, MODEL_AXIS))
+
+    # -- rank <-> coordinate math (reference src/mlsl_impl.hpp:224-240) --
+
+    def coords(self, global_idx: int) -> Tuple[int, int, int]:
+        """global rank -> (replicaIdx, dataIdx, modelIdx)."""
+        l_size = self.data_parts * self.model_parts
+        l_id = global_idx % l_size
+        return (global_idx // l_size, l_id // self.model_parts, l_id % self.model_parts)
+
+    def global_idx(self, replica: int, data: int, model: int) -> int:
+        return (replica * self.data_parts + data) * self.model_parts + model
+
+    def buffer_sharding(self, extra_dims: int = 1) -> NamedSharding:
+        """Sharding for a 'distributed buffer': global shape
+        (replica, data, model, *local_shape), one local payload per rank."""
+        spec = P(REPLICA_AXIS, DATA_AXIS, MODEL_AXIS, *([None] * extra_dims))
+        return NamedSharding(self.mesh, spec)
+
+    def shard_buffer(self, array) -> jax.Array:
+        """Place a host array of shape (R, D, M, ...) so that element [r, d, m] lives on
+        the device with those mesh coordinates."""
+        mlsl_assert(
+            array.ndim >= 4
+            and array.shape[0] == self.replica_count
+            and array.shape[1] == self.data_parts
+            and array.shape[2] == self.model_parts,
+            "buffer must have shape (R=%d, D=%d, M=%d, ...), got %s",
+            self.replica_count,
+            self.data_parts,
+            self.model_parts,
+            array.shape,
+        )
+        return jax.device_put(array, self.buffer_sharding(array.ndim - 3))
+
+
+@dataclasses.dataclass(frozen=True)
+class ProcessGroup:
+    """A subgroup of the world over which a collective runs.
+
+    Axis-aligned (colors is None): the members are the mesh ranks along ``axes``; the
+    member index is the flattened coordinate over ``axes`` in the given (major->minor)
+    order. This is the fast path — XLA lowers the collective onto the ICI rings of those
+    axes.
+
+    Color-based (colors is not None): ``colors[p]`` assigns world rank p to a group;
+    members are ordered by world rank within each color (MPI_Comm_split semantics,
+    reference src/comm_ep.cpp:1821-1827).
+    """
+
+    topology: Topology
+    axes: Tuple[str, ...]  # subset of (replica, data, model); () = self group
+    colors: Optional[Tuple[int, ...]] = None
+
+    def __post_init__(self):
+        if self.colors is not None:
+            mlsl_assert(
+                len(self.colors) == self.topology.world_size,
+                "colors must cover the world: %d != %d",
+                len(self.colors),
+                self.topology.world_size,
+            )
+
+    @property
+    def is_self(self) -> bool:
+        return self.colors is None and len(self.axes) == 0
+
+    @property
+    def size(self) -> int:
+        if self.colors is not None:
+            # All color groups must be the same size for SPMD collectives.
+            from collections import Counter
+
+            counts = Counter(self.colors)
+            sizes = set(counts.values())
+            mlsl_assert(
+                len(sizes) == 1,
+                "color groups must be equal-sized for SPMD execution, got %s",
+                dict(counts),
+            )
+            return sizes.pop()
+        size = 1
+        shape = dict(
+            zip(self.topology.mesh.axis_names, self.topology.mesh.devices.shape)
+        )
+        for a in self.axes:
+            size *= shape[a]
+        return max(size, 1)
+
+    def member_world_ranks(self, color: int) -> Tuple[int, ...]:
+        """World ranks of a color group, in group-rank order (colors mode only)."""
+        mlsl_assert(self.colors is not None, "member_world_ranks requires colors mode")
+        return tuple(p for p, c in enumerate(self.colors) if c == color)
+
+    def group_idx_of(self, global_idx: int) -> int:
+        """Member index of world rank ``global_idx`` within its group."""
+        if self.colors is not None:
+            return self.member_world_ranks(self.colors[global_idx]).index(global_idx)
+        if not self.axes:
+            return 0
+        r, d, m = self.topology.coords(global_idx)
+        coord = {REPLICA_AXIS: r, DATA_AXIS: d, MODEL_AXIS: m}
+        shape = dict(
+            zip(self.topology.mesh.axis_names, self.topology.mesh.devices.shape)
+        )
+        idx = 0
+        for a in self.axes:
+            idx = idx * shape[a] + coord[a]
+        return idx

@@ -1,0 +1,292 @@
+"""Asynchronous communication requests: the Start/Wait/Test engine.
+
+Replaces the reference's CommRequest + eplib command queue (src/comm.hpp:368-409,
+eplib/cqueue.c): where the reference hands a command to a shared-memory ring drained by
+endpoint-server processes, here ``start`` dispatches an already-compiled XLA executable
+— JAX's async dispatch returns immediately while the TPU runs the collective — and the
+returned jax.Array is the completion handle (``block_until_ready`` = Wait,
+``is_ready()`` = Test).
+
+Also implements, as host-side scheduling policy:
+- large-message chunking (reference splits >128 MiB allreduces, src/comm_ep.cpp:640-657):
+  a big allreduce is dispatched as several independent chunk programs, so completion is
+  incremental and chunks from different requests interleave;
+- newest-first priority (reference eplib/allreduce_pr.c LIFO queue, :76-79): requests
+  larger than the threshold are deferred onto a stack and dispatched LIFO at the next
+  sync point, so the most recently produced gradients hit the wire first.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import enum
+import threading
+from typing import Callable, List, Optional
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from mlsl_tpu.comm.mesh import ProcessGroup
+from mlsl_tpu.comm import collectives
+from mlsl_tpu.log import mlsl_assert, log_debug
+from mlsl_tpu.types import (
+    CompressionType,
+    DataType,
+    ReductionType,
+    dtype_size,
+    jnp_dtype,
+)
+
+
+class ComputeType(enum.IntEnum):
+    """What a request carries (reference CommDesc src/comm.hpp:253-261)."""
+
+    FPROP = 0
+    BPROP = 1
+    PARAM_GRAD = 2
+    PARAM_INC = 3
+    GENERIC = 4
+
+
+@dataclasses.dataclass
+class CommDesc:
+    kind: str                      # 'allreduce' | 'bcast' | ... | 'barrier'
+    group: ProcessGroup
+    count: int                     # elements per rank (send side)
+    data_type: DataType
+    compute_type: ComputeType = ComputeType.GENERIC
+    op: Optional[ReductionType] = None
+    root: Optional[int] = None
+    recv_count: Optional[int] = None
+    recv_counts: Optional[tuple] = None
+    send_counts: Optional[tuple] = None
+    send_offsets: Optional[tuple] = None
+    recv_offsets: Optional[tuple] = None
+    compression: CompressionType = CompressionType.NONE
+
+    def payload_bytes(self) -> int:
+        return self.count * dtype_size(self.data_type)
+
+
+class CommRequest:
+    """One reusable communication request (the analog of a cached CommRequestImpl).
+
+    Lifecycle: construct -> setup() (compile) -> start(buf) / wait() / test() any number
+    of times. ``start`` never blocks; ``wait`` returns the result array.
+    """
+
+    _seq_lock = threading.Lock()
+    _seq = 0
+
+    def __init__(self, desc: CommDesc, dispatcher: "Dispatcher", name: str = ""):
+        self.desc = desc
+        self.dispatcher = dispatcher
+        self.name = name
+        self._fns: List[Callable] = []
+        self._chunk_slices: List[slice] = []
+        self._concat_fn: Optional[Callable] = None
+        self._results: List[jax.Array] = []
+        self._result: Optional[jax.Array] = None
+        self.is_started = False
+        self.is_setup = False
+        self._epoch = 0
+        with CommRequest._seq_lock:
+            CommRequest._seq += 1
+            self.uid = CommRequest._seq
+
+    # -- setup ------------------------------------------------------------
+
+    def setup(self) -> None:
+        """Build (and implicitly compile on first run) the collective programs."""
+        d = self.desc
+        if d.kind == "barrier":
+            self._fns = [collectives.build_barrier(d.group)]
+            self._chunk_slices = [slice(None)]
+            self.is_setup = True
+            return
+
+        kw = {}
+        if d.op is not None:
+            kw["op"] = ReductionType(d.op)
+        if d.root is not None:
+            kw["root"] = int(d.root)
+        if d.recv_count is not None:
+            kw["recv_count"] = int(d.recv_count)
+        if d.recv_counts is not None:
+            kw["recv_counts"] = tuple(int(c) for c in d.recv_counts)
+        if d.kind == "alltoall":
+            kw["send_count"] = int(d.count)
+        if d.kind == "alltoallv":
+            kw.pop("recv_counts", None)
+            kw.update(_normalize_alltoallv(d))
+
+        dtype = jnp_dtype(d.data_type)
+        chunks = self._plan_chunks()
+        if chunks is None:
+            self._fns = [collectives.build_collective(d.kind, d.group, dtype, **kw)]
+            self._chunk_slices = [slice(None)]
+        else:
+            fn = collectives.build_collective(d.kind, d.group, dtype, **kw)
+            self._fns = [fn] * len(chunks)
+            self._chunk_slices = chunks
+        self.is_setup = True
+
+    def _plan_chunks(self):
+        """Chunk only elementwise-decomposable hot collectives (allreduce)."""
+        d = self.desc
+        cfg = self.dispatcher.config
+        if d.kind != "allreduce" or d.compression != CompressionType.NONE:
+            return None
+        threshold = cfg.large_msg_size_mb * 1024 * 1024
+        if threshold <= 0 or d.payload_bytes() <= threshold or cfg.large_msg_chunks <= 1:
+            return None
+        k = min(cfg.large_msg_chunks, d.count)
+        bounds = np.linspace(0, d.count, k + 1).astype(int)
+        return [slice(int(a), int(b)) for a, b in zip(bounds[:-1], bounds[1:]) if b > a]
+
+    # -- start/wait/test --------------------------------------------------
+
+    def start(self, buf: jax.Array) -> "CommRequest":
+        mlsl_assert(self.is_setup, "request must be setup() before start()")
+        self._epoch += 1
+        self._results = []
+        self._result = None
+        self.is_started = True
+        self.dispatcher.submit(self, buf)
+        return self
+
+    def _dispatch(self, buf: jax.Array) -> None:
+        """Actually launch the XLA programs (called by the Dispatcher)."""
+        if len(self._chunk_slices) == 1 and self._chunk_slices[0] == slice(None):
+            self._results = [self._fns[0](buf)]
+        else:
+            self._results = [
+                fn(buf[..., sl]) for fn, sl in zip(self._fns, self._chunk_slices)
+            ]
+
+    def _assemble(self) -> jax.Array:
+        if self._result is None:
+            if len(self._results) == 1:
+                self._result = self._results[0]
+            else:
+                self._result = jnp.concatenate(self._results, axis=-1)
+        return self._result
+
+    def wait(self) -> jax.Array:
+        mlsl_assert(self.is_started, "request was not started")
+        self.dispatcher.flush()
+        out = self._assemble()
+        jax.block_until_ready(out)
+        self.is_started = False
+        return out
+
+    def test(self) -> tuple:
+        """Non-blocking completion poll -> (is_completed, result_or_None)."""
+        if not self.is_started:
+            return True, self._result
+        self.dispatcher.flush()
+        ready = all(_array_is_ready(r) for r in self._results)
+        if ready:
+            out = self._assemble()
+            jax.block_until_ready(out)
+            self.is_started = False
+            return True, out
+        return False, None
+
+
+def _normalize_alltoallv(d: CommDesc) -> dict:
+    """Expand user count/offset arrays into full (G, G) static matrices.
+
+    MPI semantics: S[i][j] = elements i->member j. 1-D arrays mean 'same on every
+    rank' (S[i][j] = counts[j]); 2-D arrays give the full matrix. Offsets default to
+    the packed (cumulative) layout. The receive matrix is derived: R[i][j] = S[j][i].
+    """
+    g = d.group.size
+
+    def packed(mat):
+        return np.hstack([np.zeros((g, 1), int), np.cumsum(mat, axis=1)[:, :-1]])
+
+    def expand(arr):
+        a = np.asarray(arr, dtype=int)
+        if a.ndim == 1:
+            return np.tile(a, (g, 1))
+        mlsl_assert(a.shape == (g, g), "counts/offsets matrix must be (%d,%d)", g, g)
+        return a
+
+    s = expand(d.send_counts)
+    soff = packed(s) if d.send_offsets is None else expand(d.send_offsets)
+    r = s.T
+    roff = packed(r) if d.recv_offsets is None else expand(d.recv_offsets)
+    recv_len = int(np.max(roff + r)) if g > 0 else 1
+    to_t = lambda m: tuple(tuple(int(v) for v in row) for row in m)
+    return dict(S=to_t(s), Soff=to_t(soff), Roff=to_t(roff), recv_len=max(recv_len, 1))
+
+
+def _array_is_ready(arr: jax.Array) -> bool:
+    try:
+        return bool(arr.is_ready())
+    except AttributeError:  # pragma: no cover - very old jax
+        jax.block_until_ready(arr)
+        return True
+
+
+class Dispatcher:
+    """Host-side dispatch policy: immediate async launch, or newest-first deferral.
+
+    The reference's endpoint servers pull commands from a queue and (optionally) serve
+    the newest large allreduce first (eplib/cqueue.c:1999-2012 routing to
+    allreduce_pr.c LIFO). Here the queue is a host-side stack of not-yet-launched
+    requests; flush() launches them LIFO. Small messages bypass the stack entirely.
+    """
+
+    def __init__(self, config):
+        self.config = config
+        self._pending: List[tuple] = []  # stack of (request, buf)
+        self._lock = threading.Lock()
+
+    def submit(self, req: CommRequest, buf: jax.Array) -> None:
+        cfg = self.config
+        if (
+            cfg.msg_priority
+            and req.desc.payload_bytes() > cfg.msg_priority_threshold
+            and req.desc.kind != "barrier"
+        ):
+            with self._lock:
+                # A restart of an already-deferred request supersedes the stale entry
+                # (otherwise flush would re-dispatch the old buffer last and clobber
+                # the fresh results).
+                self._pending = [(r, b) for r, b in self._pending if r is not req]
+                self._pending.append((req, buf))
+            log_debug("deferred request %s (%d B)", req.name, req.desc.payload_bytes())
+        else:
+            req._dispatch(buf)
+
+    def flush(self) -> None:
+        with self._lock:
+            pending, self._pending = self._pending, []
+        if not pending:
+            return
+        order = reversed(pending) if self.config.msg_priority_mode else iter(pending)
+        for req, buf in order:
+            req._dispatch(buf)
+
+
+class RequestStorage:
+    """Tracks live generic requests so Environment.Wait/Test can free them
+    (reference RequestStorage src/mlsl_impl.hpp:60-94)."""
+
+    def __init__(self):
+        self._reqs: dict = {}
+        self._lock = threading.Lock()
+
+    def register(self, req: CommRequest) -> None:
+        with self._lock:
+            self._reqs[req.uid] = req
+
+    def remove(self, req: CommRequest) -> None:
+        with self._lock:
+            self._reqs.pop(req.uid, None)
+
+    def __len__(self) -> int:
+        return len(self._reqs)

@@ -1,0 +1,85 @@
+"""Typed configuration with MLSL_* environment-variable overrides.
+
+The reference scatters ~25 env knobs across three tiers (src/env.cpp:26-40,
+src/comm_ep.cpp:43-92,1543-1699, eplib/env.c). Here a single dataclass holds the typed
+config; every field can be overridden by the same ``MLSL_*`` names the reference honors
+(where a knob still makes sense on TPU). Knobs tied to MPI endpoint servers are accepted
+and mapped to their TPU analog or recorded as no-ops, so existing launch scripts keep
+working.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+
+
+def _env_int(name: str, default: int) -> int:
+    v = os.environ.get(name)
+    return int(v) if v not in (None, "") else default
+
+
+def _env_bool(name: str, default: bool) -> bool:
+    v = os.environ.get(name)
+    if v in (None, ""):
+        return default
+    return v.strip().lower() not in ("0", "false", "no", "off")
+
+
+@dataclasses.dataclass
+class Config:
+    # --- core tier (reference src/env.cpp:26-40) ---
+    log_level: int = 0              # MLSL_LOG_LEVEL
+    dup_group: bool = False         # MLSL_DUP_GROUP: force a dedicated data group even
+                                    # when dataParts == world size
+    enable_stats: bool = False      # MLSL_STATS
+    auto_config_type: int = 0       # MLSL_AUTO_CONFIG_TYPE
+
+    # --- dispatch/backend tier (reference src/comm_ep.cpp:43-92) ---
+    # Number of parallel dispatch lanes. TPU analog of MLSL_NUM_SERVERS (endpoint
+    # count): how many independent collective launches may be in flight.
+    num_servers: int = 4            # MLSL_NUM_SERVERS
+    # Chunking for very large messages (reference splits >128 MiB into chunks,
+    # src/comm_ep.cpp:95-97). XLA handles ICI channelization; the knob survives as the
+    # size at which a collective is split into independently dispatched chunks so Wait
+    # can complete (and overlap) incrementally.
+    large_msg_size_mb: int = 128    # MLSL_LARGE_MSG_SIZE_MB
+    large_msg_chunks: int = 4       # MLSL_LARGE_MSG_CHUNKS
+    max_short_msg_size: int = 0     # MLSL_MAX_SHORT_MSG_SIZE
+
+    # --- priority scheduling (reference eplib/env.c:135-165, allreduce_pr.c) ---
+    msg_priority: bool = False        # MLSL_MSG_PRIORITY: newest-first dispatch
+    msg_priority_threshold: int = 10000  # MLSL_MSG_PRIORITY_THRESHOLD (bytes)
+    msg_priority_mode: bool = True    # MLSL_MSG_PRIORITY_MODE: 1 = LIFO
+
+    # --- quantization ---
+    quant_block_elems: int = 256
+
+    # --- accepted-for-parity no-ops (MPI/shm specific) ---
+    server_affinity: str = ""       # MLSL_SERVER_AFFINITY
+    heap_size_gb: int = 0           # MLSL_HEAP_SIZE_GB
+    alltoall_split: int = 1         # MLSL_ALLTOALL_SPLIT
+    thp_threshold_mb: int = 0       # MLSL_THP_THRESHOLD_MB
+
+    @staticmethod
+    def from_env() -> "Config":
+        c = Config()
+        c.log_level = _env_int("MLSL_LOG_LEVEL", c.log_level)
+        c.dup_group = _env_bool("MLSL_DUP_GROUP", c.dup_group)
+        c.enable_stats = _env_bool("MLSL_STATS", c.enable_stats)
+        c.auto_config_type = _env_int("MLSL_AUTO_CONFIG_TYPE", c.auto_config_type)
+        c.num_servers = _env_int("MLSL_NUM_SERVERS", c.num_servers)
+        c.large_msg_size_mb = _env_int("MLSL_LARGE_MSG_SIZE_MB", c.large_msg_size_mb)
+        c.large_msg_chunks = _env_int("MLSL_LARGE_MSG_CHUNKS", c.large_msg_chunks)
+        c.max_short_msg_size = _env_int("MLSL_MAX_SHORT_MSG_SIZE", c.max_short_msg_size)
+        c.msg_priority = _env_bool("MLSL_MSG_PRIORITY", c.msg_priority)
+        c.msg_priority_threshold = _env_int(
+            "MLSL_MSG_PRIORITY_THRESHOLD", c.msg_priority_threshold
+        )
+        c.msg_priority_mode = _env_bool("MLSL_MSG_PRIORITY_MODE", c.msg_priority_mode)
+        c.quant_block_elems = _env_int("MLSL_QUANT_BLOCK_ELEMS", c.quant_block_elems)
+        c.server_affinity = os.environ.get("MLSL_SERVER_AFFINITY", c.server_affinity)
+        c.heap_size_gb = _env_int("MLSL_HEAP_SIZE_GB", c.heap_size_gb)
+        c.alltoall_split = _env_int("MLSL_ALLTOALL_SPLIT", c.alltoall_split)
+        c.thp_threshold_mb = _env_int("MLSL_THP_THRESHOLD_MB", c.thp_threshold_mb)
+        return c

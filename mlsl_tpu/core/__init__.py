@@ -1,0 +1,1 @@
+"""Public API layer: Environment / Session / Operation / Distribution / Statistics."""

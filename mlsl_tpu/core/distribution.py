@@ -1,0 +1,353 @@
+"""Distribution: the data x model process grid and its 11 collectives + Barrier.
+
+Mirrors the reference Distribution (include/mlsl.hpp:350-504) and DistributionImpl's
+grid construction (src/mlsl_impl.hpp:174-305). The grid math reproduces the reference's
+color formulas exactly:
+
+    lSize = dataParts * modelParts ; lId = p % lSize ; iR = p / lSize
+    dataIdx(p)  = lId / modelParts      (index within the data group)
+    modelIdx(p) = lId % modelParts      (index within the model group)
+
+so the model axis is minor. On TPU the grid IS a jax.sharding.Mesh of shape
+(replica, data, model); subgroup collectives lower onto the ICI rings of the named axes.
+
+Buffers: each collective takes a "distributed buffer" — a global jax.Array of shape
+(R, D, M, n) whose (r, d, m) slice is that rank's local buffer — and returns a
+CommRequest already started (the reference returns CommReq* from each call too,
+completed via Environment.Wait/Test). Helpers shard_buffer/make_buffer build them.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence, Tuple
+
+import numpy as np
+import jax
+
+from mlsl_tpu.comm.mesh import (
+    Topology,
+    ProcessGroup,
+    REPLICA_AXIS,
+    DATA_AXIS,
+    MODEL_AXIS,
+)
+from mlsl_tpu.comm.request import CommDesc, CommRequest, ComputeType
+from mlsl_tpu.log import mlsl_assert
+from mlsl_tpu.types import DataType, GroupType, ReductionType, jnp_dtype
+
+
+class Distribution:
+    def __init__(
+        self,
+        env,
+        data_parts: Optional[int],
+        model_parts: Optional[int],
+        devices: Sequence[jax.Device],
+        data_colors: Optional[Tuple[int, ...]] = None,
+        model_colors: Optional[Tuple[int, ...]] = None,
+    ):
+        self.env = env
+        self._colors_mode = data_colors is not None
+
+        if self._colors_mode:
+            # Color-based construction (reference src/mlsl_impl.hpp:268-280):
+            # group sizes are derived from the color assignment.
+            n = len(devices)
+            mlsl_assert(
+                len(data_colors) == n and len(model_colors) == n,
+                "color arrays must have one entry per device (%d)",
+                n,
+            )
+            from collections import Counter
+
+            d_sizes = set(Counter(data_colors).values())
+            m_sizes = set(Counter(model_colors).values())
+            mlsl_assert(
+                len(d_sizes) == 1 and len(m_sizes) == 1,
+                "color groups must be equal-sized",
+            )
+            self.data_parts = d_sizes.pop()
+            self.model_parts = m_sizes.pop()
+            # The mesh is flat (1, 1, N); groups are pure color partitions.
+            self.topology = Topology(1, 1, devices=devices)
+            # Note: Topology(1,1) gives mesh (N,1,1) since replica absorbs the rest.
+            self.data_group = ProcessGroup(self.topology, (), colors=tuple(data_colors))
+            self.model_group = ProcessGroup(
+                self.topology, (), colors=tuple(model_colors)
+            )
+            self.global_group = ProcessGroup(
+                self.topology, (REPLICA_AXIS, DATA_AXIS, MODEL_AXIS)
+            )
+            # Logical replica count is 1 in colors mode (reference
+            # src/mlsl_impl.hpp:268-273); the Topology's (N,1,1) mesh shape is a
+            # storage layout, not a replica structure — size buffers via
+            # world_shape/make_buffer, never from replica_count.
+            self.replica_count = 1
+        else:
+            self.topology = Topology(data_parts, model_parts, devices=devices)
+            self.data_parts = data_parts
+            self.model_parts = model_parts
+            self.replica_count = self.topology.replica_count
+            self.data_group = (
+                ProcessGroup(self.topology, (DATA_AXIS,))
+                if data_parts > 1
+                else ProcessGroup(self.topology, ())
+            )
+            self.model_group = (
+                ProcessGroup(self.topology, (MODEL_AXIS,))
+                if model_parts > 1
+                else ProcessGroup(self.topology, ())
+            )
+            self.global_group = ProcessGroup(
+                self.topology, (REPLICA_AXIS, DATA_AXIS, MODEL_AXIS)
+            )
+        self._self_group = ProcessGroup(self.topology, ())
+
+    # -- introspection (reference include/mlsl.hpp:360-373) ---------------
+
+    def _group(self, gt: GroupType) -> ProcessGroup:
+        gt = GroupType(gt)
+        if gt == GroupType.DATA:
+            return self.data_group
+        if gt == GroupType.MODEL:
+            return self.model_group
+        return self.global_group
+
+    def get_process_count(self, group_type: GroupType) -> int:
+        g = self._group(group_type)
+        return 1 if g.is_self else g.size
+
+    def get_process_idx(self, group_type: GroupType, global_idx: int = 0) -> int:
+        """Member index of world-rank ``global_idx`` within the group. The reference's
+        per-rank GetProcessIdx maps to this with the rank made explicit (SPMD
+        single-controller has no implicit 'my rank')."""
+        g = self._group(group_type)
+        return 0 if g.is_self else g.group_idx_of(global_idx)
+
+    def get_process_count_data(self) -> int:
+        return self.get_process_count(GroupType.DATA)
+
+    def get_process_count_model(self) -> int:
+        return self.get_process_count(GroupType.MODEL)
+
+    def get_process_count_global(self) -> int:
+        return self.topology.world_size
+
+    def get_data_parts(self) -> int:
+        return self.data_parts
+
+    def get_model_parts(self) -> int:
+        return self.model_parts
+
+    # -- buffer helpers ----------------------------------------------------
+
+    @property
+    def world_shape(self) -> Tuple[int, int, int]:
+        return (
+            self.topology.replica_count,
+            self.topology.data_parts,
+            self.topology.model_parts,
+        )
+
+    def make_buffer(self, per_rank_fn, count: int, data_type=DataType.FLOAT):
+        """Build a distributed buffer from a function global_rank -> np.ndarray(count)."""
+        r, d, m = self.world_shape
+        buf = np.stack(
+            [per_rank_fn(p) for p in range(r * d * m)], axis=0
+        ).reshape(r, d, m, count).astype(jnp_dtype(data_type))
+        return self.topology.shard_buffer(buf)
+
+    def shard_buffer(self, array) -> jax.Array:
+        """Place an (R, D, M, ...) host array onto the mesh."""
+        return self.topology.shard_buffer(np.asarray(array))
+
+    def local_part(self, buf, global_idx: int):
+        """Rank-local slice of a distributed buffer (host-side, for tests/inspection)."""
+        r, d, m = self.topology.coords(global_idx)
+        return np.asarray(buf)[r, d, m]
+
+    # -- collectives (reference include/mlsl.hpp:375-503) -----------------
+
+    def _start(self, desc: CommDesc, buf) -> CommRequest:
+        req = CommRequest(desc, self.env.dispatcher)
+        req.setup()
+        req.start(buf)
+        self.env.request_storage.register(req)
+        return req
+
+    def bcast(self, buffer, count, data_type, root_idx, group_type) -> CommRequest:
+        return self._start(
+            CommDesc(
+                "bcast",
+                self._group(group_type),
+                int(count),
+                DataType(data_type),
+                root=int(root_idx),
+            ),
+            buffer,
+        )
+
+    def reduce(
+        self, send_buffer, count, data_type, red_type, root_idx, group_type
+    ) -> CommRequest:
+        return self._start(
+            CommDesc(
+                "reduce",
+                self._group(group_type),
+                int(count),
+                DataType(data_type),
+                op=ReductionType(red_type),
+                root=int(root_idx),
+            ),
+            send_buffer,
+        )
+
+    def all_reduce(self, send_buffer, count, data_type, red_type, group_type) -> CommRequest:
+        return self._start(
+            CommDesc(
+                "allreduce",
+                self._group(group_type),
+                int(count),
+                DataType(data_type),
+                op=ReductionType(red_type),
+            ),
+            send_buffer,
+        )
+
+    def all_to_all(self, send_buffer, send_count, data_type, group_type) -> CommRequest:
+        g = self._group(group_type)
+        return self._start(
+            CommDesc("alltoall", g, int(send_count), DataType(data_type)),
+            send_buffer,
+        )
+
+    def all_to_allv(
+        self,
+        send_buffer,
+        send_counts,
+        send_offsets,
+        recv_counts,
+        recv_offsets,
+        data_type,
+        group_type,
+    ) -> CommRequest:
+        g = self._group(group_type)
+        s = np.asarray(send_counts, dtype=int)
+        count = int(s.sum(axis=-1).max()) if s.ndim else int(s)
+
+        def _tup(a):
+            if a is None:
+                return None
+            a = np.asarray(a, dtype=int)
+            if a.ndim == 1:
+                return tuple(int(v) for v in a)
+            return tuple(tuple(int(v) for v in row) for row in a)
+
+        return self._start(
+            CommDesc(
+                "alltoallv",
+                g,
+                count,
+                DataType(data_type),
+                send_counts=_tup(send_counts),
+                send_offsets=_tup(send_offsets),
+                recv_counts=_tup(recv_counts),
+                recv_offsets=_tup(recv_offsets),
+            ),
+            send_buffer,
+        )
+
+    def gather(self, send_buffer, send_count, data_type, root_idx, group_type) -> CommRequest:
+        return self._start(
+            CommDesc(
+                "gather",
+                self._group(group_type),
+                int(send_count),
+                DataType(data_type),
+                root=int(root_idx),
+            ),
+            send_buffer,
+        )
+
+    def all_gather(self, send_buffer, send_count, data_type, group_type) -> CommRequest:
+        return self._start(
+            CommDesc(
+                "allgather",
+                self._group(group_type),
+                int(send_count),
+                DataType(data_type),
+            ),
+            send_buffer,
+        )
+
+    def all_gatherv(
+        self, send_buffer, send_count, recv_counts, data_type, group_type
+    ) -> CommRequest:
+        return self._start(
+            CommDesc(
+                "allgatherv",
+                self._group(group_type),
+                int(send_count),
+                DataType(data_type),
+                recv_counts=tuple(recv_counts),
+            ),
+            send_buffer,
+        )
+
+    def scatter(self, send_buffer, recv_count, data_type, root_idx, group_type) -> CommRequest:
+        g = self._group(group_type)
+        return self._start(
+            CommDesc(
+                "scatter",
+                g,
+                int(recv_count) * (1 if g.is_self else g.size),
+                DataType(data_type),
+                root=int(root_idx),
+                recv_count=int(recv_count),
+            ),
+            send_buffer,
+        )
+
+    def reduce_scatter(
+        self, send_buffer, recv_count, data_type, red_type, group_type
+    ) -> CommRequest:
+        g = self._group(group_type)
+        return self._start(
+            CommDesc(
+                "reduce_scatter",
+                g,
+                int(recv_count) * (1 if g.is_self else g.size),
+                DataType(data_type),
+                op=ReductionType(red_type),
+                recv_count=int(recv_count),
+            ),
+            send_buffer,
+        )
+
+    def barrier(self, group_type) -> None:
+        import jax.numpy as jnp
+
+        g = self._group(group_type)
+        req = CommRequest(
+            CommDesc("barrier", g, 1, DataType.FLOAT), self.env.dispatcher
+        )
+        req.setup()
+        r, d, m = self.world_shape
+        token = self.topology.shard_buffer(np.ones((r, d, m, 1), dtype=np.float32))
+        req.start(token)
+        req.wait()
+
+    # reference-style PascalCase aliases (API parity with include/mlsl.hpp) ----
+    GetProcessCount = get_process_count
+    GetProcessIdx = get_process_idx
+    Bcast = bcast
+    Reduce = reduce
+    AllReduce = all_reduce
+    AlltoAll = all_to_all
+    AlltoAllv = all_to_allv
+    Gather = gather
+    AllGather = all_gather
+    AllGatherv = all_gatherv
+    Scatter = scatter
+    ReduceScatter = reduce_scatter
+    Barrier = barrier

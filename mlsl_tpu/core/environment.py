@@ -1,0 +1,208 @@
+"""The Environment singleton — framework bootstrap and global services.
+
+Mirrors the reference Environment (include/mlsl.hpp:799-915, src/mlsl.cpp:684-812):
+Init/Finalize, Distribution and Session factories, Alloc/Free, Wait/Test on generic
+requests, quantization-params registration, and color-based global-group configuration.
+The TPU-native difference: Init builds no MPI world — it captures the JAX device set;
+"process count" is the device count and "process idx" is only meaningful per-device
+(SPMD), so the single-controller API exposes rank math as pure functions instead.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from typing import Optional, Sequence
+
+import numpy as np
+import jax
+
+from mlsl_tpu import sysinfo
+from mlsl_tpu.config import Config
+from mlsl_tpu.comm.mesh import Topology
+from mlsl_tpu.comm.request import CommRequest, Dispatcher, RequestStorage
+from mlsl_tpu.log import mlsl_assert, set_log_level
+from mlsl_tpu.types import DataType, QuantParams, jnp_dtype
+
+
+class Environment:
+    """Process-wide singleton (reference include/mlsl.hpp:799)."""
+
+    _instance: Optional["Environment"] = None
+    _lock = threading.Lock()
+
+    def __init__(self):
+        self._initialized = False
+        self._init_pid: Optional[int] = None
+        self.config: Optional[Config] = None
+        self.dispatcher: Optional[Dispatcher] = None
+        self.request_storage = RequestStorage()
+        self.devices: Sequence[jax.Device] = ()
+        self.quant_params: Optional[QuantParams] = None
+        self._distributions: list = []
+        self._sessions: list = []
+        self._global_colors: Optional[tuple] = None
+
+    # -- singleton --------------------------------------------------------
+
+    @classmethod
+    def get_env(cls) -> "Environment":
+        with cls._lock:
+            if cls._instance is None:
+                cls._instance = Environment()
+            return cls._instance
+
+    @classmethod
+    def is_initialized(cls) -> bool:
+        return cls._instance is not None and cls._instance._initialized
+
+    # -- lifecycle (reference src/mlsl.cpp:684-746) -----------------------
+
+    def init(self, devices: Optional[Sequence[jax.Device]] = None) -> "Environment":
+        if self._initialized:
+            return self
+        self.config = Config.from_env()
+        set_log_level(self.config.log_level)
+        sysinfo.auto_config(self.config)
+        self.dispatcher = Dispatcher(self.config)
+        self.devices = tuple(devices) if devices is not None else tuple(jax.devices())
+        self._initialized = True
+        self._init_pid = os.getpid()
+        return self
+
+    def finalize(self) -> None:
+        # Fork-safety: a forked child must not tear down the parent's state
+        # (reference initPid guard, src/mlsl.cpp:720-724).
+        if not self._initialized or os.getpid() != self._init_pid:
+            return
+        for s in self._sessions:
+            s._invalidate()
+        self._sessions.clear()
+        self._distributions.clear()
+        self._initialized = False
+        Environment._instance = None
+
+    # -- world introspection ---------------------------------------------
+
+    def get_process_count(self) -> int:
+        mlsl_assert(self._initialized, "Environment not initialized")
+        return len(self.devices)
+
+    def get_process_idx(self) -> int:
+        """Single-controller SPMD: the controller is logical rank 0. Per-device rank
+        math lives on Distribution (process_idx_of)."""
+        return 0
+
+    # -- configuration (reference src/mlsl.cpp:620-682) -------------------
+
+    def configure(self, conf_str: str) -> None:
+        """Color-based restriction of the world (reference Configure("color=N"),
+        src/mlsl.cpp:620-647: MPI ranks with the same color form the new global group).
+
+        Single-controller translation: 'color=N' (one value) keeps the full device set
+        (every device shares the controller's color — identical to the reference when
+        all ranks pass the same color). 'color=c0,c1,...' (one value per device)
+        restricts subsequently created Distributions to the devices whose color equals
+        the first listed color.
+        """
+        conf_str = conf_str.strip()
+        mlsl_assert(
+            conf_str.startswith("color="),
+            "unsupported configuration string: %s",
+            conf_str,
+        )
+        values = [int(v) for v in conf_str.split("=", 1)[1].split(",")]
+        if len(values) == 1:
+            self._global_colors = tuple(values * len(self.devices))
+            return
+        mlsl_assert(
+            len(values) == len(self.devices),
+            "color list length %d != device count %d",
+            len(values),
+            len(self.devices),
+        )
+        self._global_colors = tuple(values)
+        self.devices = tuple(
+            d for d, c in zip(self.devices, values) if c == values[0]
+        )
+
+    # -- factories --------------------------------------------------------
+
+    def create_distribution(
+        self,
+        data_parts: int,
+        model_parts: int,
+        devices: Optional[Sequence[jax.Device]] = None,
+    ):
+        from mlsl_tpu.core.distribution import Distribution
+
+        mlsl_assert(self._initialized, "Environment not initialized")
+        d = Distribution(
+            self, data_parts, model_parts, devices=devices or self.devices
+        )
+        self._distributions.append(d)
+        return d
+
+    def create_distribution_with_colors(self, data_color_per_rank, model_color_per_rank):
+        from mlsl_tpu.core.distribution import Distribution
+
+        mlsl_assert(self._initialized, "Environment not initialized")
+        d = Distribution(
+            self,
+            None,
+            None,
+            devices=self.devices,
+            data_colors=tuple(data_color_per_rank),
+            model_colors=tuple(model_color_per_rank),
+        )
+        self._distributions.append(d)
+        return d
+
+    def delete_distribution(self, dist) -> None:
+        if dist in self._distributions:
+            self._distributions.remove(dist)
+
+    def create_session(self, phase_type=None):
+        from mlsl_tpu.core.session import Session
+        from mlsl_tpu.types import PhaseType
+
+        mlsl_assert(self._initialized, "Environment not initialized")
+        s = Session(self, phase_type if phase_type is not None else PhaseType.TRAIN)
+        self._sessions.append(s)
+        return s
+
+    def delete_session(self, session) -> None:
+        if session in self._sessions:
+            session._invalidate()
+            self._sessions.remove(session)
+
+    # -- memory (reference Alloc/Free -> EPLIB_memalign shm; here device arrays) --
+
+    def alloc(self, count: int, data_type: DataType = DataType.FLOAT):
+        """Allocate a zeroed host-side buffer; collectives accept device arrays
+        directly, so this exists for API parity and test convenience."""
+        return np.zeros((count,), dtype=jnp_dtype(data_type))
+
+    def free(self, buf) -> None:  # noqa: ARG002 - parity no-op (GC owns memory)
+        return None
+
+    # -- generic request completion (reference src/mlsl.cpp:784-796) ------
+
+    def wait(self, req: CommRequest):
+        out = req.wait()
+        self.request_storage.remove(req)
+        return out
+
+    def test(self, req: CommRequest):
+        done, out = req.test()
+        if done:
+            self.request_storage.remove(req)
+        return done, out
+
+    # -- quantization (reference src/mlsl.cpp:798) ------------------------
+
+    def set_quantization_params(self, params: QuantParams) -> None:
+        self.quant_params = params
+
+    def get_quantization_params(self) -> Optional[QuantParams]:
+        return self.quant_params

@@ -1,0 +1,189 @@
+"""ParameterSet: gradient synchronization with optional distributed update.
+
+Mirrors the reference ParameterSetImpl (src/mlsl_impl.cpp:388-444 and
+include/mlsl.hpp:276-341):
+
+- kernels are partitioned over the model group: localKernelCount =
+  globalKernelCount/modelParts at offset localKernelCount*modelIdx;
+- plain path: gradients AllReduce'd over the data group;
+- distributedUpdate (ZeRO-1 ancestor): ownedKernelCount = ceil(local/dataParts),
+  localKernelCount padded up to owned*dataParts; gradients ReduceScatter'd so each data
+  rank owns a shard, the optimizer updates only the owned shard, and the parameter
+  increments AllGather back (reference :401-435);
+- int8 quantized gradients when compression is enabled (reference swaps the MPI op for
+  MPI_QUANT_OP, src/comm_ep.cpp:946-950; here the request uses the Pallas quantized
+  ring allreduce).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from mlsl_tpu.comm.request import CommDesc, CommRequest, ComputeType
+from mlsl_tpu.log import mlsl_assert
+from mlsl_tpu.types import CompressionType, DataType, ReductionType
+
+
+class ParameterSet:
+    def __init__(self, op, reg, index: int):
+        self.op = op
+        self.param_index = index
+        self.dist = op.distribution
+        self.distributed_update = bool(reg.distributed_update)
+        self.compression = CompressionType(reg.compression)
+        self.data_type = DataType(reg.data_type)
+        self.kernel_size = reg.size
+        self.global_kernel_count = reg.count
+
+        model_size = self.dist.get_process_count_model()
+        data_size = self.dist.get_process_count_data()
+        mlsl_assert(
+            self.global_kernel_count % model_size == 0,
+            "kernel count %d not divisible by model parts %d",
+            self.global_kernel_count,
+            model_size,
+        )
+        self.local_kernel_count = self.global_kernel_count // model_size
+        self._local_kernel_offset_per_model_idx = self.local_kernel_count
+
+        self.need_comm = data_size > 1
+        if self.distributed_update:
+            self.owned_kernel_count = -(-self.local_kernel_count // data_size)  # ceil
+            # The local count is padded up so each data rank owns an equal shard
+            # (reference :403-405).
+            self.local_kernel_count = self.owned_kernel_count * data_size
+        else:
+            self.owned_kernel_count = self.local_kernel_count
+
+        self.grad_req: Optional[CommRequest] = None
+        self.inc_req: Optional[CommRequest] = None
+        env = op.session.env
+        if self.need_comm:
+            n_owned = self.owned_kernel_count * self.kernel_size
+            if self.distributed_update:
+                self.grad_req = CommRequest(
+                    CommDesc(
+                        "reduce_scatter",
+                        self.dist.data_group,
+                        n_owned * data_size,
+                        self.data_type,
+                        compute_type=ComputeType.PARAM_GRAD,
+                        op=ReductionType.SUM,
+                        recv_count=n_owned,
+                        compression=self.compression,
+                    ),
+                    env.dispatcher,
+                )
+                self.inc_req = CommRequest(
+                    CommDesc(
+                        "allgather",
+                        self.dist.data_group,
+                        n_owned,
+                        self.data_type,
+                        compute_type=ComputeType.PARAM_INC,
+                    ),
+                    env.dispatcher,
+                )
+                self.inc_req.setup()
+            else:
+                self.grad_req = CommRequest(
+                    CommDesc(
+                        "allreduce",
+                        self.dist.data_group,
+                        n_owned,
+                        self.data_type,
+                        compute_type=ComputeType.PARAM_GRAD,
+                        op=ReductionType.SUM,
+                        compression=self.compression,
+                    ),
+                    env.dispatcher,
+                )
+            self.grad_req.setup()
+
+    # -- introspection (reference include/mlsl.hpp:284-341) ----------------
+
+    def get_global_kernel_count(self) -> int:
+        return self.global_kernel_count
+
+    def get_global_kernel_offset(self, model_idx: int = 0) -> int:
+        return self._local_kernel_offset_per_model_idx * model_idx
+
+    def get_local_kernel_count(self) -> int:
+        return self.local_kernel_count
+
+    def get_owned_kernel_count(self) -> int:
+        return self.owned_kernel_count
+
+    def get_owned_kernel_offset(self, data_idx: int = 0) -> int:
+        if self.distributed_update:
+            return self.owned_kernel_count * data_idx
+        return 0
+
+    def get_kernel_size(self) -> int:
+        return self.kernel_size
+
+    def get_data_type(self) -> DataType:
+        return self.data_type
+
+    def is_distributed_update(self) -> bool:
+        return self.distributed_update
+
+    # -- gradient sync (reference src/mlsl_impl.cpp:446-539) ---------------
+
+    def start_gradient_comm(self, grad_buf) -> None:
+        """Dispatch the gradient collective. grad_buf: distributed buffer of shape
+        (R, D, M, localKernelCount*kernelSize)."""
+        self.op.session._stat_event(self, "start", is_param=True)
+        if self.need_comm:
+            self.grad_req.start(grad_buf)
+        self.op.session._stat_event(self, "start_done", is_param=True)
+
+    def wait_gradient_comm(self):
+        self.op.session._stat_event(self, "wait", is_param=True)
+        out = None
+        if self.need_comm and self.grad_req.is_started:
+            out = self.grad_req.wait()
+        self.op.session._stat_event(self, "wait_done", is_param=True)
+        return out
+
+    def test_gradient_comm(self):
+        """-> (is_completed, result_or_None)."""
+        self.op.session._stat_event(self, "test", is_param=True)
+        if not self.need_comm:
+            done, out = True, None
+        else:
+            done, out = self.grad_req.test()
+        self.op.session._stat_event(self, "test_done", is_param=True)
+        return done, out
+
+    def start_increment_comm(self, inc_buf) -> None:
+        """AllGather the locally updated owned shard (distributedUpdate only)."""
+        self.op.session._stat_event(self, "start", is_param=True, is_increment=True)
+        if self.need_comm and self.distributed_update:
+            self.inc_req.start(inc_buf)
+        self.op.session._stat_event(
+            self, "start_done", is_param=True, is_increment=True
+        )
+
+    def wait_increment_comm(self):
+        self.op.session._stat_event(self, "wait", is_param=True, is_increment=True)
+        out = None
+        if self.need_comm and self.distributed_update and self.inc_req.is_started:
+            out = self.inc_req.wait()
+        self.op.session._stat_event(self, "wait_done", is_param=True, is_increment=True)
+        return out
+
+    # PascalCase parity aliases
+    GetGlobalKernelCount = get_global_kernel_count
+    GetGlobalKernelOffset = get_global_kernel_offset
+    GetLocalKernelCount = get_local_kernel_count
+    GetOwnedKernelCount = get_owned_kernel_count
+    GetOwnedKernelOffset = get_owned_kernel_offset
+    GetKernelSize = get_kernel_size
+    GetDataType = get_data_type
+    IsDistributedUpdate = is_distributed_update
+    StartGradientComm = start_gradient_comm
+    WaitGradientComm = wait_gradient_comm
+    TestGradientComm = test_gradient_comm
+    StartIncrementComm = start_increment_comm
+    WaitIncrementComm = wait_increment_comm

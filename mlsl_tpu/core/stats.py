@@ -1,0 +1,247 @@
+"""Statistics: online comm/compute accounting and the isolation benchmark.
+
+Mirrors the reference Statistics engine (include/mlsl.hpp:651-726,
+src/mlsl_impl_stats.cpp):
+
+- Online accounting: every Start/Wait/Test on any entity emits an event pair; the time
+  since the previous event is attributed to *compute* on the pre-event and to *comm* on
+  the post-event, and bytes are attributed on Start (reference UpdateStats
+  :564-668). "Cycles" are reported as nanoseconds (TPU has no rdtsc visible to the
+  host; the unit is documented).
+
+- Isolation benchmark at Commit: every registered comm request is replayed
+  ISOLATION_ITERS times (first ISOLATION_SKIP discarded) with compute off, using zero
+  buffers, giving the pure-communication time per iteration (reference
+  CollectIsolationStats :387-562, iters/skip hardcoded :48-49). This doubles as the
+  algbw-vs-size measurement harness used by bench.py.
+
+- Table printer to mlsl_stats.log (reference :226-363).
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+import jax
+
+from mlsl_tpu.types import dtype_size, jnp_dtype
+
+ISOLATION_ITERS = 10
+ISOLATION_SKIP = 4
+STATS_OUTPUT_FILE = "mlsl_stats.log"
+
+
+class _Slot:
+    __slots__ = ("bytes", "comm_ns", "comp_ns", "events")
+
+    def __init__(self):
+        self.bytes = 0
+        self.comm_ns = 0
+        self.comp_ns = 0
+        self.events = 0
+
+
+def _entity_key(entity, is_param: bool, is_increment: bool) -> Tuple:
+    if is_param:
+        kind = "INC" if is_increment else "GRAD"
+        return (kind, entity.param_index)
+    kind = "IA" if entity.is_input else "OA"
+    return (kind, entity.act_index)
+
+
+class Statistics:
+    def __init__(self, session):
+        self.session = session
+        self._started = False
+        self._last_event_ns: Optional[int] = None
+        self._slots: Dict[Tuple[int, Tuple], _Slot] = {}
+        self._isolation_ns: Dict[int, int] = {}   # op_idx -> per-iteration comm ns
+        self._isolation_bytes: Dict[int, int] = {}
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def is_enabled(self) -> bool:
+        cfg = self.session.env.config
+        return bool(cfg and cfg.enable_stats)
+
+    def is_started(self) -> bool:
+        return self._started
+
+    def initialize(self) -> None:
+        self._slots.clear()
+        if self.is_enabled():
+            self._started = True
+            self._last_event_ns = time.perf_counter_ns()
+
+    def start(self) -> None:
+        self._started = True
+        self._last_event_ns = time.perf_counter_ns()
+
+    def stop(self) -> None:
+        self._started = False
+
+    def reset(self) -> None:
+        self._slots.clear()
+        self._last_event_ns = time.perf_counter_ns()
+
+    # -- online accounting -------------------------------------------------
+
+    def _slot(self, op_idx: int, key: Tuple) -> _Slot:
+        s = self._slots.get((op_idx, key))
+        if s is None:
+            s = _Slot()
+            self._slots[(op_idx, key)] = s
+        return s
+
+    def update(self, entity, action: str, is_param: bool, is_increment: bool) -> None:
+        """Pre-events ('start','wait','test') attribute elapsed time to compute;
+        post-events ('*_done') attribute it to comm; bytes counted on start."""
+        if not self._started:
+            return
+        now = time.perf_counter_ns()
+        delta = now - (self._last_event_ns or now)
+        self._last_event_ns = now
+        op_idx = entity.op.op_idx
+        slot = self._slot(op_idx, _entity_key(entity, is_param, is_increment))
+        if action.endswith("_done"):
+            slot.comm_ns += delta
+        else:
+            slot.comp_ns += delta
+        if action == "start":
+            req = _entity_request(entity, is_param, is_increment)
+            if req is not None:
+                slot.bytes += req.desc.payload_bytes()
+        slot.events += 1
+
+    # -- isolation benchmark ----------------------------------------------
+
+    def collect_isolation_stats(self) -> None:
+        """Replay every registered comm with compute off (reference :387-562)."""
+        for op in self.session.operations:
+            total_ns = 0
+            total_bytes = 0
+            for req in _op_requests(op):
+                ns, nbytes = isolation_time_request(req)
+                total_ns += ns
+                total_bytes += nbytes
+            self._isolation_ns[op.op_idx] = total_ns
+            self._isolation_bytes[op.op_idx] = total_bytes
+
+    # -- queries (reference include/mlsl.hpp:680-725) ----------------------
+
+    def get_isolation_comm_cycles(self, op_idx: int) -> int:
+        return self._isolation_ns.get(op_idx, 0)
+
+    def get_comm_size(self, op_idx: int) -> int:
+        return sum(s.bytes for (oi, _), s in self._slots.items() if oi == op_idx)
+
+    def get_comm_cycles(self, op_idx: int) -> int:
+        return sum(s.comm_ns for (oi, _), s in self._slots.items() if oi == op_idx)
+
+    def get_compute_cycles(self, op_idx: int) -> int:
+        return sum(s.comp_ns for (oi, _), s in self._slots.items() if oi == op_idx)
+
+    def get_total_isolation_comm_cycles(self) -> int:
+        return sum(self._isolation_ns.values())
+
+    def get_total_comm_size(self) -> int:
+        return sum(s.bytes for s in self._slots.values())
+
+    def get_total_comm_cycles(self) -> int:
+        return sum(s.comm_ns for s in self._slots.values())
+
+    def get_total_compute_cycles(self) -> int:
+        return sum(s.comp_ns for s in self._slots.values())
+
+    # -- printer (reference :226-363) --------------------------------------
+
+    def print_(self, path: str = STATS_OUTPUT_FILE) -> str:
+        lines = []
+        mb = max(self.session.global_minibatch_size, 1)
+        lines.append(
+            f"{'op':<16} {'entity':<8} {'KB':>12} {'comm Kns/img':>14} "
+            f"{'comp Kns/img':>14} {'events':>8}"
+        )
+        for (op_idx, key), slot in sorted(self._slots.items()):
+            op = self.session.operations[op_idx]
+            lines.append(
+                f"{op.name:<16} {key[0] + str(key[1]):<8} "
+                f"{slot.bytes / 1024.0:>12.1f} {slot.comm_ns / 1e3 / mb:>14.2f} "
+                f"{slot.comp_ns / 1e3 / mb:>14.2f} {slot.events:>8}"
+            )
+        for op_idx, ns in sorted(self._isolation_ns.items()):
+            op = self.session.operations[op_idx]
+            lines.append(
+                f"{op.name:<16} {'ISOLATE':<8} "
+                f"{self._isolation_bytes.get(op_idx, 0) / 1024.0:>12.1f} "
+                f"{ns / 1e3 / mb:>14.2f} {'-':>14} {'-':>8}"
+            )
+        text = "\n".join(lines) + "\n"
+        try:
+            with open(path, "a") as f:
+                f.write(text)
+        except OSError:
+            pass
+        return text
+
+    # PascalCase parity aliases
+    Start = start
+    Stop = stop
+    Reset = reset
+    IsStarted = is_started
+    IsEnabled = is_enabled
+    Print = print_
+    GetIsolationCommCycles = get_isolation_comm_cycles
+    GetCommSize = get_comm_size
+    GetCommCycles = get_comm_cycles
+    GetComputeCycles = get_compute_cycles
+    GetTotalIsolationCommCycles = get_total_isolation_comm_cycles
+    GetTotalCommSize = get_total_comm_size
+    GetTotalCommCycles = get_total_comm_cycles
+    GetTotalComputeCycles = get_total_compute_cycles
+
+
+# -- helpers -----------------------------------------------------------------
+
+
+def _entity_request(entity, is_param: bool, is_increment: bool):
+    if is_param:
+        return entity.inc_req if is_increment else entity.grad_req
+    return entity.comm_req
+
+
+def _op_requests(op) -> List:
+    reqs = []
+    for act in op.inputs + op.outputs:
+        if act.comm_req is not None:
+            reqs.append(act.comm_req)
+    for ps in op.parameter_sets:
+        if ps.grad_req is not None:
+            reqs.append(ps.grad_req)
+        if ps.inc_req is not None:
+            reqs.append(ps.inc_req)
+    return reqs
+
+
+def isolation_time_request(req) -> Tuple[int, int]:
+    """(per-iteration ns, payload bytes) for one request, measured in isolation."""
+    d = req.desc
+    topo = d.group.topology
+    r, dd, m = (
+        topo.replica_count,
+        topo.data_parts,
+        topo.model_parts,
+    )
+    buf = topo.shard_buffer(
+        np.zeros((r, dd, m, d.count), dtype=jnp_dtype(d.data_type))
+    )
+    times = []
+    for i in range(ISOLATION_ITERS):
+        t0 = time.perf_counter_ns()
+        req.start(buf)
+        req.wait()
+        times.append(time.perf_counter_ns() - t0)
+    good = times[ISOLATION_SKIP:]
+    return int(sum(good) / max(len(good), 1)), d.payload_bytes()

@@ -1,0 +1,82 @@
+"""Logging and assertion utilities.
+
+TPU-native counterpart of the reference's MLSL_LOG/MLSL_ASSERT macros
+(src/log.hpp:35-83): level-gated logging with timestamp/function/line, a backtrace on
+ERROR, and an assert that finalizes the environment before raising. Unlike the
+reference, failure raises ``MLSLError`` instead of calling ``_exit(1)`` — idiomatic for
+a Python-driven runtime and testable.
+"""
+
+from __future__ import annotations
+
+import enum
+import os
+import sys
+import time
+import traceback
+
+
+class LogLevel(enum.IntEnum):
+    ERROR = 0
+    INFO = 1
+    DEBUG = 2
+    TRACE = 3
+
+
+_level = LogLevel(int(os.environ.get("MLSL_LOG_LEVEL", "0")))
+
+
+class MLSLError(RuntimeError):
+    """Raised on MLSL_ASSERT failure (reference aborts via _exit; we raise)."""
+
+
+def set_log_level(level: int | LogLevel) -> None:
+    global _level
+    _level = LogLevel(int(level))
+
+
+def get_log_level() -> LogLevel:
+    return _level
+
+
+def _emit(level: LogLevel, msg: str, *args) -> None:
+    if level > _level:
+        return
+    frame = sys._getframe(2)  # cheap caller lookup; inspect.stack() walks everything
+    text = msg % args if args else msg
+    ts = time.strftime("%H:%M:%S", time.localtime())
+    print(
+        f"[{ts}] mlsl_tpu {level.name} {frame.f_code.co_name}:{frame.f_lineno} {text}",
+        file=sys.stderr,
+        flush=True,
+    )
+    if level == LogLevel.ERROR:
+        traceback.print_stack(file=sys.stderr)
+
+
+def log_error(msg: str, *args) -> None:
+    _emit(LogLevel.ERROR, msg, *args)
+
+
+def log_info(msg: str, *args) -> None:
+    _emit(LogLevel.INFO, msg, *args)
+
+
+def log_debug(msg: str, *args) -> None:
+    _emit(LogLevel.DEBUG, msg, *args)
+
+
+def log_trace(msg: str, *args) -> None:
+    _emit(LogLevel.TRACE, msg, *args)
+
+
+def mlsl_assert(cond: bool, msg: str, *args) -> None:
+    """Assert helper mirroring MLSL_ASSERT (src/log.hpp:72-83).
+
+    The reference finalizes and _exit(1)s because C++ cannot unwind safely; a Python
+    library raises instead — the Environment stays usable so a caller that catches the
+    error (validation failures, bad wiring) can continue or finalize explicitly.
+    """
+    if cond:
+        return
+    raise MLSLError(msg % args if args else msg)

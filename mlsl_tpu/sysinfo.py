@@ -1,0 +1,60 @@
+"""Platform probing and auto-configuration.
+
+TPU counterpart of the reference's sysinfo (src/sysinfo.hpp:27-48: Xeon-vs-Phi CPU and
+ETH/MLX/HFI NIC probing feeding AutoConfig, src/mlsl.cpp:649-682). Here the probed
+"hardware" is the JAX device set: platform kind, chip generation, per-chip memory, and
+the host topology — used to pick dispatch defaults (chunk sizes, lanes).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+
+import jax
+
+
+@dataclasses.dataclass(frozen=True)
+class SysInfo:
+    platform: str            # 'tpu' | 'cpu' | 'gpu'
+    device_kind: str         # e.g. 'TPU v5 lite'
+    num_devices: int
+    num_hosts: int
+    memory_per_device: int   # bytes, 0 if unknown
+
+
+@functools.lru_cache(maxsize=1)
+def probe() -> SysInfo:
+    devices = jax.devices()
+    d0 = devices[0]
+    mem = 0
+    try:
+        stats = d0.memory_stats()
+        if stats:
+            mem = int(stats.get("bytes_limit", 0))
+    except Exception:
+        mem = 0
+    num_hosts = max(d.process_index for d in devices) + 1
+    return SysInfo(
+        platform=d0.platform,
+        device_kind=getattr(d0, "device_kind", d0.platform),
+        num_devices=len(devices),
+        num_hosts=num_hosts,
+        memory_per_device=mem,
+    )
+
+
+def auto_config(config) -> None:
+    """Adjust config defaults from probed hardware (reference src/mlsl.cpp:649-682).
+
+    The reference bumps MLSL_LARGE_MSG_CHUNKS on Ethernet; the TPU analog keys on
+    platform: on real TPU keep few large chunks (ICI is fast, dispatch overhead
+    dominates); on CPU simulation keep chunking minimal so tests stay cheap.
+    """
+    si = probe()
+    if config.auto_config_type == 0:
+        return
+    if si.platform == "tpu":
+        config.large_msg_chunks = max(config.large_msg_chunks, 4)
+    else:
+        config.large_msg_chunks = 1

@@ -1,0 +1,129 @@
+"""Public enums and small value types.
+
+Mirrors the reference API surface (include/mlsl.hpp:88-172) with TPU-appropriate
+extensions: ``DataType`` gains bf16/f16/int8 (first-class on TPU MXU), and
+``QuantParams`` replaces the reference's dlopen'd library contract
+(include/mlsl.hpp:162-171) with the parameters of the built-in Pallas kernels.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import enum
+
+import jax.numpy as jnp
+
+
+class DataType(enum.IntEnum):
+    """Element types for activations and parameters (reference include/mlsl.hpp:88-93).
+
+    The reference supports FLOAT/DOUBLE/BYTE; on TPU the natural set also includes
+    bfloat16 (MXU-native), float16 and int8.
+    """
+
+    FLOAT = 0
+    DOUBLE = 1
+    BYTE = 2
+    BFLOAT16 = 3
+    FLOAT16 = 4
+    INT8 = 5
+    INT32 = 6
+
+
+_JNP_DTYPES = {
+    DataType.FLOAT: jnp.float32,
+    DataType.DOUBLE: jnp.float64,
+    DataType.BYTE: jnp.uint8,
+    DataType.BFLOAT16: jnp.bfloat16,
+    DataType.FLOAT16: jnp.float16,
+    DataType.INT8: jnp.int8,
+    DataType.INT32: jnp.int32,
+}
+
+_DTYPE_SIZES = {
+    DataType.FLOAT: 4,
+    DataType.DOUBLE: 8,
+    DataType.BYTE: 1,
+    DataType.BFLOAT16: 2,
+    DataType.FLOAT16: 2,
+    DataType.INT8: 1,
+    DataType.INT32: 4,
+}
+
+
+def jnp_dtype(dt: DataType):
+    """DataType -> jnp dtype."""
+    return _JNP_DTYPES[DataType(dt)]
+
+
+def dtype_size(dt: DataType) -> int:
+    """Element size in bytes (reference: dataTypeSize in src/mlsl_impl.cpp:251)."""
+    return _DTYPE_SIZES[DataType(dt)]
+
+
+class PhaseType(enum.IntEnum):
+    """Training vs testing phase (reference include/mlsl.hpp:96-100)."""
+
+    TRAIN = 0
+    TEST = 1
+
+
+class GroupType(enum.IntEnum):
+    """Process-group selector (reference include/mlsl.hpp:114-119).
+
+    DATA: processes holding the same model shard for different batches (data parallel).
+    MODEL: processes holding different model shards for the same batch (model parallel).
+    GLOBAL: all processes.
+    """
+
+    DATA = 0
+    MODEL = 1
+    GLOBAL = 2
+
+
+class ReductionType(enum.IntEnum):
+    """Reduction ops for Reduce/AllReduce/ReduceScatter (reference include/mlsl.hpp:122-127)."""
+
+    SUM = 0
+    MIN = 1
+    MAX = 2
+
+
+class OpType(enum.IntEnum):
+    """Compute-operation kinds (reference include/mlsl.hpp:136-148)."""
+
+    CC = 0      # cross-correlation: IA and OA independent, has parameters
+    BIAS = 1    # same IA/OA, has parameters
+    ACT = 2     # same IA/OA, no parameters
+    POOL = 3    # same IA/OA, no parameters
+    SPLIT = 4   # OA depends on IA (=OA1+OA2...), no parameters
+    CONCAT = 5  # OA = concat(IA1, IA2, ...), no parameters
+    BCAST = 6   # OA1 = IA, OA2 = IA, ...
+    REDUCE = 7  # OA = IA1 + IA2 + ...
+    DATA = 8    # only OA (input layer)
+    EVAL = 9    # only IA (loss layer)
+
+
+class CompressionType(enum.IntEnum):
+    """Gradient-compression selector (reference include/mlsl.hpp:151-155)."""
+
+    NONE = 0
+    QUANTIZATION = 1
+
+
+@dataclasses.dataclass
+class QuantParams:
+    """Quantization configuration.
+
+    The reference (include/mlsl.hpp:162-171) names a dlopen'd library providing
+    compress/decompress/reduce_sum; here the built-in Pallas kernels implement the same
+    int8-block + error-feedback semantics (reference quant/quant.c:153-211), so only the
+    block geometry is configurable.
+    """
+
+    block_size: int = 256        # bytes per quantized block (scale + int8 payload)
+    elem_in_block: int = 256     # elements quantized per block (one shared scale)
+    lib_path: str | None = None  # accepted for API parity; ignored (kernels are built in)
+    quant_buffer_func_name: str | None = None
+    dequant_buffer_func_name: str | None = None
+    reduce_sum_func_name: str | None = None

@@ -1,0 +1,54 @@
+"""Test harness: an 8-device virtual CPU mesh simulating a multi-chip TPU slice.
+
+The reference tests multi-node behavior with 4 MPI ranks on one host
+(tests/examples/mlsl_test/Makefile:56-105); the JAX analog is
+--xla_force_host_platform_device_count, giving real SPMD execution of the sharded
+programs without TPU hardware.
+"""
+
+import os
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax  # noqa: E402
+
+# The axon site hook pins JAX_PLATFORMS=axon; override post-import as well.
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np  # noqa: E402
+import pytest  # noqa: E402
+
+
+@pytest.fixture()
+def env():
+    """A fresh initialized Environment; finalized after the test."""
+    from mlsl_tpu.core.environment import Environment
+
+    e = Environment.get_env().init()
+    yield e
+    e.finalize()
+
+
+@pytest.fixture(autouse=True)
+def _clean_singleton():
+    yield
+    from mlsl_tpu.core.environment import Environment
+
+    if Environment._instance is not None:
+        Environment._instance.finalize()
+
+
+def ref_coords(p, data_parts, model_parts):
+    """The reference's rank->color math (src/mlsl_impl.hpp:224-240), used as the
+    oracle for grid tests."""
+    l_size = data_parts * model_parts
+    l_id = p % l_size
+    i_r = p // l_size
+    i_m = l_id // model_parts   # index within the data group
+    i_f = l_id % model_parts    # index within the model group
+    model_color = i_r * l_size + i_m
+    data_color = i_r * l_size + i_f
+    return i_r, i_m, i_f, data_color, model_color

@@ -1,0 +1,66 @@
+"""Grid math unit tests: Topology/ProcessGroup vs the reference color formulas."""
+
+import pytest
+
+from tests.conftest import ref_coords
+
+
+@pytest.mark.parametrize(
+    "data_parts,model_parts",
+    [(1, 1), (8, 1), (1, 8), (2, 4), (4, 2), (2, 2), (4, 1), (1, 2)],
+)
+def test_coords_match_reference(env, data_parts, model_parts):
+    dist = env.create_distribution(data_parts, model_parts)
+    topo = dist.topology
+    world = topo.world_size
+    assert world == 8
+    for p in range(world):
+        i_r, i_m, i_f, _, _ = ref_coords(p, data_parts, model_parts)
+        r, d, m = topo.coords(p)
+        assert (r, d, m) == (i_r, i_m, i_f)
+        assert topo.global_idx(r, d, m) == p
+
+
+@pytest.mark.parametrize("data_parts,model_parts", [(2, 4), (4, 2), (8, 1), (1, 8)])
+def test_group_indices(env, data_parts, model_parts):
+    from mlsl_tpu.types import GroupType
+
+    dist = env.create_distribution(data_parts, model_parts)
+    for p in range(8):
+        i_r, i_m, i_f, _, _ = ref_coords(p, data_parts, model_parts)
+        if data_parts > 1:
+            assert dist.get_process_idx(GroupType.DATA, p) == i_m
+        if model_parts > 1:
+            assert dist.get_process_idx(GroupType.MODEL, p) == i_f
+        assert dist.get_process_idx(GroupType.GLOBAL, p) == p
+    assert dist.get_process_count(GroupType.DATA) == data_parts
+    assert dist.get_process_count(GroupType.MODEL) == model_parts
+    assert dist.get_process_count(GroupType.GLOBAL) == 8
+
+
+def test_replicas(env):
+    # 8 devices, 2x2 grid -> 2 replica blocks, same data/model group structure per block
+    dist = env.create_distribution(2, 2)
+    assert dist.replica_count == 2
+    topo = dist.topology
+    for p in range(8):
+        i_r, i_m, i_f, _, _ = ref_coords(p, 2, 2)
+        assert topo.coords(p) == (i_r, i_m, i_f)
+
+
+def test_model_group_members_are_consecutive_ranks(env):
+    # model axis is minor: ranks {0..M-1} form the first model group
+    dist = env.create_distribution(2, 4)
+    g = dist.model_group
+    idxs = [g.group_idx_of(p) for p in range(4)]
+    assert idxs == [0, 1, 2, 3]
+    # data group: strided by modelParts
+    gd = dist.data_group
+    assert gd.group_idx_of(0) == 0 and gd.group_idx_of(4) == 1
+
+
+def test_indivisible_world_asserts(env):
+    from mlsl_tpu.log import MLSLError
+
+    with pytest.raises(MLSLError):
+        env.create_distribution(3, 1)  # 8 % 3 != 0

@@ -1,0 +1,111 @@
+"""Request engine tests: chunking, priority deferral, restart semantics, test()."""
+
+import numpy as np
+import pytest
+
+from mlsl_tpu.types import DataType, GroupType, ReductionType
+
+
+def test_restart_reuses_request(env):
+    dist = env.create_distribution(8, 1)
+    from mlsl_tpu.comm.request import CommDesc, CommRequest
+
+    req = CommRequest(
+        CommDesc("allreduce", dist.data_group, 4, DataType.FLOAT, op=ReductionType.SUM),
+        env.dispatcher,
+    )
+    req.setup()
+    for it in range(3):
+        buf = dist.make_buffer(lambda p: np.full(4, float(p + it)), 4)
+        req.start(buf)
+        out = req.wait()
+        expected = sum(float(p + it) for p in range(8))
+        np.testing.assert_allclose(dist.local_part(out, 0), np.full(4, expected))
+
+
+def test_priority_deferral_and_restart_supersede(env):
+    env.config.msg_priority = True
+    env.config.msg_priority_threshold = 0
+    try:
+        dist = env.create_distribution(8, 1)
+        from mlsl_tpu.comm.request import CommDesc, CommRequest
+
+        req = CommRequest(
+            CommDesc("allreduce", dist.data_group, 4, DataType.FLOAT, op=ReductionType.SUM),
+            env.dispatcher,
+        )
+        req.setup()
+        buf1 = dist.make_buffer(lambda p: np.full(4, 1.0), 4)
+        buf2 = dist.make_buffer(lambda p: np.full(4, 2.0), 4)
+        req.start(buf1)
+        assert len(env.dispatcher._pending) == 1
+        # Restart before any wait: the stale deferred entry must be superseded.
+        req.start(buf2)
+        assert len(env.dispatcher._pending) == 1
+        out = req.wait()
+        np.testing.assert_allclose(dist.local_part(out, 0), np.full(4, 16.0))
+        assert len(env.dispatcher._pending) == 0
+    finally:
+        env.config.msg_priority = False
+
+
+def test_priority_lifo_order(env):
+    env.config.msg_priority = True
+    env.config.msg_priority_threshold = 0
+    try:
+        dist = env.create_distribution(8, 1)
+        buf = dist.make_buffer(lambda p: np.full(4, float(p)), 4)
+        r1 = dist.all_reduce(buf, 4, DataType.FLOAT, ReductionType.SUM, GroupType.DATA)
+        r2 = dist.all_reduce(buf, 4, DataType.FLOAT, ReductionType.SUM, GroupType.DATA)
+        assert len(env.dispatcher._pending) == 2
+        out1 = env.wait(r1)  # flush dispatches LIFO; both results must be correct
+        out2 = env.wait(r2)
+        np.testing.assert_allclose(dist.local_part(out1, 0), np.full(4, 28.0))
+        np.testing.assert_allclose(dist.local_part(out2, 0), np.full(4, 28.0))
+    finally:
+        env.config.msg_priority = False
+
+
+def test_large_message_chunking(env):
+    env.config.large_msg_size_mb = 0  # force: any message above 0 MB is "large"
+    env.config.large_msg_size_mb = 1
+    env.config.large_msg_chunks = 4
+    n = 1024 * 1024  # 4 MiB fp32 > 1 MiB threshold
+    dist = env.create_distribution(8, 1)
+    buf = dist.make_buffer(lambda p: np.full(n, float(p)), n)
+    req = dist.all_reduce(buf, n, DataType.FLOAT, ReductionType.SUM, GroupType.DATA)
+    assert len(req._chunk_slices) == 4
+    out = env.wait(req)
+    np.testing.assert_allclose(dist.local_part(out, 3)[:5], np.full(5, 28.0))
+    np.testing.assert_allclose(dist.local_part(out, 3)[-5:], np.full(5, 28.0))
+
+
+def test_test_polling(env):
+    dist = env.create_distribution(8, 1)
+    buf = dist.make_buffer(lambda p: np.full(64, float(p)), 64)
+    req = dist.all_reduce(buf, 64, DataType.FLOAT, ReductionType.SUM, GroupType.DATA)
+    while True:
+        done, out = env.test(req)
+        if done:
+            break
+    np.testing.assert_allclose(dist.local_part(out, 0), np.full(64, 28.0))
+
+
+def test_double_pairing_rejected(env):
+    from mlsl_tpu.log import MLSLError
+    from mlsl_tpu.types import OpType
+
+    dist = env.create_distribution(2, 4)
+    s = env.create_session()
+    s.set_global_minibatch_size(8)
+
+    def mk_op():
+        r = s.create_operation_reg_info(OpType.CC)
+        r.add_input(16, 4)
+        r.add_output(16, 4)
+        return s.get_operation(s.add_operation(r, dist))
+
+    o1, o2, o3 = mk_op(), mk_op(), mk_op()
+    o1.set_next(o2, 0, 0)
+    with pytest.raises(MLSLError):
+        o3.set_next(o2, 0, 0)  # in2 already paired with out1
