@@ -1,0 +1,109 @@
+"""Headline benchmark: ResNet-50 data-parallel training step through the framework.
+
+BASELINE.md config 5 ("Caffe ResNet-50 data-parallel Session/Operation graph,
+per-layer grad sync"). The reference repo publishes no numbers (BASELINE.md), so the
+baseline is self-generated: the same model/batch trained by a single fused raw-JAX jit
+(loss+grad+SGD, no framework). vs_baseline = raw_step_time / framework_step_time —
+1.0 means the MLSL-style per-layer Start/Wait graph adds zero overhead over the best
+monolithic XLA program; >1.0 means we beat it.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+"""
+
+import argparse
+import json
+import statistics
+import sys
+import time
+
+import numpy as np
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--quick", action="store_true", help="tiny shapes (CI/CPU)")
+    ap.add_argument("--iters", type=int, default=20)
+    ap.add_argument("--warmup", type=int, default=5)
+    args = ap.parse_args()
+
+    import jax
+    import jax.numpy as jnp
+
+    import mlsl_tpu as mlsl
+    from mlsl_tpu.models import resnet
+    from mlsl_tpu.models.train import DataParallelTrainer
+
+    if args.quick:
+        batch, hw, classes = 8, 64, 10
+    else:
+        batch, hw, classes = 32, 224, 1000
+
+    n_dev = len(jax.devices())
+    env = mlsl.Environment.get_env().init()
+    dist = env.create_distribution(n_dev, 1)
+    sess = env.create_session()
+    sess.set_global_minibatch_size(batch)
+
+    params = resnet.init_resnet50(jax.random.PRNGKey(0), num_classes=classes)
+    trainer = DataParallelTrainer(
+        env, dist, sess, params,
+        resnet.loss_fn, resnet.layer_names(params), resnet.layer_subtree,
+        lr=0.05,
+    )
+
+    rng = np.random.default_rng(0)
+    x = rng.normal(size=(batch, hw, hw, 3)).astype(np.float32)
+    y = rng.integers(0, classes, size=(batch,)).astype(np.int32)
+    fw_batch = trainer.shard_batch(x, y)
+
+    # --- framework: steady-state throughput (chained steps, one final block) ---
+    for _ in range(args.warmup):
+        trainer.step(fw_batch)
+    jax.block_until_ready(trainer.params)
+    t0 = time.perf_counter()
+    for _ in range(args.iters):
+        trainer.step(fw_batch)
+    jax.block_until_ready(trainer.params)
+    fw_ms = (time.perf_counter() - t0) / args.iters * 1e3
+
+    # --- raw-JAX baseline: one fused jit, same math ---
+    lr, data_size = 0.05, dist.get_process_count_data()
+    mesh = dist.topology.mesh
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    raw_params = jax.device_put(params, NamedSharding(mesh, P()))
+    xb = jax.device_put(
+        jnp.asarray(x), NamedSharding(mesh, P(("replica", "data", "model")))
+    )
+    yb = jax.device_put(
+        jnp.asarray(y), NamedSharding(mesh, P(("replica", "data", "model")))
+    )
+
+    @jax.jit
+    def raw_step(p, bx, by):
+        loss, grads = jax.value_and_grad(resnet.loss_fn)(p, (bx, by))
+        return loss, jax.tree.map(lambda w, g: w - lr * g, p, grads)
+
+    for _ in range(args.warmup):
+        loss, raw_params = raw_step(raw_params, xb, yb)
+    jax.block_until_ready(raw_params)
+    t0 = time.perf_counter()
+    for _ in range(args.iters):
+        loss, raw_params = raw_step(raw_params, xb, yb)
+    jax.block_until_ready(raw_params)
+    raw_ms = (time.perf_counter() - t0) / args.iters * 1e3
+
+    print(
+        json.dumps(
+            {
+                "metric": "resnet50_dp_train_step_time",
+                "value": round(fw_ms, 3),
+                "unit": "ms",
+                "vs_baseline": round(raw_ms / fw_ms, 4),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

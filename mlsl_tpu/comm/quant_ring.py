@@ -1,0 +1,194 @@
+"""Int8-compressed ring collectives with error feedback.
+
+The TPU-native equivalent of the reference's quantized allreduce (eplib routes
+MPI_QUANT_OP allreduces through quantize -> reduce -> dequantize on the endpoint
+server, eplib/cqueue.c:1977-1994, with the int8 block transform + error-feedback diff
+buffer of quant/quant.c:153-211).
+
+Design: a ring reduce-scatter + ring all-gather built from ``lax.ppermute`` where every
+hop moves int8 payload + per-block f32 scales instead of f32 data — 4x less ICI
+traffic. Each hop dequantizes, accumulates, and requantizes (the reference's custom
+MPI reduction op does the same per pair). The client-side error-feedback residual is
+returned functionally: callers carry it between iterations
+(CommRequest holds it per request).
+
+Ring index math: rank p's travelling partial starts at chunk (p-1) mod G; after G-1
+hops it has accumulated all ranks' contributions for chunk p (MPI reduce-scatter
+placement). The all-gather phase then circulates each rank's owned chunk.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Callable, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+try:
+    from jax import shard_map as _shard_map
+except ImportError:  # pragma: no cover
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+from jax.sharding import PartitionSpec as P
+
+from mlsl_tpu.comm.mesh import ProcessGroup
+from mlsl_tpu.comm.collectives import _BUF_SPEC, _axis_sizes, sizes_prod
+from mlsl_tpu.log import mlsl_assert
+from mlsl_tpu.ops import quant_kernels as qk
+
+
+def _quant(x2d, use_pallas):
+    if use_pallas:
+        # non-jitted internals; we are already inside jit/shard_map
+        n, block = x2d.shape
+        return qk._quantize_pallas(x2d)
+    return qk.quantize_blocks_ref(x2d)
+
+
+def _dequant(q, s, use_pallas):
+    if use_pallas:
+        return qk._dequantize_pallas(q, s)
+    return qk.dequantize_blocks_ref(q, s)
+
+
+def _to_chunks(x, G, rc, chunk):
+    """(n_orig,) -> (G, chunk): slice j of the logical partition (length rc) sits at
+    the START of padded chunk j, so ring chunk ownership == MPI slice placement."""
+    xp = jnp.pad(x, (0, G * rc - x.shape[0]))
+    return jnp.pad(xp.reshape(G, rc), ((0, 0), (0, chunk - rc)))
+
+
+def _ring_body(x, err, *, axis, G, rc, chunk, block, n_orig, mode, use_pallas):
+    """Local body (inside shard_map). x: (n_orig,), err: (G*chunk,)."""
+    xq = _to_chunks(x.astype(jnp.float32), G, rc, chunk).reshape(-1) + err
+    # Entry quantization + error feedback (reference quant_quantize semantics).
+    q0, s0 = _quant(xq.reshape(-1, block), use_pallas)
+    xhat = _dequant(q0.reshape(-1, block), s0, use_pallas).reshape(-1)
+    new_err = xq - xhat
+    chunks = xhat.reshape(G, chunk)
+
+    me = lax.axis_index(axis)
+    perm = [(i, (i + 1) % G) for i in range(G)]
+
+    if G == 1:
+        result = xhat[:n_orig] if mode == "allreduce" else xhat[:rc]
+        return result, new_err
+
+    # --- phase 1: ring reduce-scatter (quantized wire) ---
+    partial = lax.dynamic_index_in_dim(chunks, (me - 1) % G, keepdims=False)
+
+    def rs_step(t, partial):
+        q, s = _quant(partial.reshape(-1, block), use_pallas)
+        q = lax.ppermute(q, axis, perm)
+        s = lax.ppermute(s, axis, perm)
+        received = _dequant(q.reshape(-1, block), s, use_pallas).reshape(-1)
+        local = lax.dynamic_index_in_dim(chunks, (me - 2 - t) % G, keepdims=False)
+        return received + local
+
+    partial = lax.fori_loop(0, G - 1, rs_step, partial)
+    # partial = fully reduced chunk `me`; its first rc elements are MPI slice `me`
+
+    if mode == "reduce_scatter":
+        return partial[:rc], new_err
+
+    # --- phase 2: ring all-gather (quantized wire) ---
+    qo, so = _quant(partial.reshape(-1, block), use_pallas)
+    own = _dequant(qo.reshape(-1, block), so, use_pallas).reshape(-1)
+    out = jnp.zeros((G, chunk), dtype=jnp.float32)
+    out = lax.dynamic_update_index_in_dim(out, own, me, axis=0)
+
+    def ag_step(k, carry):
+        out, q, s = carry
+        q = lax.ppermute(q, axis, perm)
+        s = lax.ppermute(s, axis, perm)
+        val = _dequant(q.reshape(-1, block), s, use_pallas).reshape(-1)
+        idx = (me - 1 - k) % G
+        return lax.dynamic_update_index_in_dim(out, val, idx, axis=0), q, s
+
+    out, _, _ = lax.fori_loop(0, G - 1, ag_step, (out, qo, so))
+    return out[:, :rc].reshape(-1)[:n_orig], new_err
+
+
+_cache: dict = {}
+
+
+def build_quantized_collective(
+    kind: str, group: ProcessGroup, count: int, block: int
+) -> Tuple[Callable, int]:
+    """-> (compiled fn (buf, err) -> (result, new_err), error-feedback length).
+
+    ``kind``: 'allreduce' or 'reduce_scatter' (SUM only — the reference's quantized
+    path is likewise allreduce-SUM, eplib/cqueue.c:1977-1994; callers must reject
+    other ops).
+    Single-axis groups use the compressed ring; degenerate/multi-axis groups fall back
+    to entry-quantization + psum (same numerics contract, uncompressed wire).
+    """
+    from mlsl_tpu.comm.collectives import _group_key
+
+    topo = group.topology
+    mesh = topo.mesh
+    sizes = _axis_sizes(mesh)
+    g = 1 if group.is_self else group.size
+    mlsl_assert(group.colors is None, "quantized collectives require axis-aligned groups")
+    use_pallas = mesh.devices.flat[0].platform == "tpu" and block % 128 == 0
+
+    # Per-rank logical slice rc, padded to the block/tile unit -> ring chunk.
+    if kind == "reduce_scatter":
+        mlsl_assert(count % g == 0, "reduce_scatter count %d %% group %d != 0", count, g)
+        rc = count // g
+    else:
+        rc = -(-count // g)
+    unit = block * (qk.ROW_TILE if use_pallas else 1)
+    chunk = -(-rc // unit) * unit
+    err_len = g * chunk
+
+    key = (kind, _group_key(group), count, block)
+    fn = _cache.get(key)
+    if fn is not None:
+        return fn, err_len
+
+    if g > 1 and len(group.axes) == 1:
+        body = functools.partial(
+            _ring_body,
+            axis=group.axes[0],
+            G=g,
+            rc=rc,
+            chunk=chunk,
+            block=block,
+            n_orig=count,
+            mode=kind,
+            use_pallas=use_pallas,
+        )
+    else:
+        def body(x, err, _axes=group.axes, _g=g):
+            xq = _to_chunks(x.astype(jnp.float32), _g, rc, chunk).reshape(-1) + err
+            q0, s0 = _quant(xq.reshape(-1, block), use_pallas)
+            xhat = _dequant(q0.reshape(-1, block), s0, use_pallas).reshape(-1)
+            new_err = xq - xhat
+            red = lax.psum(xhat, _axes) if _axes and _g > 1 else xhat
+            red_chunks = red.reshape(_g, chunk)
+            if kind == "reduce_scatter" and _g > 1:
+                from mlsl_tpu.comm.collectives import _group_rank
+
+                me = _group_rank(_axes, sizes)
+                mine = lax.dynamic_index_in_dim(red_chunks, me, axis=0, keepdims=False)
+                return mine[:rc], new_err
+            if kind == "reduce_scatter":
+                return red_chunks[0, :rc], new_err
+            return red_chunks[:, :rc].reshape(-1)[:count], new_err
+
+    def local_fn(x, e):
+        out, new_err = body(x.reshape(x.shape[3:]), e.reshape(e.shape[3:]))
+        return out[None, None, None], new_err[None, None, None]
+
+    sm = _shard_map(
+        local_fn,
+        mesh=mesh,
+        in_specs=(_BUF_SPEC, _BUF_SPEC),
+        out_specs=(_BUF_SPEC, _BUF_SPEC),
+    )
+    fn = jax.jit(sm)
+    _cache[key] = fn
+    return fn, err_len

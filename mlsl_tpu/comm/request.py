@@ -88,6 +88,8 @@ class CommRequest:
         self._concat_fn: Optional[Callable] = None
         self._results: List[jax.Array] = []
         self._result: Optional[jax.Array] = None
+        self._quant_fn: Optional[Callable] = None
+        self._err: Optional[jax.Array] = None  # quantization error-feedback state
         self.is_started = False
         self.is_setup = False
         self._epoch = 0
@@ -100,6 +102,24 @@ class CommRequest:
     def setup(self) -> None:
         """Build (and implicitly compile on first run) the collective programs."""
         d = self.desc
+        if d.compression == CompressionType.QUANTIZATION and d.kind in (
+            "allreduce",
+            "reduce_scatter",
+        ):
+            from mlsl_tpu.comm import quant_ring
+
+            mlsl_assert(
+                d.op in (None, ReductionType.SUM),
+                "quantized collectives support SUM only (got %s)",
+                d.op,
+            )
+            block = self.dispatcher.config.quant_block_elems
+            self._quant_fn, self._err_len = quant_ring.build_quantized_collective(
+                d.kind, d.group, d.count, block
+            )
+            self._chunk_slices = [slice(None)]
+            self.is_setup = True
+            return
         if d.kind == "barrier":
             self._fns = [collectives.build_barrier(d.group)]
             self._chunk_slices = [slice(None)]
@@ -158,6 +178,19 @@ class CommRequest:
 
     def _dispatch(self, buf: jax.Array) -> None:
         """Actually launch the XLA programs (called by the Dispatcher)."""
+        if self._quant_fn is not None:
+            if self._err is None:
+                topo = self.desc.group.topology
+                self._err = topo.shard_buffer(
+                    np.zeros(
+                        (topo.replica_count, topo.data_parts, topo.model_parts,
+                         self._err_len),
+                        dtype=np.float32,
+                    )
+                )
+            out, self._err = self._quant_fn(buf, self._err)
+            self._results = [out]
+            return
         if len(self._chunk_slices) == 1 and self._chunk_slices[0] == slice(None):
             self._results = [self._fns[0](buf)]
         else:

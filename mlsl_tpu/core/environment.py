@@ -203,6 +203,8 @@ class Environment:
 
     def set_quantization_params(self, params: QuantParams) -> None:
         self.quant_params = params
+        if self.config is not None and params.elem_in_block:
+            self.config.quant_block_elems = int(params.elem_in_block)
 
     def get_quantization_params(self) -> Optional[QuantParams]:
         return self.quant_params

@@ -1,0 +1,1 @@
+"""Model zoo: pure-JAX models whose training drives the Session/Operation graph."""

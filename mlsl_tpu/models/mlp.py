@@ -1,0 +1,28 @@
+"""Minimal MLP classifier — the small end-to-end test/dry-run model."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+LAYERS = ["l1", "l2"]
+
+
+def init(key, din=8, dh=16, dout=4):
+    k1, k2 = jax.random.split(key)
+    return {
+        "l1": {"w": jax.random.normal(k1, (din, dh)) * 0.3, "b": jnp.zeros((dh,))},
+        "l2": {"w": jax.random.normal(k2, (dh, dout)) * 0.3, "b": jnp.zeros((dout,))},
+    }
+
+
+def loss_fn(params, batch):
+    x, y = batch
+    h = jnp.tanh(x @ params["l1"]["w"] + params["l1"]["b"])
+    logits = h @ params["l2"]["w"] + params["l2"]["b"]
+    logp = jax.nn.log_softmax(logits)
+    return -jnp.mean(jnp.take_along_axis(logp, y[:, None], axis=1))
+
+
+def get_layer(params, name):
+    return params[name]

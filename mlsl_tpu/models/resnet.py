@@ -1,0 +1,143 @@
+"""ResNet-50 in pure JAX (no flax/haiku) — the flagship benchmark model.
+
+The reference's headline workload is Caffe ResNet-50 data-parallel training with
+per-layer gradient sync through the Session/Operation graph (BASELINE.json config 5).
+This is a from-scratch TPU-idiomatic implementation: NHWC layout (TPU-native),
+bfloat16 activations with float32 params, lax.conv_general_dilated on the MXU, and a
+flat per-layer parameter list that maps 1:1 onto MLSL Operations.
+
+Train-mode batch norm computes batch statistics on the local shard (per-device BN, the
+standard data-parallel practice; the reference likewise keeps BN local to each worker).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+Params = Dict[str, Any]
+
+STAGES = (3, 4, 6, 3)          # ResNet-50 bottleneck counts
+WIDTHS = (256, 512, 1024, 2048)
+
+
+def _conv_init(key, kh, kw, cin, cout):
+    fan_in = kh * kw * cin
+    std = np.sqrt(2.0 / fan_in)
+    return jax.random.normal(key, (kh, kw, cin, cout), jnp.float32) * std
+
+
+def _bn_init(c):
+    return {"scale": jnp.ones((c,), jnp.float32), "bias": jnp.zeros((c,), jnp.float32)}
+
+
+def init_resnet50(key, num_classes: int = 1000) -> Params:
+    keys = iter(jax.random.split(key, 128))
+    params: Params = {"stem": {"conv": _conv_init(next(keys), 7, 7, 3, 64), "bn": _bn_init(64)}}
+    cin = 64
+    for si, (blocks, width) in enumerate(zip(STAGES, WIDTHS)):
+        mid = width // 4
+        stage = []
+        for bi in range(blocks):
+            block = {
+                "conv1": _conv_init(next(keys), 1, 1, cin, mid),
+                "bn1": _bn_init(mid),
+                "conv2": _conv_init(next(keys), 3, 3, mid, mid),
+                "bn2": _bn_init(mid),
+                "conv3": _conv_init(next(keys), 1, 1, mid, width),
+                "bn3": _bn_init(width),
+            }
+            if bi == 0:
+                block["proj"] = _conv_init(next(keys), 1, 1, cin, width)
+                block["bn_proj"] = _bn_init(width)
+            stage.append(block)
+            cin = width
+        params[f"stage{si}"] = stage
+    params["fc"] = {
+        "w": jax.random.normal(next(keys), (2048, num_classes), jnp.float32) * 0.01,
+        "b": jnp.zeros((num_classes,), jnp.float32),
+    }
+    return params
+
+
+def _bn(x, p, eps=1e-5):
+    mean = jnp.mean(x, axis=(0, 1, 2), keepdims=True)
+    var = jnp.var(x, axis=(0, 1, 2), keepdims=True)
+    inv = lax.rsqrt(var + eps)
+    return (x - mean) * inv * p["scale"] + p["bias"]
+
+
+def _conv(x, w, stride=1):
+    return lax.conv_general_dilated(
+        x,
+        w.astype(x.dtype),
+        window_strides=(stride, stride),
+        padding="SAME",
+        dimension_numbers=("NHWC", "HWIO", "NHWC"),
+    )
+
+
+def _bottleneck(x, block, stride):
+    y = jax.nn.relu(_bn(_conv(x, block["conv1"]), block["bn1"]))
+    y = jax.nn.relu(_bn(_conv(y, block["conv2"], stride), block["bn2"]))
+    y = _bn(_conv(y, block["conv3"]), block["bn3"])
+    if "proj" in block:
+        x = _bn(_conv(x, block["proj"], stride), block["bn_proj"])
+    return jax.nn.relu(x + y)
+
+
+def apply_resnet50(params: Params, x: jax.Array) -> jax.Array:
+    """x: (N, H, W, 3) -> logits (N, num_classes). Compute in bf16, params f32."""
+    x = x.astype(jnp.bfloat16)
+    x = _conv(x, params["stem"]["conv"], stride=2)
+    x = jax.nn.relu(_bn(x, params["stem"]["bn"]))
+    x = lax.reduce_window(
+        x, -jnp.inf, lax.max, (1, 3, 3, 1), (1, 2, 2, 1), "SAME"
+    )
+    for si, blocks in enumerate(STAGES):
+        stage = params[f"stage{si}"]
+        for bi in range(blocks):
+            stride = 2 if (bi == 0 and si > 0) else 1
+            x = _bottleneck(x, stage[bi], stride)
+    x = jnp.mean(x, axis=(1, 2)).astype(jnp.float32)
+    return x @ params["fc"]["w"] + params["fc"]["b"]
+
+
+def loss_fn(params: Params, batch: Tuple[jax.Array, jax.Array]) -> jax.Array:
+    x, labels = batch
+    logits = apply_resnet50(params, x)
+    logp = jax.nn.log_softmax(logits)
+    return -jnp.mean(jnp.take_along_axis(logp, labels[:, None], axis=1))
+
+
+def layer_names(params: Params) -> List[str]:
+    """Flat per-layer names in forward order — one MLSL Operation per entry."""
+    names = ["stem"]
+    for si, blocks in enumerate(STAGES):
+        names += [f"stage{si}.{bi}" for bi in range(blocks)]
+    names.append("fc")
+    return names
+
+
+def layer_param_counts(params: Params) -> Dict[str, int]:
+    """name -> total parameter element count (the Operation's kernel count)."""
+    counts = {}
+    counts["stem"] = sum(int(np.prod(l.shape)) for l in jax.tree.leaves(params["stem"]))
+    for si, blocks in enumerate(STAGES):
+        for bi in range(blocks):
+            counts[f"stage{si}.{bi}"] = sum(
+                int(np.prod(l.shape)) for l in jax.tree.leaves(params[f"stage{si}"][bi])
+            )
+    counts["fc"] = sum(int(np.prod(l.shape)) for l in jax.tree.leaves(params["fc"]))
+    return counts
+
+
+def layer_subtree(params: Params, name: str):
+    if name in ("stem", "fc"):
+        return params[name]
+    stage, block = name.split(".")
+    return params[stage][int(block)]

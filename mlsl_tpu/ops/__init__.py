@@ -1,0 +1,1 @@
+"""Device kernels: Pallas TPU implementations of the hot non-matmul ops."""

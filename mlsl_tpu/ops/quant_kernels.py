@@ -1,0 +1,135 @@
+"""Blockwise int8 quantization kernels (Pallas on TPU, jnp reference elsewhere).
+
+TPU-native replacement for the reference's dlopen'd quantization library
+(quant/quant.c:153-211): elements are grouped into fixed-size blocks; each block is
+scaled by max|x|/127 and rounded to int8; dequantization multiplies back. The
+error-feedback ("diff") buffer semantics of the reference — the residual x - deq(q(x))
+is carried to the next iteration — are implemented by the caller
+(mlsl_tpu.comm.quant_ring) because JAX state is functional.
+
+The Pallas kernel fuses scale computation + clip/round in one VMEM pass (the reference
+does the same transform scalar-at-a-time on the endpoint server CPU). Layout: blocks
+are rows of a (n_blocks, block) matrix; block must be a multiple of 128 lanes; rows are
+tiled in groups of 32 to satisfy the int8 (32, 128) min tile.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+ROW_TILE = 32  # int8 min sublane count
+
+
+def _on_tpu() -> bool:
+    try:
+        return jax.default_backend() == "tpu"
+    except Exception:  # pragma: no cover
+        return False
+
+
+# -- reference (jnp) implementation: the semantic oracle ---------------------
+
+
+def quantize_blocks_ref(x2d: jax.Array):
+    """(n_blocks, block) f32 -> (int8 q, f32 scales (n_blocks,))."""
+    amax = jnp.max(jnp.abs(x2d), axis=1)
+    scale = jnp.where(amax == 0, 1.0, amax / 127.0).astype(jnp.float32)
+    q = jnp.clip(
+        jnp.round(x2d / scale[:, None]), -127, 127
+    ).astype(jnp.int8)
+    return q, scale
+
+
+def dequantize_blocks_ref(q2d: jax.Array, scales: jax.Array) -> jax.Array:
+    return q2d.astype(jnp.float32) * scales[:, None]
+
+
+# -- pallas kernels -----------------------------------------------------------
+
+
+def _quant_kernel(x_ref, q_ref, s_ref):
+    x = x_ref[:]
+    amax = jnp.max(jnp.abs(x), axis=1, keepdims=True)          # (ROW_TILE, 1)
+    scale = jnp.where(amax == 0.0, 1.0, amax / 127.0)
+    q = jnp.clip(jnp.round(x / scale), -127, 127).astype(jnp.int8)
+    q_ref[:] = q
+    s_ref[:] = jnp.broadcast_to(scale, s_ref.shape)
+
+
+def _dequant_kernel(q_ref, s_ref, x_ref):
+    x_ref[:] = q_ref[:].astype(jnp.float32) * s_ref[:, :1]
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def _quantize_pallas(x2d, interpret=False):
+    n, block = x2d.shape
+    grid = (n // ROW_TILE,)
+    q, s = pl.pallas_call(
+        _quant_kernel,
+        grid=grid,
+        in_specs=[pl.BlockSpec((ROW_TILE, block), lambda i: (i, 0))],
+        out_specs=[
+            pl.BlockSpec((ROW_TILE, block), lambda i: (i, 0)),
+            pl.BlockSpec((ROW_TILE, 128), lambda i: (i, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((n, block), jnp.int8),
+            jax.ShapeDtypeStruct((n, 128), jnp.float32),
+        ],
+        interpret=interpret,
+    )(x2d)
+    return q, s[:, 0]
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def _dequantize_pallas(q2d, scales, interpret=False):
+    n, block = q2d.shape
+    s128 = jnp.broadcast_to(scales[:, None], (n, 128))
+    return pl.pallas_call(
+        _dequant_kernel,
+        grid=(n // ROW_TILE,),
+        in_specs=[
+            pl.BlockSpec((ROW_TILE, block), lambda i: (i, 0)),
+            pl.BlockSpec((ROW_TILE, 128), lambda i: (i, 0)),
+        ],
+        out_specs=pl.BlockSpec((ROW_TILE, block), lambda i: (i, 0)),
+        out_shape=jax.ShapeDtypeStruct((n, block), jnp.float32),
+        interpret=interpret,
+    )(q2d, s128)
+
+
+# -- public API: pads to tile geometry, picks backend -------------------------
+
+
+def quantize(x: jax.Array, block: int = 256, use_pallas: bool | None = None):
+    """1-D f32 -> (q int8 (padded n,), scales f32, orig_len). Pads to block*ROW_TILE."""
+    n = x.shape[0]
+    n_pad = -(-n // (block * ROW_TILE)) * (block * ROW_TILE)
+    xp = jnp.pad(x.astype(jnp.float32), (0, n_pad - n))
+    x2d = xp.reshape(-1, block)
+    if use_pallas is None:
+        use_pallas = _on_tpu() and block % 128 == 0
+    if use_pallas:
+        q, s = _quantize_pallas(x2d)
+    else:
+        q, s = quantize_blocks_ref(x2d)
+    return q.reshape(-1), s, n
+
+
+def dequantize(q: jax.Array, scales: jax.Array, block: int = 256, orig_len=None,
+               use_pallas: bool | None = None) -> jax.Array:
+    q2d = q.reshape(-1, block)
+    if use_pallas is None:
+        use_pallas = _on_tpu() and block % 128 == 0
+    if use_pallas:
+        x = _dequantize_pallas(q2d, scales)
+    else:
+        x = dequantize_blocks_ref(q2d, scales)
+    x = x.reshape(-1)
+    return x if orig_len is None else x[:orig_len]
