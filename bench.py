@@ -73,10 +73,10 @@ def main():
 
     raw_params = jax.device_put(params, NamedSharding(mesh, P()))
     xb = jax.device_put(
-        jnp.asarray(x), NamedSharding(mesh, P(("replica", "data", "model")))
+        jnp.asarray(x), NamedSharding(mesh, P(("replica", "data", "seq", "model")))
     )
     yb = jax.device_put(
-        jnp.asarray(y), NamedSharding(mesh, P(("replica", "data", "model")))
+        jnp.asarray(y), NamedSharding(mesh, P(("replica", "data", "seq", "model")))
     )
 
     @jax.jit

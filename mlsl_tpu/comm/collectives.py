@@ -3,8 +3,9 @@
 This replaces the reference's per-backend collective dispatch (src/comm_ep.cpp:768-1378,
 src/comm_handoff.cpp:491-564). Design:
 
-- A "distributed buffer" is one global jax.Array of shape (R, D, M, n): the (r, d, m)
-  slice is rank (r,d,m)'s local buffer (what each MPI rank would hold). Collectives are
+- A "distributed buffer" is one global jax.Array of shape (R, D, S, M, n): the
+  (r, d, s, m) slice is that rank's local buffer (what each MPI rank would hold;
+  S = sequence-parallel axis, 1 unless seq_parts is used). Collectives are
   pure functions global-buffer -> global-buffer, built with ``shard_map`` so XLA sees
   the per-device program and lowers group operations onto ICI collectives.
 
@@ -38,12 +39,12 @@ try:  # JAX >= 0.4.35 exposes shard_map at top level
 except ImportError:  # pragma: no cover
     from jax.experimental.shard_map import shard_map as _shard_map
 
-from mlsl_tpu.comm.mesh import ProcessGroup, REPLICA_AXIS, DATA_AXIS, MODEL_AXIS
+from mlsl_tpu.comm.mesh import GRID_AXES, NUM_GRID_AXES, ProcessGroup
 from mlsl_tpu.log import mlsl_assert
 from mlsl_tpu.types import ReductionType
 
-ALL_AXES = (REPLICA_AXIS, DATA_AXIS, MODEL_AXIS)
-_BUF_SPEC = P(REPLICA_AXIS, DATA_AXIS, MODEL_AXIS, None)
+ALL_AXES = GRID_AXES
+_BUF_SPEC = P(*GRID_AXES, None)
 
 
 def _axis_sizes(mesh) -> dict:
@@ -312,9 +313,9 @@ def build_collective(kind: str, group: ProcessGroup, dtype, **kw) -> Callable:
         raw = _AXIS_BODIES[kind]
         body = functools.partial(raw, axes=group.axes, sizes=sizes, **kw)
 
-    def local_fn(x):  # x: (1, 1, 1, n)
-        out = body(x.reshape(x.shape[3:] or (1,)) if x.ndim == 4 else x)
-        return out[None, None, None]
+    def local_fn(x):  # x: (1, 1, 1, 1, n)
+        out = body(x.reshape(x.shape[NUM_GRID_AXES:]))
+        return out[None, None, None, None]
 
     sm = _shard_map(local_fn, mesh=mesh, in_specs=_BUF_SPEC, out_specs=_BUF_SPEC)
     fn = jax.jit(sm)
@@ -334,11 +335,11 @@ def build_barrier(group: ProcessGroup) -> Callable:
             axes = group.axes
 
         def local_fn(x):
-            return lax.psum(x, axes)[None, None, None]
+            return lax.psum(x, axes)[None, None, None, None]
 
         topo = group.topology
         sm = _shard_map(
-            lambda x: local_fn(x.reshape(x.shape[3:])),
+            lambda x: local_fn(x.reshape(x.shape[NUM_GRID_AXES:])),
             mesh=topo.mesh,
             in_specs=_BUF_SPEC,
             out_specs=_BUF_SPEC,

@@ -2,16 +2,19 @@
 
 Replaces the reference's ProcessGroup-over-MPI_Comm (src/comm.hpp:33-46, backend
 ProcessGroupImpl src/comm_ep.cpp:144-200): the "world" is the set of JAX devices,
-arranged as a ``jax.sharding.Mesh`` of shape (replica, data, model). A ProcessGroup is a
+arranged as a ``jax.sharding.Mesh`` of shape (replica, data, seq, model). A ProcessGroup is a
 *descriptor* — either an axis-aligned subgroup (named mesh axes, the fast path: XLA
 collectives ride ICI rings directly) or a color partition (arbitrary subgroups, the
 analog of MPI_Comm_split color, reference src/mlsl.cpp:620-647), executed via a
 gather+mask fallback.
 
-Rank layout matches the reference grid math (src/mlsl_impl.hpp:224-266):
-    global rank p  =  replicaIdx * (D*M) + dataIdx * M + modelIdx
-i.e. the model axis is minor (consecutive ranks form a model group), the data axis is
-strided by M, replicas are outermost blocks.
+Rank layout matches the reference grid math (src/mlsl_impl.hpp:224-266), extended with
+a sequence axis (absent in the 2016-era reference; SURVEY.md §5.7 prescribes exposing
+sequence sharding as just another grid axis):
+    global rank p  =  ((replicaIdx * D + dataIdx) * S + seqIdx) * M + modelIdx
+i.e. the model axis is minor (consecutive ranks form a model group), then sequence,
+then data, replicas outermost. With S = 1 this reduces exactly to the reference's
+layout.
 """
 
 from __future__ import annotations
@@ -27,11 +30,14 @@ from mlsl_tpu.log import mlsl_assert
 
 REPLICA_AXIS = "replica"
 DATA_AXIS = "data"
+SEQ_AXIS = "seq"
 MODEL_AXIS = "model"
+GRID_AXES = (REPLICA_AXIS, DATA_AXIS, SEQ_AXIS, MODEL_AXIS)
+NUM_GRID_AXES = len(GRID_AXES)
 
 
 class Topology:
-    """The device world arranged as a (replica, data, model) mesh.
+    """The device world arranged as a (replica, data, seq, model) mesh.
 
     One Topology per (Environment, Distribution-shape). The mesh is built so that the
     flattened device order follows the reference's rank layout; group indices derived
@@ -43,62 +49,69 @@ class Topology:
         data_parts: int,
         model_parts: int,
         devices: Optional[Sequence[jax.Device]] = None,
+        seq_parts: int = 1,
     ):
         if devices is None:
             devices = jax.devices()
         n = len(devices)
         mlsl_assert(
-            data_parts > 0 and model_parts > 0,
-            "numbers for data and model groups must be positive",
+            data_parts > 0 and model_parts > 0 and seq_parts > 0,
+            "numbers for data/model/seq groups must be positive",
         )
-        l_size = data_parts * model_parts
+        l_size = data_parts * model_parts * seq_parts
         mlsl_assert(
             n % l_size == 0,
-            "device count %d not divisible by dataParts*modelParts %d",
+            "device count %d not divisible by dataParts*seqParts*modelParts %d",
             n,
             l_size,
         )
         self.data_parts = data_parts
         self.model_parts = model_parts
+        self.seq_parts = seq_parts
         self.replica_count = n // l_size
         self.world_size = n
         dev_array = np.array(list(devices), dtype=object).reshape(
-            self.replica_count, data_parts, model_parts
+            self.replica_count, data_parts, seq_parts, model_parts
         )
-        self.mesh = Mesh(dev_array, (REPLICA_AXIS, DATA_AXIS, MODEL_AXIS))
+        self.mesh = Mesh(dev_array, GRID_AXES)
 
     # -- rank <-> coordinate math (reference src/mlsl_impl.hpp:224-240) --
 
-    def coords(self, global_idx: int) -> Tuple[int, int, int]:
-        """global rank -> (replicaIdx, dataIdx, modelIdx)."""
-        l_size = self.data_parts * self.model_parts
+    def coords(self, global_idx: int) -> Tuple[int, int, int, int]:
+        """global rank -> (replicaIdx, dataIdx, seqIdx, modelIdx)."""
+        l_size = self.data_parts * self.seq_parts * self.model_parts
         l_id = global_idx % l_size
-        return (global_idx // l_size, l_id // self.model_parts, l_id % self.model_parts)
+        m = l_id % self.model_parts
+        s = (l_id // self.model_parts) % self.seq_parts
+        d = l_id // (self.model_parts * self.seq_parts)
+        return (global_idx // l_size, d, s, m)
 
-    def global_idx(self, replica: int, data: int, model: int) -> int:
-        return (replica * self.data_parts + data) * self.model_parts + model
+    def global_idx(self, replica: int, data: int, seq: int, model: int) -> int:
+        return (
+            (replica * self.data_parts + data) * self.seq_parts + seq
+        ) * self.model_parts + model
+
+    @property
+    def grid_shape(self) -> Tuple[int, int, int, int]:
+        return (self.replica_count, self.data_parts, self.seq_parts, self.model_parts)
 
     def buffer_sharding(self, extra_dims: int = 1) -> NamedSharding:
         """Sharding for a 'distributed buffer': global shape
-        (replica, data, model, *local_shape), one local payload per rank."""
-        spec = P(REPLICA_AXIS, DATA_AXIS, MODEL_AXIS, *([None] * extra_dims))
+        (replica, data, seq, model, *local_shape), one local payload per rank."""
+        spec = P(*GRID_AXES, *([None] * extra_dims))
         return NamedSharding(self.mesh, spec)
 
     def shard_buffer(self, array) -> jax.Array:
-        """Place a host array of shape (R, D, M, ...) so that element [r, d, m] lives on
-        the device with those mesh coordinates."""
+        """Place a host array of shape (R, D, S, M, ...) so that element [r, d, s, m]
+        lives on the device with those mesh coordinates."""
         mlsl_assert(
-            array.ndim >= 4
-            and array.shape[0] == self.replica_count
-            and array.shape[1] == self.data_parts
-            and array.shape[2] == self.model_parts,
-            "buffer must have shape (R=%d, D=%d, M=%d, ...), got %s",
-            self.replica_count,
-            self.data_parts,
-            self.model_parts,
+            array.ndim >= NUM_GRID_AXES + 1
+            and array.shape[: NUM_GRID_AXES] == self.grid_shape,
+            "buffer must have shape (R=%d, D=%d, S=%d, M=%d, ...), got %s",
+            *self.grid_shape,
             array.shape,
         )
-        return jax.device_put(array, self.buffer_sharding(array.ndim - 3))
+        return jax.device_put(array, self.buffer_sharding(array.ndim - NUM_GRID_AXES))
 
 
 @dataclasses.dataclass(frozen=True)
@@ -116,7 +129,7 @@ class ProcessGroup:
     """
 
     topology: Topology
-    axes: Tuple[str, ...]  # subset of (replica, data, model); () = self group
+    axes: Tuple[str, ...]  # subset of GRID_AXES (replica, data, seq, model); () = self
     colors: Optional[Tuple[int, ...]] = None
 
     def __post_init__(self):
@@ -165,8 +178,8 @@ class ProcessGroup:
             return self.member_world_ranks(self.colors[global_idx]).index(global_idx)
         if not self.axes:
             return 0
-        r, d, m = self.topology.coords(global_idx)
-        coord = {REPLICA_AXIS: r, DATA_AXIS: d, MODEL_AXIS: m}
+        r, d, s, m = self.topology.coords(global_idx)
+        coord = {REPLICA_AXIS: r, DATA_AXIS: d, SEQ_AXIS: s, MODEL_AXIS: m}
         shape = dict(
             zip(self.topology.mesh.axis_names, self.topology.mesh.devices.shape)
         )

@@ -33,7 +33,7 @@ except ImportError:  # pragma: no cover
 
 from jax.sharding import PartitionSpec as P
 
-from mlsl_tpu.comm.mesh import ProcessGroup
+from mlsl_tpu.comm.mesh import NUM_GRID_AXES, ProcessGroup
 from mlsl_tpu.comm.collectives import _BUF_SPEC, _axis_sizes, sizes_prod
 from mlsl_tpu.log import mlsl_assert
 from mlsl_tpu.ops import quant_kernels as qk
@@ -180,8 +180,10 @@ def build_quantized_collective(
             return red_chunks[:, :rc].reshape(-1)[:count], new_err
 
     def local_fn(x, e):
-        out, new_err = body(x.reshape(x.shape[3:]), e.reshape(e.shape[3:]))
-        return out[None, None, None], new_err[None, None, None]
+        out, new_err = body(
+            x.reshape(x.shape[NUM_GRID_AXES:]), e.reshape(e.shape[NUM_GRID_AXES:])
+        )
+        return out[None, None, None, None], new_err[None, None, None, None]
 
     sm = _shard_map(
         local_fn,

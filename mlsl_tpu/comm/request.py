@@ -182,11 +182,7 @@ class CommRequest:
             if self._err is None:
                 topo = self.desc.group.topology
                 self._err = topo.shard_buffer(
-                    np.zeros(
-                        (topo.replica_count, topo.data_parts, topo.model_parts,
-                         self._err_len),
-                        dtype=np.float32,
-                    )
+                    np.zeros((*topo.grid_shape, self._err_len), dtype=np.float32)
                 )
             out, self._err = self._quant_fn(buf, self._err)
             self._results = [out]

@@ -12,7 +12,7 @@ so the model axis is minor. On TPU the grid IS a jax.sharding.Mesh of shape
 (replica, data, model); subgroup collectives lower onto the ICI rings of the named axes.
 
 Buffers: each collective takes a "distributed buffer" — a global jax.Array of shape
-(R, D, M, n) whose (r, d, m) slice is that rank's local buffer — and returns a
+(R, D, S, M, n) whose (r, d, s, m) slice is that rank's local buffer — and returns a
 CommRequest already started (the reference returns CommReq* from each call too,
 completed via Environment.Wait/Test). Helpers shard_buffer/make_buffer build them.
 """
@@ -25,10 +25,12 @@ import numpy as np
 import jax
 
 from mlsl_tpu.comm.mesh import (
+    GRID_AXES,
     Topology,
     ProcessGroup,
     REPLICA_AXIS,
     DATA_AXIS,
+    SEQ_AXIS,
     MODEL_AXIS,
 )
 from mlsl_tpu.comm.request import CommDesc, CommRequest, ComputeType
@@ -45,6 +47,7 @@ class Distribution:
         devices: Sequence[jax.Device],
         data_colors: Optional[Tuple[int, ...]] = None,
         model_colors: Optional[Tuple[int, ...]] = None,
+        seq_parts: int = 1,
     ):
         self.env = env
         self._colors_mode = data_colors is not None
@@ -68,25 +71,28 @@ class Distribution:
             )
             self.data_parts = d_sizes.pop()
             self.model_parts = m_sizes.pop()
-            # The mesh is flat (1, 1, N); groups are pure color partitions.
+            self.seq_parts = 1
+            # The mesh is flat (N, 1, 1, 1); groups are pure color partitions.
             self.topology = Topology(1, 1, devices=devices)
-            # Note: Topology(1,1) gives mesh (N,1,1) since replica absorbs the rest.
             self.data_group = ProcessGroup(self.topology, (), colors=tuple(data_colors))
             self.model_group = ProcessGroup(
                 self.topology, (), colors=tuple(model_colors)
             )
-            self.global_group = ProcessGroup(
-                self.topology, (REPLICA_AXIS, DATA_AXIS, MODEL_AXIS)
-            )
+            self.seq_group = ProcessGroup(self.topology, ())
+            self.global_group = ProcessGroup(self.topology, GRID_AXES)
+            self.grad_group = self.data_group
             # Logical replica count is 1 in colors mode (reference
             # src/mlsl_impl.hpp:268-273); the Topology's (N,1,1) mesh shape is a
             # storage layout, not a replica structure — size buffers via
             # world_shape/make_buffer, never from replica_count.
             self.replica_count = 1
         else:
-            self.topology = Topology(data_parts, model_parts, devices=devices)
+            self.topology = Topology(
+                data_parts, model_parts, devices=devices, seq_parts=seq_parts
+            )
             self.data_parts = data_parts
             self.model_parts = model_parts
+            self.seq_parts = seq_parts
             self.replica_count = self.topology.replica_count
             self.data_group = (
                 ProcessGroup(self.topology, (DATA_AXIS,))
@@ -98,9 +104,20 @@ class Distribution:
                 if model_parts > 1
                 else ProcessGroup(self.topology, ())
             )
-            self.global_group = ProcessGroup(
-                self.topology, (REPLICA_AXIS, DATA_AXIS, MODEL_AXIS)
+            self.seq_group = (
+                ProcessGroup(self.topology, (SEQ_AXIS,))
+                if seq_parts > 1
+                else ProcessGroup(self.topology, ())
             )
+            self.global_group = ProcessGroup(self.topology, GRID_AXES)
+            # Parameter gradients sum over BOTH batch shards and sequence shards
+            # (sequence parallelism looks like data parallelism to the parameters).
+            grad_axes = tuple(
+                a
+                for a, n in ((DATA_AXIS, data_parts), (SEQ_AXIS, seq_parts))
+                if n > 1
+            )
+            self.grad_group = ProcessGroup(self.topology, grad_axes)
         self._self_group = ProcessGroup(self.topology, ())
 
     # -- introspection (reference include/mlsl.hpp:360-373) ---------------
@@ -111,6 +128,8 @@ class Distribution:
             return self.data_group
         if gt == GroupType.MODEL:
             return self.model_group
+        if gt == GroupType.SEQ:
+            return self.seq_group
         return self.global_group
 
     def get_process_count(self, group_type: GroupType) -> int:
@@ -139,32 +158,32 @@ class Distribution:
     def get_model_parts(self) -> int:
         return self.model_parts
 
+    def get_seq_parts(self) -> int:
+        return self.seq_parts
+
     # -- buffer helpers ----------------------------------------------------
 
     @property
-    def world_shape(self) -> Tuple[int, int, int]:
-        return (
-            self.topology.replica_count,
-            self.topology.data_parts,
-            self.topology.model_parts,
-        )
+    def world_shape(self) -> Tuple[int, int, int, int]:
+        return self.topology.grid_shape
 
     def make_buffer(self, per_rank_fn, count: int, data_type=DataType.FLOAT):
         """Build a distributed buffer from a function global_rank -> np.ndarray(count)."""
-        r, d, m = self.world_shape
+        shape = self.world_shape
+        n = int(np.prod(shape))
         buf = np.stack(
-            [per_rank_fn(p) for p in range(r * d * m)], axis=0
-        ).reshape(r, d, m, count).astype(jnp_dtype(data_type))
+            [per_rank_fn(p) for p in range(n)], axis=0
+        ).reshape(*shape, count).astype(jnp_dtype(data_type))
         return self.topology.shard_buffer(buf)
 
     def shard_buffer(self, array) -> jax.Array:
-        """Place an (R, D, M, ...) host array onto the mesh."""
+        """Place an (R, D, S, M, ...) host array onto the mesh."""
         return self.topology.shard_buffer(np.asarray(array))
 
     def local_part(self, buf, global_idx: int):
         """Rank-local slice of a distributed buffer (host-side, for tests/inspection)."""
-        r, d, m = self.topology.coords(global_idx)
-        return np.asarray(buf)[r, d, m]
+        r, d, s, m = self.topology.coords(global_idx)
+        return np.asarray(buf)[r, d, s, m]
 
     # -- collectives (reference include/mlsl.hpp:375-503) -----------------
 
@@ -332,8 +351,9 @@ class Distribution:
             CommDesc("barrier", g, 1, DataType.FLOAT), self.env.dispatcher
         )
         req.setup()
-        r, d, m = self.world_shape
-        token = self.topology.shard_buffer(np.ones((r, d, m, 1), dtype=np.float32))
+        token = self.topology.shard_buffer(
+            np.ones((*self.world_shape, 1), dtype=np.float32)
+        )
         req.start(token)
         req.wait()
 

@@ -133,12 +133,17 @@ class Environment:
         data_parts: int,
         model_parts: int,
         devices: Optional[Sequence[jax.Device]] = None,
+        seq_parts: int = 1,
     ):
         from mlsl_tpu.core.distribution import Distribution
 
         mlsl_assert(self._initialized, "Environment not initialized")
         d = Distribution(
-            self, data_parts, model_parts, devices=devices or self.devices
+            self,
+            data_parts,
+            model_parts,
+            devices=devices or self.devices,
+            seq_parts=seq_parts,
         )
         self._distributions.append(d)
         return d

@@ -36,7 +36,10 @@ class ParameterSet:
         self.global_kernel_count = reg.count
 
         model_size = self.dist.get_process_count_model()
-        data_size = self.dist.get_process_count_data()
+        # Gradient reduction spans data AND sequence shards (sequence parallelism is
+        # data parallelism from the parameters' point of view).
+        grad_group = self.dist.grad_group
+        data_size = 1 if grad_group.is_self else grad_group.size
         mlsl_assert(
             self.global_kernel_count % model_size == 0,
             "kernel count %d not divisible by model parts %d",
@@ -64,7 +67,7 @@ class ParameterSet:
                 self.grad_req = CommRequest(
                     CommDesc(
                         "reduce_scatter",
-                        self.dist.data_group,
+                        self.dist.grad_group,
                         n_owned * data_size,
                         self.data_type,
                         compute_type=ComputeType.PARAM_GRAD,
@@ -77,7 +80,7 @@ class ParameterSet:
                 self.inc_req = CommRequest(
                     CommDesc(
                         "allgather",
-                        self.dist.data_group,
+                        self.dist.grad_group,
                         n_owned,
                         self.data_type,
                         compute_type=ComputeType.PARAM_INC,
@@ -89,7 +92,7 @@ class ParameterSet:
                 self.grad_req = CommRequest(
                     CommDesc(
                         "allreduce",
-                        self.dist.data_group,
+                        self.dist.grad_group,
                         n_owned,
                         self.data_type,
                         compute_type=ComputeType.PARAM_GRAD,
@@ -132,7 +135,7 @@ class ParameterSet:
 
     def start_gradient_comm(self, grad_buf) -> None:
         """Dispatch the gradient collective. grad_buf: distributed buffer of shape
-        (R, D, M, localKernelCount*kernelSize)."""
+        (R, D, S, M, localKernelCount*kernelSize)."""
         self.op.session._stat_event(self, "start", is_param=True)
         if self.need_comm:
             self.grad_req.start(grad_buf)

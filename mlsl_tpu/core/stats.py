@@ -229,13 +229,8 @@ def isolation_time_request(req) -> Tuple[int, int]:
     """(per-iteration ns, payload bytes) for one request, measured in isolation."""
     d = req.desc
     topo = d.group.topology
-    r, dd, m = (
-        topo.replica_count,
-        topo.data_parts,
-        topo.model_parts,
-    )
     buf = topo.shard_buffer(
-        np.zeros((r, dd, m, d.count), dtype=jnp_dtype(d.data_type))
+        np.zeros((*topo.grid_shape, d.count), dtype=jnp_dtype(d.data_type))
     )
     times = []
     for i in range(ISOLATION_ITERS):

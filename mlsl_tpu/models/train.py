@@ -12,7 +12,7 @@ way:
 - WaitGradientComm + a jitted update apply SGD, with the distributed-update
   (ReduceScatter / local update / AllGather-increment) path supported per layer.
 
-Gradients cross the framework boundary as distributed buffers (R, D, M, count): the
+Gradients cross the framework boundary as distributed buffers (R, D, S, M, count): the
 device-local flattened layer gradient is the shard — no host round-trips in the loop.
 """
 
@@ -32,7 +32,13 @@ except ImportError:  # pragma: no cover
     from jax.experimental.shard_map import shard_map as _shard_map_raw
 
 from mlsl_tpu.comm.collectives import _BUF_SPEC
-from mlsl_tpu.comm.mesh import DATA_AXIS, MODEL_AXIS, REPLICA_AXIS
+from mlsl_tpu.comm.mesh import (
+    DATA_AXIS,
+    MODEL_AXIS,
+    NUM_GRID_AXES,
+    REPLICA_AXIS,
+    SEQ_AXIS,
+)
 from mlsl_tpu.log import mlsl_assert
 from mlsl_tpu.types import CompressionType, DataType, OpType
 
@@ -97,14 +103,20 @@ class DataParallelTrainer:
         self.get_layer = get_layer
         self.lr = lr
         self.mesh = dist.topology.mesh
-        self.data_size = dist.get_process_count_data()
+        # Normalizer must match the reduction group (grad_group = data x seq); this
+        # trainer only shards the batch, so it requires seq_parts == 1 and the two
+        # coincide (HybridTrainer handles sequence-parallel grids).
         mlsl_assert(
-            dist.get_process_count_model() == 1 and dist.replica_count == 1,
-            "DataParallelTrainer requires model_parts == 1 and replica_count == 1 "
-            "(got model=%d, replicas=%d): replicas would train unsynced",
+            dist.get_process_count_model() == 1
+            and dist.replica_count == 1
+            and dist.get_seq_parts() == 1,
+            "DataParallelTrainer requires model=seq=1 and replica_count == 1 "
+            "(got model=%d, seq=%d, replicas=%d)",
             dist.get_process_count_model(),
+            dist.get_seq_parts(),
             dist.replica_count,
         )
+        self.data_size = dist.get_process_count_data()
 
         # Register one Operation per layer (reference per-layer Caffe graph).
         self.ops = {}
@@ -158,15 +170,15 @@ class DataParallelTrainer:
             # per-device: local-batch loss -> local grads (NO cross-device sync here;
             # the MLSL requests own the reduction)
             x, y = batch
-            x = x.reshape(x.shape[3:])  # strip (1,1,1) block dims
-            y = y.reshape(y.shape[3:])
+            x = x.reshape(x.shape[NUM_GRID_AXES:])  # strip grid block dims
+            y = y.reshape(y.shape[NUM_GRID_AXES:])
             loss, grads = jax.value_and_grad(loss_fn)(params, (x, y))
             flat = {}
             for name in layers:
                 g = _flatten_layer(get_layer(grads, name))
                 g = jnp.pad(g, (0, padded[name] - g.shape[0]))
-                flat[name] = g[None, None, None]
-            return loss[None, None, None, None], flat
+                flat[name] = g[None, None, None, None]
+            return loss[None, None, None, None, None], flat
 
         sm = smap(
             local_grads,
@@ -213,7 +225,7 @@ class DataParallelTrainer:
 
         def inc(g):
             def body(g):
-                return (-lr * g.reshape(g.shape[3:]) / data_size)[None, None, None]
+                return (-lr * g.reshape(g.shape[NUM_GRID_AXES:]) / data_size)[None, None, None, None]
 
             return smap(body, self.mesh, in_specs=_BUF_SPEC, out_specs=_BUF_SPEC)(g)
 
@@ -251,8 +263,8 @@ class DataParallelTrainer:
         @jax.jit
         def fused(params, batch):
             x, y = batch
-            x = x.reshape(x.shape[3:])
-            y = y.reshape(y.shape[3:])
+            x = x.reshape(x.shape[NUM_GRID_AXES:])
+            y = y.reshape(y.shape[NUM_GRID_AXES:])
             loss, grads = jax.value_and_grad(loss_fn)(params, (x, y))
             return loss, jax.tree.map(lambda p, g: p - lr * g, params, grads)
 
@@ -261,14 +273,18 @@ class DataParallelTrainer:
     # -- data placement ----------------------------------------------------
 
     def shard_batch(self, x: np.ndarray, y: np.ndarray):
-        """Global batch (B, ...) -> distributed buffers (R, D, M, localB, ...)."""
+        """Global batch (B, ...) -> distributed buffers (R, D, S, M, localB, ...)."""
         topo = self.dist.topology
-        r, d, m = topo.replica_count, topo.data_parts, topo.model_parts
+        r, d, s, m = topo.grid_shape
         local_b = x.shape[0] // (r * d)
-        xs = x.reshape(r, d, 1, local_b, *x.shape[1:])
-        xs = np.broadcast_to(xs, (r, d, m, local_b, *x.shape[1:]))
-        ys = y.reshape(r, d, 1, local_b, *y.shape[1:])
-        ys = np.broadcast_to(ys, (r, d, m, local_b, *y.shape[1:]))
+        xs = np.broadcast_to(
+            x.reshape(r, d, 1, 1, local_b, *x.shape[1:]),
+            (r, d, s, m, local_b, *x.shape[1:]),
+        )
+        ys = np.broadcast_to(
+            y.reshape(r, d, 1, 1, local_b, *y.shape[1:]),
+            (r, d, s, m, local_b, *y.shape[1:]),
+        )
         return topo.shard_buffer(xs), topo.shard_buffer(ys)
 
     # -- the training step (reference loop mlsl_test.cpp:660-698) ----------

@@ -1,0 +1,354 @@
+"""Decoder-only transformer with dp x sp x tp hybrid parallelism, MLSL in the loop.
+
+The scaling design (SURVEY.md §2 parallelism table + §5.7):
+- batch over the 'data' axis (DP), sequence over the 'seq' axis (SP, ring or Ulysses
+  attention), heads/hidden over the 'model' axis (TP — the reference's feature-map
+  sharding, src/mlsl_impl.cpp:36-66, applied to attention heads and MLP width);
+- TP activation reductions are lax.psum over 'model' inside the forward (the
+  reference's needReduce -> AllReduce case 2);
+- parameter-gradient sync across data x seq goes through ParameterSet requests exactly
+  like the ResNet trainer — TP-sharded leaves ride the same distributed buffers, with
+  each model-axis slot carrying that rank's shard;
+- gradients of replicated params (embeddings, layer norms, head) are psum'd over
+  'model' inside the grad program (their forward is used by every TP branch).
+
+Compute is bf16 on the MXU; params and reductions f32.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, List
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from mlsl_tpu.comm.collectives import _BUF_SPEC
+from mlsl_tpu.comm.mesh import DATA_AXIS, MODEL_AXIS, SEQ_AXIS
+from mlsl_tpu.log import mlsl_assert
+from mlsl_tpu.models.train import smap, _unflatten_like
+from mlsl_tpu.parallel.sequence import ring_attention, ulysses_attention
+from mlsl_tpu.types import DataType, OpType
+
+
+@dataclasses.dataclass(frozen=True)
+class TransformerConfig:
+    vocab: int = 256
+    d_model: int = 64
+    n_heads: int = 8
+    head_dim: int = 8
+    n_blocks: int = 2
+    seq_len: int = 64
+    mlp_ratio: int = 4
+    attention: str = "ring"  # 'ring' | 'ulysses'
+    dtype: str = "bfloat16"  # MXU compute dtype; 'float32' for exactness tests
+
+
+def init_params(key, cfg: TransformerConfig) -> Dict:
+    ks = iter(jax.random.split(key, 8 + 8 * cfg.n_blocks))
+    dm, h, dh = cfg.d_model, cfg.n_heads, cfg.head_dim
+    f = cfg.mlp_ratio * dm
+    std = 0.02
+    params = {
+        "embed": {
+            "tok": jax.random.normal(next(ks), (cfg.vocab, dm)) * std,
+            "pos": jax.random.normal(next(ks), (cfg.seq_len, dm)) * std,
+        },
+        "final": {
+            "ln_scale": jnp.ones((dm,)),
+            "ln_bias": jnp.zeros((dm,)),
+            "head": jax.random.normal(next(ks), (dm, cfg.vocab)) * std,
+        },
+    }
+    for i in range(cfg.n_blocks):
+        params[f"blk{i}.ln"] = {
+            "ln1_scale": jnp.ones((dm,)), "ln1_bias": jnp.zeros((dm,)),
+            "ln2_scale": jnp.ones((dm,)), "ln2_bias": jnp.zeros((dm,)),
+        }
+        params[f"blk{i}.attn"] = {
+            "wqkv": jax.random.normal(next(ks), (dm, 3, h, dh)) * std,
+            "wo": jax.random.normal(next(ks), (h, dh, dm)) * std,
+        }
+        params[f"blk{i}.mlp"] = {
+            "w1": jax.random.normal(next(ks), (dm, f)) * std,
+            "b1": jnp.zeros((f,)),
+            "w2": jax.random.normal(next(ks), (f, dm)) * std,
+            "b2": jnp.zeros((dm,)),
+        }
+    return params
+
+
+def param_specs(cfg: TransformerConfig) -> Dict:
+    """PartitionSpec pytree: which leaves are TP-sharded over 'model'."""
+    specs = {
+        "embed": {"tok": P(), "pos": P()},
+        "final": {"ln_scale": P(), "ln_bias": P(), "head": P()},
+    }
+    for i in range(cfg.n_blocks):
+        specs[f"blk{i}.ln"] = {
+            "ln1_scale": P(), "ln1_bias": P(), "ln2_scale": P(), "ln2_bias": P(),
+        }
+        specs[f"blk{i}.attn"] = {
+            "wqkv": P(None, None, MODEL_AXIS, None),
+            "wo": P(MODEL_AXIS, None, None),
+        }
+        specs[f"blk{i}.mlp"] = {
+            "w1": P(None, MODEL_AXIS),
+            "b1": P(MODEL_AXIS),
+            "w2": P(MODEL_AXIS, None),
+            "b2": P(),
+        }
+    return specs
+
+
+def layer_names(cfg: TransformerConfig) -> List[str]:
+    names = ["embed"]
+    for i in range(cfg.n_blocks):
+        names += [f"blk{i}.ln", f"blk{i}.attn", f"blk{i}.mlp"]
+    names.append("final")
+    return names
+
+
+def get_layer(params, name):
+    return params[name]
+
+
+def _ln(x, scale, bias, eps=1e-5):
+    mu = jnp.mean(x, axis=-1, keepdims=True)
+    var = jnp.var(x, axis=-1, keepdims=True)
+    return (x - mu) * lax.rsqrt(var + eps) * scale + bias
+
+
+def forward_local(params, tokens, cfg: TransformerConfig, sp: int, tp: int):
+    """SPMD forward on local shards (call inside shard_map).
+
+    tokens: (Bl, Sl) int32. params: LOCAL shards per param_specs. Returns logits
+    (Bl, Sl, vocab) — replicated over 'model' (psum'd), sharded over data/seq.
+    """
+    emb = params["embed"]
+    cdt = jnp.dtype(cfg.dtype)
+    s_idx = lax.axis_index(SEQ_AXIS) if sp > 1 else 0
+    sl = tokens.shape[1]
+    pos = lax.dynamic_slice_in_dim(emb["pos"], s_idx * sl, sl, axis=0)
+    h = (emb["tok"][tokens] + pos[None]).astype(cdt)
+
+    attn_fn = ring_attention if cfg.attention == "ring" else ulysses_attention
+    for i in range(cfg.n_blocks):
+        lnp = params[f"blk{i}.ln"]
+        ap = params[f"blk{i}.attn"]
+        mp = params[f"blk{i}.mlp"]
+
+        a = _ln(h.astype(jnp.float32), lnp["ln1_scale"], lnp["ln1_bias"]).astype(cdt)
+        qkv = jnp.einsum("bsd,dchx->bcshx", a, ap["wqkv"].astype(cdt))
+        q, k, v = (
+            jnp.moveaxis(qkv[:, c], 2, 1) for c in range(3)
+        )  # (Bl, Hl, Sl, Dh)
+        attn = attn_fn(q, k, v, SEQ_AXIS, sp, causal=True)
+        o = jnp.einsum(
+            "bhsx,hxd->bsd", attn.astype(jnp.float32), ap["wo"].astype(jnp.float32)
+        )
+        o = lax.psum(o, MODEL_AXIS) if tp > 1 else o      # TP reduction (case-2 analog)
+        h = (h.astype(jnp.float32) + o).astype(cdt)
+
+        a = _ln(h.astype(jnp.float32), lnp["ln2_scale"], lnp["ln2_bias"]).astype(cdt)
+        f = jax.nn.gelu(
+            jnp.einsum("bsd,df->bsf", a, mp["w1"].astype(cdt))
+            + mp["b1"].astype(cdt)
+        )
+        o = jnp.einsum("bsf,fd->bsd", f.astype(jnp.float32), mp["w2"].astype(jnp.float32))
+        o = lax.psum(o, MODEL_AXIS) if tp > 1 else o
+        h = (h.astype(jnp.float32) + o + mp["b2"]).astype(cdt)
+
+    fin = params["final"]
+    h = _ln(h.astype(jnp.float32), fin["ln_scale"], fin["ln_bias"])
+    return h @ fin["head"]
+
+
+def local_loss(params, tokens, labels, cfg, sp, tp):
+    """Sum (not mean) of CE over the LOCAL token shard — the reduction across
+    data/seq shards belongs to the MLSL gradient requests."""
+    logits = forward_local(params, tokens, cfg, sp, tp)
+    logp = jax.nn.log_softmax(logits.astype(jnp.float32))
+    ce = -jnp.take_along_axis(logp, labels[..., None], axis=-1)[..., 0]
+    return jnp.sum(ce)
+
+
+class HybridTrainer:
+    """dp x sp x tp training with per-layer MLSL gradient sync over data x seq."""
+
+    def __init__(self, env, cfg: TransformerConfig, dp: int, sp: int, tp: int,
+                 batch: int = None, lr: float = 0.1, seed: int = 0):
+        self.env = env
+        self.cfg = cfg
+        self.dp, self.sp, self.tp = dp, sp, tp
+        self.batch = batch if batch is not None else dp
+        mlsl_assert(self.batch % dp == 0, "batch %d %% dp %d", self.batch, dp)
+        self.lr = lr
+        self.dist = env.create_distribution(dp, tp, seq_parts=sp)
+        mlsl_assert(
+            self.dist.replica_count == 1,
+            "device count must equal dp*sp*tp (got %d replicas)",
+            self.dist.replica_count,
+        )
+        mlsl_assert(cfg.n_heads % tp == 0, "heads %d %% tp %d", cfg.n_heads, tp)
+        mlsl_assert(cfg.seq_len % sp == 0, "seq %d %% sp %d", cfg.seq_len, sp)
+        self.mesh = self.dist.topology.mesh
+        self.session = env.create_session()
+        self.session.set_global_minibatch_size(self.batch)
+
+        self.specs = param_specs(cfg)
+        params = init_params(jax.random.PRNGKey(seed), cfg)
+        self.params = jax.tree.map(
+            lambda x, s: jax.device_put(x, NamedSharding(self.mesh, s)),
+            params,
+            self.specs,
+            is_leaf=lambda x: isinstance(x, P),
+        )
+        self.layers = layer_names(cfg)
+        self._replicated = {
+            name: all(s == P() for s in jax.tree.leaves(
+                self.specs[name], is_leaf=lambda x: isinstance(x, P))
+            )
+            for name in self.layers
+        }
+
+        # local (per-device) flat size of each layer = Operation kernel count
+        self.local_counts = {}
+        for name in self.layers:
+            n = 0
+            for leaf, spec in zip(
+                jax.tree.leaves(params[name]),
+                jax.tree.leaves(self.specs[name], is_leaf=lambda x: isinstance(x, P)),
+            ):
+                size = int(np.prod(leaf.shape))
+                for dim_spec, dim in zip(spec, leaf.shape):
+                    if dim_spec == MODEL_AXIS:
+                        size //= tp
+                n += size
+            self.local_counts[name] = n
+
+        self.ops = {}
+        for name in self.layers:
+            reg = self.session.create_operation_reg_info(OpType.CC)
+            reg.set_name(name)
+            reg.add_input(tp, 1)   # placeholder activations (graph comm is unused
+            reg.add_output(tp, 1)  # here; grads flow through the parameter sets)
+            # MLSL kernel counts are global: the ParameterSet partitions them over the
+            # model group, recovering the per-device length local_counts[name]
+            reg.add_parameter_set(self.local_counts[name] * tp, 1, DataType.FLOAT)
+            self.ops[name] = self.session.get_operation(
+                self.session.add_operation(reg, self.dist)
+            )
+        self.session.commit()
+        self.padded_counts = {
+            name: self.ops[name].get_parameter_set(0).get_local_kernel_count()
+            for name in self.layers
+        }
+
+        self._grad_fn = self._build_grad_fn()
+        self._update_fn = self._build_update_fn()
+
+    # -- compiled programs -------------------------------------------------
+
+    def _token_spec(self):
+        return P((DATA_AXIS,), (SEQ_AXIS,))
+
+    def _build_grad_fn(self):
+        cfg, sp, tp = self.cfg, self.sp, self.tp
+        layers, padded = self.layers, self.padded_counts
+        specs = self.specs
+
+        # SPMD autodiff semantics: differentiating a per-device scalar seeds cotangent
+        # 1 on EVERY device, so the computed gradient is d(sum of all devices'
+        # losses)/d(local leaf). The loss is replicated over the model axis (logits
+        # are psum'd), so that sum counts the true loss tp times. Scaling the
+        # differentiated loss by 1/tp makes TP-sharded leaf gradients exact, and
+        # replicated leaves then need exactly one psum over 'model' to collect their
+        # per-branch partials.
+        def scaled_loss(p, t, l):
+            return local_loss(p, t, l, cfg, sp, tp) / tp
+
+        def body(params, tokens, labels):
+            loss, grads = jax.value_and_grad(scaled_loss)(params, tokens, labels)
+            flat = {}
+            for name in layers:
+                parts = []
+                leaf_specs = jax.tree.leaves(
+                    specs[name], is_leaf=lambda x: isinstance(x, P)
+                )
+                for leaf, spec in zip(jax.tree.leaves(grads[name]), leaf_specs):
+                    g = leaf.reshape(-1).astype(jnp.float32)
+                    if tp > 1 and MODEL_AXIS not in spec:
+                        g = lax.psum(g, MODEL_AXIS)
+                    parts.append(g)
+                g = jnp.concatenate(parts)
+                flat[name] = jnp.pad(g, (0, padded[name] - g.shape[0]))[
+                    None, None, None, None
+                ]
+            return (loss * tp)[None, None, None, None, None], flat
+
+        sm = smap(
+            body,
+            self.mesh,
+            in_specs=(self.specs, self._token_spec(), self._token_spec()),
+            out_specs=(_BUF_SPEC, {n: _BUF_SPEC for n in layers}),
+            check=False,
+        )
+        return jax.jit(sm)
+
+    def _build_update_fn(self):
+        layers, lr = self.layers, self.lr
+        counts = self.local_counts
+        # synced grads are sums of d(CE sum)/dw over all data x seq shards; SGD on the
+        # mean loss divides by the total token count
+        norm = self.batch * self.cfg.seq_len
+
+        def update(params, reduced):
+            def body(params, *flat_grads):
+                new = dict(params)
+                for name, g in zip(layers, flat_grads):
+                    g = g.reshape(-1)[: counts[name]] / norm
+                    sub = params[name]
+                    new[name] = jax.tree.map(
+                        lambda p, gg: (p - lr * gg).astype(p.dtype),
+                        sub,
+                        _unflatten_like(sub, g),
+                    )
+                return new
+
+            sm = smap(
+                body,
+                self.mesh,
+                in_specs=(self.specs,) + tuple(_BUF_SPEC for _ in layers),
+                out_specs=self.specs,
+                check=False,
+            )
+            return sm(params, *[reduced[n] for n in layers])
+
+        return jax.jit(update)
+
+    # -- step --------------------------------------------------------------
+
+    def shard_tokens(self, tokens: np.ndarray, labels: np.ndarray):
+        sharding = NamedSharding(self.mesh, self._token_spec())
+        return (
+            jax.device_put(jnp.asarray(tokens), sharding),
+            jax.device_put(jnp.asarray(labels), sharding),
+        )
+
+    def step(self, tokens, labels):
+        loss, grads = self._grad_fn(self.params, tokens, labels)
+        for name in reversed(self.layers):
+            self.ops[name].get_parameter_set(0).start_gradient_comm(grads[name])
+        reduced = {}
+        for name in self.layers:
+            ps = self.ops[name].get_parameter_set(0)
+            out = ps.wait_gradient_comm()
+            reduced[name] = out if out is not None else grads[name]
+        self.params = self._update_fn(self.params, reduced)
+        # loss buffer holds per-(data,seq)-shard partial CE sums (replicated over the
+        # model axis -> take slot 0); mean = total / (batch * seq_len)
+        return jnp.sum(loss[:, :, :, 0]) / (self.batch * self.cfg.seq_len)

@@ -1,0 +1,12 @@
+"""Parallelism schedules beyond the grid: sequence/context parallelism primitives.
+
+The reference's Distribution grid + AlltoAll redistribution machinery
+(src/mlsl_impl.cpp:203-226) generalizes to sequence scaling in exactly two schedules
+(SURVEY.md §5.7): all-to-all head/sequence re-sharding (Ulysses) and neighbor-exchange
+rings (ring attention — the implemented form of the reference's declared-but-unbuilt
+SendRecvList primitive, src/comm.hpp:212-248).
+"""
+
+from mlsl_tpu.parallel.sequence import ring_attention, ulysses_attention
+
+__all__ = ["ring_attention", "ulysses_attention"]

@@ -1,0 +1,142 @@
+"""Sequence-parallel attention: ppermute ring and all-to-all (Ulysses) schedules.
+
+Both functions are SPMD bodies — call them inside ``shard_map`` over a mesh that has
+the given sequence axis. Inputs are the device-local shards:
+    q, k, v: (batch, heads_local, seq_local, head_dim)
+
+ring_attention: k/v blocks rotate around the ring via lax.ppermute while each device
+keeps its query block, accumulating with the numerically-stable online-softmax
+(flash-attention) update. Wire cost per step: one k+v block over the neighbor link —
+the TPU-native realization of the reference's unimplemented SendRecvList
+neighbor-exchange CommOp (src/comm.hpp:212-248). Supports causal masking via global
+position arithmetic.
+
+ulysses_attention: two all-to-alls switch sharding seq->heads and back (the reference's
+redistribution-AlltoAll pattern, src/mlsl_impl.cpp:203-226, applied to the sequence
+axis): attention itself runs with the full sequence but a head subset per device.
+Requires heads_local divisible by the axis size.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+_NEG = -1e30
+
+
+def _pvary(x, axis):
+    """Mark x as device-varying over axis (no-op on JAX versions without VMA)."""
+    try:
+        return lax.pcast(x, (axis,), to="varying")
+    except (AttributeError, TypeError):  # pragma: no cover - older jax
+        try:
+            return lax.pvary(x, (axis,))
+        except AttributeError:
+            return x
+
+
+def _attn_block_update(q, k_blk, v_blk, acc, m, l, q_pos, k_pos, causal, scale):
+    """One online-softmax accumulation step.
+
+    q: (B, H, Sq, D); k_blk/v_blk: (B, H, Sk, D); acc: (B, H, Sq, D);
+    m, l: (B, H, Sq); q_pos: (Sq,), k_pos: (Sk,) global positions.
+    """
+    s = jnp.einsum("bhqd,bhkd->bhqk", q, k_blk) * scale
+    if causal:
+        valid = (k_pos[None, :] <= q_pos[:, None])  # (Sq, Sk)
+        s = jnp.where(valid[None, None], s, _NEG)
+    s_max = jnp.max(s, axis=-1)                      # (B, H, Sq)
+    m_new = jnp.maximum(m, s_max)
+    # exp of masked entries: s = _NEG << m_new -> exp underflows to 0 exactly
+    p = jnp.exp(s - m_new[..., None])
+    p = jnp.where(s <= _NEG / 2, 0.0, p)
+    corr = jnp.exp(m - m_new)
+    l_new = l * corr + jnp.sum(p, axis=-1)
+    acc_new = acc * corr[..., None] + jnp.einsum("bhqk,bhkd->bhqd", p, v_blk)
+    return acc_new, m_new, l_new
+
+
+def ring_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    axis: str,
+    axis_size: int,
+    causal: bool = False,
+) -> jax.Array:
+    """Exact attention over the full (sharded) sequence via a k/v ring."""
+    if axis_size == 1:
+        return _dense_attention(q, k, v, causal, 0)
+    b, h, sl, d = q.shape
+    scale = 1.0 / jnp.sqrt(d).astype(q.dtype)
+    me = lax.axis_index(axis)
+    perm = [(i, (i + 1) % axis_size) for i in range(axis_size)]
+    q_pos = me * sl + jnp.arange(sl)
+
+    acc = jnp.zeros((b, h, sl, d), jnp.float32)
+    m = jnp.full((b, h, sl), _NEG, jnp.float32)
+    l = jnp.zeros((b, h, sl), jnp.float32)
+    # mark the carry as device-varying over the ring axis (shard_map VMA rules:
+    # the loop body mixes in ppermute'd values, so the carry type must be varying)
+    acc, m, l = (_pvary(x, axis) for x in (acc, m, l))
+
+    def step(t, carry):
+        acc, m, l, k_cur, v_cur = carry
+        src = (me - t) % axis_size          # original owner of the current k/v block
+        k_pos = src * sl + jnp.arange(sl)
+        acc, m, l = _attn_block_update(
+            q.astype(jnp.float32), k_cur.astype(jnp.float32),
+            v_cur.astype(jnp.float32), acc, m, l, q_pos, k_pos, causal, scale
+        )
+        k_nxt = lax.ppermute(k_cur, axis, perm)
+        v_nxt = lax.ppermute(v_cur, axis, perm)
+        return acc, m, l, k_nxt, v_nxt
+
+    acc, m, l, _, _ = lax.fori_loop(0, axis_size, step, (acc, m, l, k, v))
+    out = acc / jnp.maximum(l[..., None], 1e-30)
+    return out.astype(q.dtype)
+
+
+def ulysses_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    axis: str,
+    axis_size: int,
+    causal: bool = False,
+) -> jax.Array:
+    """Exact attention by re-sharding seq->heads with all-to-all, attending, and
+    re-sharding back."""
+    b, h, sl, d = q.shape
+    if axis_size == 1:
+        return _dense_attention(q, k, v, causal, 0)
+    assert h % axis_size == 0, (
+        f"heads_local {h} must be divisible by seq axis size {axis_size}"
+    )
+
+    def to_heads(x):  # (B, H, Sl, D) -> (B, H/G, S, D)
+        return lax.all_to_all(x, axis, split_axis=1, concat_axis=2, tiled=True)
+
+    def to_seq(x):    # (B, H/G, S, D) -> (B, H, Sl, D)
+        return lax.all_to_all(x, axis, split_axis=2, concat_axis=1, tiled=True)
+
+    qh, kh, vh = to_heads(q), to_heads(k), to_heads(v)
+    out = _dense_attention(qh, kh, vh, causal, 0)
+    return to_seq(out)
+
+
+def _dense_attention(q, k, v, causal: bool, pos_offset: int) -> jax.Array:
+    b, h, s, d = q.shape
+    scale = 1.0 / jnp.sqrt(d).astype(jnp.float32)
+    s_mat = jnp.einsum(
+        "bhqd,bhkd->bhqk", q.astype(jnp.float32), k.astype(jnp.float32)
+    ) * scale
+    if causal:
+        pos = jnp.arange(s) + pos_offset
+        s_mat = jnp.where((pos[None, :] <= pos[:, None])[None, None], s_mat, _NEG)
+    p = jax.nn.softmax(s_mat, axis=-1)
+    return jnp.einsum("bhqk,bhkd->bhqd", p, v.astype(jnp.float32)).astype(q.dtype)

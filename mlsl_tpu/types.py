@@ -74,11 +74,14 @@ class GroupType(enum.IntEnum):
     DATA: processes holding the same model shard for different batches (data parallel).
     MODEL: processes holding different model shards for the same batch (model parallel).
     GLOBAL: all processes.
+    SEQ (extension, absent in the 2016-era reference): processes holding different
+    sequence chunks of the same batch (sequence/context parallelism).
     """
 
     DATA = 0
     MODEL = 1
     GLOBAL = 2
+    SEQ = 3
 
 
 class ReductionType(enum.IntEnum):

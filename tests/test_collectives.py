@@ -34,7 +34,7 @@ def group_members(dist, gt, world):
                 q for q in range(world)
                 if all(
                     dist.topology.coords(q)[i] == dist.topology.coords(p)[i]
-                    for i, ax in enumerate(("replica", "data", "model"))
+                    for i, ax in enumerate(("replica", "data", "seq", "model"))
                     if ax not in g.axes
                 )
             ]

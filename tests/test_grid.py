@@ -16,9 +16,10 @@ def test_coords_match_reference(env, data_parts, model_parts):
     assert world == 8
     for p in range(world):
         i_r, i_m, i_f, _, _ = ref_coords(p, data_parts, model_parts)
-        r, d, m = topo.coords(p)
-        assert (r, d, m) == (i_r, i_m, i_f)
-        assert topo.global_idx(r, d, m) == p
+        r, d, s, m = topo.coords(p)
+        # with seq_parts == 1 the layout reduces exactly to the reference's
+        assert (r, d, s, m) == (i_r, i_m, 0, i_f)
+        assert topo.global_idx(r, d, s, m) == p
 
 
 @pytest.mark.parametrize("data_parts,model_parts", [(2, 4), (4, 2), (8, 1), (1, 8)])
@@ -45,7 +46,7 @@ def test_replicas(env):
     topo = dist.topology
     for p in range(8):
         i_r, i_m, i_f, _, _ = ref_coords(p, 2, 2)
-        assert topo.coords(p) == (i_r, i_m, i_f)
+        assert topo.coords(p) == (i_r, i_m, 0, i_f)
 
 
 def test_model_group_members_are_consecutive_ranks(env):

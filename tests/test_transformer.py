@@ -1,0 +1,75 @@
+"""Hybrid dp x sp x tp transformer training vs a single-device oracle."""
+
+import numpy as np
+import pytest
+import jax
+import jax.numpy as jnp
+
+from mlsl_tpu.models import transformer as tfm
+
+
+CFG = tfm.TransformerConfig(
+    vocab=32, d_model=16, n_heads=4, head_dim=4, n_blocks=2, seq_len=16,
+    dtype="float32",  # exactness vs the oracle; bf16 is the production default
+)
+
+
+def _data(b, seed=0):
+    rng = np.random.default_rng(seed)
+    toks = rng.integers(0, CFG.vocab, size=(b, CFG.seq_len)).astype(np.int32)
+    labels = rng.integers(0, CFG.vocab, size=(b, CFG.seq_len)).astype(np.int32)
+    return toks, labels
+
+
+def _oracle_steps(params, toks, labels, lr, n_steps):
+    """Single-device full-batch SGD on mean CE (tp=sp=1 path)."""
+
+    def mean_loss(p):
+        return tfm.local_loss(p, jnp.asarray(toks), jnp.asarray(labels), CFG, 1, 1) / (
+            toks.shape[0] * CFG.seq_len
+        )
+
+    for _ in range(n_steps):
+        g = jax.grad(mean_loss)(params)
+        params = jax.tree.map(lambda p, gg: p - lr * gg, params, g)
+    return params, float(mean_loss(params))
+
+
+@pytest.mark.parametrize("dp,sp,tp", [(2, 2, 2), (8, 1, 1), (1, 4, 2), (2, 4, 1), (1, 2, 4)])
+def test_hybrid_matches_oracle(env, dp, sp, tp):
+    b = 2 * dp
+    trainer = tfm.HybridTrainer(env, CFG, dp, sp, tp, batch=b, lr=0.5)
+    toks, labels = _data(b)
+    # oracle from identical initial params (single device, no sharding)
+    ref_params = tfm.init_params(jax.random.PRNGKey(0), CFG)
+    st, sl_ = trainer.shard_tokens(toks, labels)
+    losses = []
+    for _ in range(2):
+        losses.append(float(trainer.step(st, sl_)))
+    ref_params, _ = _oracle_steps(ref_params, toks, labels, 0.5, 2)
+
+    got = jax.device_get(trainer.params)
+    want = jax.device_get(ref_params)
+    flat_g = jax.tree.leaves(got)
+    flat_w = jax.tree.leaves(want)
+    for g, w in zip(flat_g, flat_w):
+        np.testing.assert_allclose(
+            np.asarray(g, np.float32), np.asarray(w, np.float32), atol=2e-2, rtol=2e-2
+        )
+    assert np.isfinite(losses).all()
+
+
+def test_hybrid_ulysses_variant(env):
+    cfg = tfm.TransformerConfig(
+        vocab=32, d_model=16, n_heads=4, head_dim=4, n_blocks=1, seq_len=16,
+        attention="ulysses",
+    )
+    trainer = tfm.HybridTrainer(env, cfg, 2, 2, 2, batch=4, lr=0.5)
+    toks = np.random.default_rng(0).integers(0, 32, size=(4, 16)).astype(np.int32)
+    labels = np.roll(toks, -1, axis=1)
+    st, sl_ = trainer.shard_tokens(toks, labels)
+    l0 = float(trainer.step(st, sl_))
+    l5 = l0
+    for _ in range(5):
+        l5 = float(trainer.step(st, sl_))
+    assert np.isfinite(l0) and l5 < l0  # memorizing a fixed batch must reduce loss
