@@ -1,0 +1,124 @@
+/* mlsl_tpu C API — the flat-C surface for C/C++ frameworks.
+ *
+ * Mirrors the reference's C binding (include/mlsl.h + src/c_bind.cpp: opaque
+ * handles, int status returns) over the TPU-native core. Architecture note:
+ * the reference's core is C++ with Python bound on top; this framework's core
+ * is Python/JAX with this C layer embedding the interpreter — the same flat
+ * contract from the caller's point of view.
+ *
+ * Buffer convention (single-controller SPMD): a caller passes the WHOLE
+ * world's data as one dense array of logical shape (world_size, count),
+ * rank-major — the analog of each MPI rank passing its local buffer.
+ *
+ * All functions return MLSL_TPU_SUCCESS (0) or MLSL_TPU_FAILURE (-1) unless
+ * documented otherwise; handle-returning calls return 0 on failure.
+ */
+
+#ifndef MLSL_TPU_H
+#define MLSL_TPU_H
+
+#include <stddef.h>
+#include <stdint.h>
+
+#ifdef __cplusplus
+extern "C" {
+#endif
+
+#define MLSL_TPU_SUCCESS 0
+#define MLSL_TPU_FAILURE -1
+
+typedef uint64_t mlsl_handle_t;
+
+/* enums match mlsl_tpu.types (reference include/mlsl.hpp:88-155) */
+typedef enum { MLSL_DT_FLOAT = 0, MLSL_DT_DOUBLE = 1, MLSL_DT_BYTE = 2,
+               MLSL_DT_BF16 = 3, MLSL_DT_F16 = 4, MLSL_DT_INT8 = 5 } mlsl_data_type_t;
+typedef enum { MLSL_GT_DATA = 0, MLSL_GT_MODEL = 1, MLSL_GT_GLOBAL = 2,
+               MLSL_GT_SEQ = 3 } mlsl_group_type_t;
+typedef enum { MLSL_RT_SUM = 0, MLSL_RT_MIN = 1, MLSL_RT_MAX = 2 } mlsl_reduction_t;
+typedef enum { MLSL_OT_CC = 0, MLSL_OT_BIAS = 1, MLSL_OT_ACT = 2, MLSL_OT_POOL = 3,
+               MLSL_OT_SPLIT = 4, MLSL_OT_CONCAT = 5, MLSL_OT_BCAST = 6,
+               MLSL_OT_REDUCE = 7, MLSL_OT_DATA = 8, MLSL_OT_EVAL = 9 } mlsl_op_type_t;
+typedef enum { MLSL_CT_NONE = 0, MLSL_CT_QUANTIZATION = 1 } mlsl_compression_t;
+
+/* ---- environment ---- */
+int mlsl_environment_init(void);
+int mlsl_environment_finalize(void);
+int64_t mlsl_environment_get_process_count(void);
+mlsl_handle_t mlsl_environment_create_distribution(int64_t data_parts,
+                                                   int64_t model_parts,
+                                                   int64_t seq_parts);
+mlsl_handle_t mlsl_environment_create_session(void);
+
+/* ---- distribution collectives ---- */
+int64_t mlsl_distribution_get_process_count(mlsl_handle_t dist,
+                                            mlsl_group_type_t group);
+/* send: (world, count); returns a request handle (0 on failure). */
+mlsl_handle_t mlsl_distribution_all_reduce(mlsl_handle_t dist, const void* send,
+                                           int64_t count, mlsl_data_type_t dt,
+                                           mlsl_reduction_t op,
+                                           mlsl_group_type_t group);
+mlsl_handle_t mlsl_distribution_bcast(mlsl_handle_t dist, const void* send,
+                                      int64_t count, mlsl_data_type_t dt,
+                                      int64_t root, mlsl_group_type_t group);
+mlsl_handle_t mlsl_distribution_all_gather(mlsl_handle_t dist, const void* send,
+                                           int64_t send_count,
+                                           mlsl_data_type_t dt,
+                                           mlsl_group_type_t group);
+mlsl_handle_t mlsl_distribution_reduce_scatter(mlsl_handle_t dist,
+                                               const void* send,
+                                               int64_t send_count,
+                                               mlsl_data_type_t dt,
+                                               mlsl_reduction_t op,
+                                               mlsl_group_type_t group);
+mlsl_handle_t mlsl_distribution_all_to_all(mlsl_handle_t dist, const void* send,
+                                           int64_t send_count,
+                                           mlsl_data_type_t dt,
+                                           mlsl_group_type_t group);
+int mlsl_distribution_barrier(mlsl_handle_t dist, mlsl_group_type_t group);
+
+/* ---- request completion (reference Environment::Wait/Test) ---- */
+/* recv: (world, recv_count) written on success. Frees the request. */
+int mlsl_request_wait(mlsl_handle_t req, void* recv, int64_t recv_count,
+                      mlsl_data_type_t dt);
+/* 1 = complete, 0 = in flight, negative = error. Does not consume. */
+int mlsl_request_test(mlsl_handle_t req);
+
+/* ---- session graph ---- */
+int mlsl_session_set_global_minibatch_size(mlsl_handle_t sess, int64_t size);
+mlsl_handle_t mlsl_session_create_operation_reg_info(mlsl_handle_t sess,
+                                                     mlsl_op_type_t op_type);
+int64_t mlsl_operation_reg_info_add_input(mlsl_handle_t reg, int64_t count,
+                                          int64_t size, mlsl_data_type_t dt);
+int64_t mlsl_operation_reg_info_add_output(mlsl_handle_t reg, int64_t count,
+                                           int64_t size, mlsl_data_type_t dt);
+int64_t mlsl_operation_reg_info_add_parameter_set(mlsl_handle_t reg,
+                                                  int64_t kernel_count,
+                                                  int64_t kernel_size,
+                                                  mlsl_data_type_t dt,
+                                                  int dist_update,
+                                                  mlsl_compression_t comp);
+mlsl_handle_t mlsl_session_add_operation(mlsl_handle_t sess, mlsl_handle_t reg,
+                                         mlsl_handle_t dist);
+int mlsl_session_commit(mlsl_handle_t sess);
+int mlsl_operation_set_next(mlsl_handle_t op, mlsl_handle_t next,
+                            int64_t out_idx, int64_t in_idx);
+int64_t mlsl_operation_get_local_minibatch_size(mlsl_handle_t op);
+int64_t mlsl_operation_get_parameter_local_count(mlsl_handle_t op, int64_t idx);
+int64_t mlsl_operation_get_parameter_owned_count(mlsl_handle_t op, int64_t idx);
+
+/* ---- parameter-set gradient sync ---- */
+int mlsl_parameter_set_start_gradient_comm(mlsl_handle_t op, int64_t ps_idx,
+                                           const void* grads,
+                                           mlsl_data_type_t dt);
+/* Writes (world, n) into recv; returns n (per-rank element count; 0 = no comm
+ * was needed; negative = error). */
+int64_t mlsl_parameter_set_wait_gradient_comm(mlsl_handle_t op, int64_t ps_idx,
+                                              void* recv, mlsl_data_type_t dt);
+
+int mlsl_handle_release(mlsl_handle_t h);
+
+#ifdef __cplusplus
+}
+#endif
+
+#endif /* MLSL_TPU_H */

@@ -1,0 +1,246 @@
+"""Flat-function shim backing the embedded-Python C API (native/c_api.cpp).
+
+The reference exposes its C++ core to C via opaque handles (src/c_bind.cpp) and to
+Python via ctypes over that C layer (include/mlsl/mlsl.py). This framework inverts the
+stack — the core is Python/JAX — so the C API embeds the interpreter and calls these
+flat functions. Handles are integers into a registry; buffers cross the boundary as
+raw pointer addresses wrapped with ctypes (single-controller: a C caller provides the
+whole world's buffer, shape (world, count), and receives results the same way).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import threading
+
+import numpy as np
+
+from mlsl_tpu.core.environment import Environment
+from mlsl_tpu.types import CompressionType, DataType, GroupType, OpType, ReductionType, jnp_dtype
+
+_registry: dict = {}
+_next_id = 1
+_lock = threading.Lock()
+
+
+def _put(obj) -> int:
+    global _next_id
+    with _lock:
+        hid = _next_id
+        _next_id += 1
+        _registry[hid] = obj
+    return hid
+
+
+def _get(hid: int):
+    return _registry[int(hid)]
+
+
+def _release(hid: int) -> int:
+    _registry.pop(int(hid), None)
+    return 0
+
+
+# ---- environment ----
+
+def env_init() -> int:
+    import os
+
+    platform = os.environ.get("MLSL_TPU_PLATFORM")
+    if platform:
+        # the axon site hook pins JAX_PLATFORMS; the config update wins post-import
+        import jax
+
+        jax.config.update("jax_platforms", platform)
+    Environment.get_env().init()
+    return 0
+
+
+def env_finalize() -> int:
+    Environment.get_env().finalize()
+    return 0
+
+
+def env_process_count() -> int:
+    return Environment.get_env().get_process_count()
+
+
+def env_create_distribution(data_parts: int, model_parts: int, seq_parts: int) -> int:
+    env = Environment.get_env()
+    return _put(env.create_distribution(data_parts, model_parts, seq_parts=seq_parts))
+
+
+def env_create_session() -> int:
+    return _put(Environment.get_env().create_session())
+
+
+# ---- buffers: address <-> numpy ----
+
+def _read_world_buffer(dist, addr: int, count: int, data_type: int):
+    """C buffer at `addr`, logical shape (world, count), -> distributed buffer."""
+    dt = jnp_dtype(DataType(data_type))
+    world = dist.get_process_count_global()
+    flat = np.ctypeslib.as_array(
+        ctypes.cast(int(addr), ctypes.POINTER(ctypes.c_char)),
+        shape=(world * count * np.dtype(dt).itemsize,),
+    ).view(dt).reshape(world, count)
+    return dist.make_buffer(lambda p: flat[p], count, DataType(data_type))
+
+
+def _write_world_buffer(dist, result, addr: int, count: int, data_type: int) -> int:
+    dt = np.dtype(jnp_dtype(DataType(data_type)))
+    world = dist.get_process_count_global()
+    out = np.ctypeslib.as_array(
+        ctypes.cast(int(addr), ctypes.POINTER(ctypes.c_char)),
+        shape=(world * count * dt.itemsize,),
+    ).view(dt).reshape(world, count)
+    host = np.asarray(result).reshape(world, -1)
+    out[:, : host.shape[1]] = host[:, :count]
+    return 0
+
+
+# ---- distribution collectives (sync + async) ----
+
+def dist_collective_start(
+    dist_h: int, kind: str, addr: int, count: int, data_type: int,
+    op: int, root: int, group: int,
+) -> int:
+    dist = _get(dist_h)
+    buf = _read_world_buffer(dist, addr, count, data_type)
+    gt = GroupType(group)
+    if kind == "allreduce":
+        req = dist.all_reduce(buf, count, data_type, ReductionType(op), gt)
+    elif kind == "bcast":
+        req = dist.bcast(buf, count, data_type, root, gt)
+    elif kind == "reduce":
+        req = dist.reduce(buf, count, data_type, ReductionType(op), root, gt)
+    elif kind == "allgather":
+        req = dist.all_gather(buf, count, data_type, gt)
+    elif kind in ("reduce_scatter", "alltoall"):
+        from mlsl_tpu.log import mlsl_assert
+
+        g = dist._group(gt)
+        gsize = 1 if g.is_self else g.size
+        mlsl_assert(
+            count % gsize == 0,
+            "%s send count %d must be divisible by group size %d",
+            kind, count, gsize,
+        )
+        if kind == "reduce_scatter":
+            req = dist.reduce_scatter(
+                buf, count // gsize, data_type, ReductionType(op), gt
+            )
+        else:
+            req = dist.all_to_all(buf, count // gsize, data_type, gt)
+    else:
+        raise ValueError(f"unknown collective {kind}")
+    return _put((dist, req))
+
+
+def request_wait(req_h: int, out_addr: int, out_count: int, data_type: int) -> int:
+    dist, req = _get(req_h)
+    result = Environment.get_env().wait(req)
+    _write_world_buffer(dist, result, out_addr, out_count, data_type)
+    _release(req_h)
+    return 0
+
+
+def request_test(req_h: int) -> int:
+    """1 if complete, 0 otherwise. Non-consuming: a later request_wait still
+    delivers the result (the request caches it on test completion)."""
+    dist, req = _get(req_h)
+    done, _ = req.test()
+    return 1 if done else 0
+
+
+def dist_barrier(dist_h: int, group: int) -> int:
+    _get(dist_h).barrier(GroupType(group))
+    return 0
+
+
+def dist_process_count(dist_h: int, group: int) -> int:
+    return _get(dist_h).get_process_count(GroupType(group))
+
+
+# ---- session graph ----
+
+def session_set_minibatch(sess_h: int, size: int) -> int:
+    _get(sess_h).set_global_minibatch_size(size)
+    return 0
+
+
+def session_create_reginfo(sess_h: int, op_type: int) -> int:
+    return _put(_get(sess_h).create_operation_reg_info(OpType(op_type)))
+
+
+def reginfo_add_input(reg_h: int, count: int, size: int, data_type: int) -> int:
+    return _get(reg_h).add_input(count, size, DataType(data_type))
+
+
+def reginfo_add_output(reg_h: int, count: int, size: int, data_type: int) -> int:
+    return _get(reg_h).add_output(count, size, DataType(data_type))
+
+
+def reginfo_add_parameter_set(
+    reg_h: int, count: int, size: int, data_type: int, dist_update: int, compression: int
+) -> int:
+    return _get(reg_h).add_parameter_set(
+        count, size, DataType(data_type),
+        distributed_update=bool(dist_update),
+        compression_type=CompressionType(compression),
+    )
+
+
+def session_add_operation(sess_h: int, reg_h: int, dist_h: int) -> int:
+    sess = _get(sess_h)
+    idx = sess.add_operation(_get(reg_h), _get(dist_h))
+    return _put(sess.get_operation(idx))
+
+
+def session_commit(sess_h: int) -> int:
+    _get(sess_h).commit()
+    return 0
+
+
+def operation_set_next(op_h: int, next_h: int, out_idx: int, in_idx: int) -> int:
+    _get(op_h).set_next(_get(next_h), out_idx, in_idx)
+    return 0
+
+
+def operation_local_minibatch(op_h: int) -> int:
+    return _get(op_h).get_local_minibatch_size()
+
+
+def operation_param_local_count(op_h: int, ps_idx: int) -> int:
+    ps = _get(op_h).get_parameter_set(ps_idx)
+    return ps.get_local_kernel_count() * ps.get_kernel_size()
+
+
+def operation_param_owned_count(op_h: int, ps_idx: int) -> int:
+    ps = _get(op_h).get_parameter_set(ps_idx)
+    return ps.get_owned_kernel_count() * ps.get_kernel_size()
+
+
+def param_start_gradient_comm(op_h: int, ps_idx: int, addr: int, data_type: int) -> int:
+    op = _get(op_h)
+    ps = op.get_parameter_set(ps_idx)
+    count = ps.get_local_kernel_count() * ps.get_kernel_size()
+    buf = _read_world_buffer(op.distribution, addr, count, data_type)
+    ps.start_gradient_comm(buf)
+    return 0
+
+
+def param_wait_gradient_comm(op_h: int, ps_idx: int, out_addr: int, data_type: int) -> int:
+    """Returns the per-rank element count written (0 if no comm was needed)."""
+    op = _get(op_h)
+    ps = op.get_parameter_set(ps_idx)
+    out = ps.wait_gradient_comm()
+    if out is None:
+        return 0
+    n = int(np.asarray(out).shape[-1])
+    _write_world_buffer(op.distribution, out, out_addr, n, data_type)
+    return n
+
+
+def handle_release(hid: int) -> int:
+    return _release(hid)

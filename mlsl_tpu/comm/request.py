@@ -90,6 +90,7 @@ class CommRequest:
         self._result: Optional[jax.Array] = None
         self._quant_fn: Optional[Callable] = None
         self._err: Optional[jax.Array] = None  # quantization error-feedback state
+        self._completed_via_test = False
         self.is_started = False
         self.is_setup = False
         self._epoch = 0
@@ -172,6 +173,7 @@ class CommRequest:
         self._epoch += 1
         self._results = []
         self._result = None
+        self._completed_via_test = False
         self.is_started = True
         self.dispatcher.submit(self, buf)
         return self
@@ -203,6 +205,11 @@ class CommRequest:
         return self._result
 
     def wait(self) -> jax.Array:
+        # A request completed by test() can still be wait()ed (MPI semantics:
+        # MPI_Wait on a completed request returns immediately).
+        if not self.is_started and self._completed_via_test:
+            self._completed_via_test = False
+            return self._result
         mlsl_assert(self.is_started, "request was not started")
         self.dispatcher.flush()
         out = self._assemble()
@@ -220,6 +227,7 @@ class CommRequest:
             out = self._assemble()
             jax.block_until_ready(out)
             self.is_started = False
+            self._completed_via_test = True
             return True, out
         return False, None
 
@@ -272,15 +280,53 @@ class Dispatcher:
     def __init__(self, config):
         self.config = config
         self._pending: List[tuple] = []  # stack of (request, buf)
+        self._by_id: dict = {}           # req uid -> (request, buf), native path
         self._lock = threading.Lock()
+        self._native = None
+        self._native_tried = False
+
+    def _ensure_native_locked(self):
+        """Lazily bind the C++ priority queue (config may be toggled post-init).
+        Caller must hold self._lock — the check-and-swap must not race submits."""
+        cfg = self.config
+        if not self._native_tried or (
+            self._native is not None
+            and self._native.params != (cfg.msg_priority_threshold, cfg.msg_priority_mode)
+            and self._native.pending() == 0  # never strand deferred entries
+        ):
+            self._native_tried = True
+            try:
+                from mlsl_tpu.native import NativeScheduler
+
+                self._native = NativeScheduler(
+                    cfg.msg_priority_threshold, cfg.msg_priority_mode
+                )
+            except (RuntimeError, ImportError):
+                self._native = None
+        return self._native
 
     def submit(self, req: CommRequest, buf: jax.Array) -> None:
         cfg = self.config
-        if (
-            cfg.msg_priority
-            and req.desc.payload_bytes() > cfg.msg_priority_threshold
-            and req.desc.kind != "barrier"
-        ):
+        if not cfg.msg_priority or req.desc.kind == "barrier":
+            req._dispatch(buf)
+            return
+        native = None
+        immediate = False
+        with self._lock:
+            native = self._ensure_native_locked()
+            if native is not None:
+                immediate = native.submit(req.uid, req.desc.payload_bytes())
+                if not immediate:
+                    self._by_id[req.uid] = (req, buf)
+        if native is not None:
+            if immediate:
+                req._dispatch(buf)  # outside the lock: may trigger compilation
+            else:
+                log_debug(
+                    "deferred request %s (%d B)", req.name, req.desc.payload_bytes()
+                )
+            return
+        if req.desc.payload_bytes() > cfg.msg_priority_threshold:
             with self._lock:
                 # A restart of an already-deferred request supersedes the stale entry
                 # (otherwise flush would re-dispatch the old buffer last and clobber
@@ -292,6 +338,13 @@ class Dispatcher:
             req._dispatch(buf)
 
     def flush(self) -> None:
+        if self._native is not None:
+            with self._lock:
+                order = self._native.drain()
+                items = [self._by_id.pop(rid) for rid in order if rid in self._by_id]
+            for req, buf in items:
+                req._dispatch(buf)
+            return
         with self._lock:
             pending, self._pending = self._pending, []
         if not pending:
@@ -299,6 +352,12 @@ class Dispatcher:
         order = reversed(pending) if self.config.msg_priority_mode else iter(pending)
         for req, buf in order:
             req._dispatch(buf)
+
+    @property
+    def pending_count(self) -> int:
+        if self._native is not None:
+            return self._native.pending()
+        return len(self._pending)
 
 
 class RequestStorage:

@@ -1,0 +1,259 @@
+/* C API implementation: embeds CPython and delegates to mlsl_tpu.c_shim.
+ *
+ * The reference binds C over its C++ core (src/c_bind.cpp); here the core is
+ * Python/JAX, so this translation unit owns the interpreter lifecycle (the
+ * inverse binding). Every entry point grabs the GIL, calls one flat shim
+ * function, and converts the result — no Python types leak to callers.
+ */
+
+#include "../include/mlsl_tpu.h"
+
+#define PY_SSIZE_T_CLEAN
+#include <Python.h>
+
+#include <cstdio>
+#include <mutex>
+
+namespace {
+
+PyObject* g_shim = nullptr;
+std::once_flag g_init_flag;
+bool g_owns_interpreter = false;
+
+void interpreter_init() {
+  if (!Py_IsInitialized()) {
+    Py_InitializeEx(0);
+    g_owns_interpreter = true;
+  }
+  PyGILState_STATE gil = PyGILState_Ensure();
+  g_shim = PyImport_ImportModule("mlsl_tpu.c_shim");
+  if (g_shim == nullptr) {
+    PyErr_Print();
+    std::fprintf(stderr,
+                 "mlsl_tpu: failed to import mlsl_tpu.c_shim "
+                 "(is mlsl_tpu on PYTHONPATH?)\n");
+  }
+  PyGILState_Release(gil);
+  if (g_owns_interpreter) {
+    // Py_InitializeEx leaves this thread holding the GIL; release it so other
+    // threads' PyGILState_Ensure can acquire (async start/test/wait from
+    // multiple threads is the expected usage pattern).
+    PyEval_SaveThread();
+  }
+}
+
+/* Call shim.<name>(args...) where every arg and the result are int64. */
+int64_t call_i(const char* name, std::initializer_list<int64_t> args,
+               int64_t fail = MLSL_TPU_FAILURE) {
+  std::call_once(g_init_flag, interpreter_init);
+  if (g_shim == nullptr) return fail;
+  PyGILState_STATE gil = PyGILState_Ensure();
+  int64_t result = fail;
+  PyObject* tuple = PyTuple_New(static_cast<Py_ssize_t>(args.size()));
+  Py_ssize_t i = 0;
+  for (int64_t a : args)
+    PyTuple_SET_ITEM(tuple, i++, PyLong_FromLongLong(a));
+  PyObject* fn = PyObject_GetAttrString(g_shim, name);
+  if (fn != nullptr) {
+    PyObject* res = PyObject_CallObject(fn, tuple);
+    if (res != nullptr) {
+      result = PyLong_AsLongLong(res);
+      if (PyErr_Occurred()) {
+        PyErr_Print();
+        result = fail;
+      }
+      Py_DECREF(res);
+    } else {
+      PyErr_Print();
+    }
+    Py_DECREF(fn);
+  } else {
+    PyErr_Print();
+  }
+  Py_DECREF(tuple);
+  PyGILState_Release(gil);
+  return result;
+}
+
+/* shim.dist_collective_start(dist, kind, addr, count, dt, op, root, group) */
+mlsl_handle_t collective_start(mlsl_handle_t dist, const char* kind,
+                               const void* send, int64_t count, int64_t dt,
+                               int64_t op, int64_t root, int64_t group) {
+  std::call_once(g_init_flag, interpreter_init);
+  if (g_shim == nullptr) return 0;
+  PyGILState_STATE gil = PyGILState_Ensure();
+  mlsl_handle_t handle = 0;
+  PyObject* res = PyObject_CallMethod(
+      g_shim, "dist_collective_start", "LsLLLLLL", (long long)dist, kind,
+      (long long)(intptr_t)send, (long long)count, (long long)dt, (long long)op,
+      (long long)root, (long long)group);
+  if (res != nullptr) {
+    handle = (mlsl_handle_t)PyLong_AsUnsignedLongLong(res);
+    if (PyErr_Occurred()) {
+      PyErr_Print();
+      handle = 0;
+    }
+    Py_DECREF(res);
+  } else {
+    PyErr_Print();
+  }
+  PyGILState_Release(gil);
+  return handle;
+}
+
+}  // namespace
+
+extern "C" {
+
+int mlsl_environment_init(void) {
+  return (int)call_i("env_init", {});
+}
+
+int mlsl_environment_finalize(void) {
+  return (int)call_i("env_finalize", {});
+}
+
+int64_t mlsl_environment_get_process_count(void) {
+  return call_i("env_process_count", {});
+}
+
+mlsl_handle_t mlsl_environment_create_distribution(int64_t d, int64_t m,
+                                                   int64_t s) {
+  return (mlsl_handle_t)call_i("env_create_distribution", {d, m, s}, 0);
+}
+
+mlsl_handle_t mlsl_environment_create_session(void) {
+  return (mlsl_handle_t)call_i("env_create_session", {}, 0);
+}
+
+int64_t mlsl_distribution_get_process_count(mlsl_handle_t dist,
+                                            mlsl_group_type_t group) {
+  return call_i("dist_process_count", {(int64_t)dist, (int64_t)group});
+}
+
+mlsl_handle_t mlsl_distribution_all_reduce(mlsl_handle_t dist, const void* send,
+                                           int64_t count, mlsl_data_type_t dt,
+                                           mlsl_reduction_t op,
+                                           mlsl_group_type_t group) {
+  return collective_start(dist, "allreduce", send, count, dt, op, 0, group);
+}
+
+mlsl_handle_t mlsl_distribution_bcast(mlsl_handle_t dist, const void* send,
+                                      int64_t count, mlsl_data_type_t dt,
+                                      int64_t root, mlsl_group_type_t group) {
+  return collective_start(dist, "bcast", send, count, dt, 0, root, group);
+}
+
+mlsl_handle_t mlsl_distribution_all_gather(mlsl_handle_t dist, const void* send,
+                                           int64_t send_count,
+                                           mlsl_data_type_t dt,
+                                           mlsl_group_type_t group) {
+  return collective_start(dist, "allgather", send, send_count, dt, 0, 0, group);
+}
+
+mlsl_handle_t mlsl_distribution_reduce_scatter(
+    mlsl_handle_t dist, const void* send, int64_t send_count,
+    mlsl_data_type_t dt, mlsl_reduction_t op, mlsl_group_type_t group) {
+  return collective_start(dist, "reduce_scatter", send, send_count, dt, op, 0,
+                          group);
+}
+
+mlsl_handle_t mlsl_distribution_all_to_all(mlsl_handle_t dist, const void* send,
+                                           int64_t send_count,
+                                           mlsl_data_type_t dt,
+                                           mlsl_group_type_t group) {
+  return collective_start(dist, "alltoall", send, send_count, dt, 0, 0, group);
+}
+
+int mlsl_distribution_barrier(mlsl_handle_t dist, mlsl_group_type_t group) {
+  return (int)call_i("dist_barrier", {(int64_t)dist, (int64_t)group});
+}
+
+int mlsl_request_wait(mlsl_handle_t req, void* recv, int64_t recv_count,
+                      mlsl_data_type_t dt) {
+  return (int)call_i("request_wait",
+                     {(int64_t)req, (int64_t)(intptr_t)recv, recv_count,
+                      (int64_t)dt});
+}
+
+int mlsl_request_test(mlsl_handle_t req) {
+  return (int)call_i("request_test", {(int64_t)req});
+}
+
+int mlsl_session_set_global_minibatch_size(mlsl_handle_t sess, int64_t size) {
+  return (int)call_i("session_set_minibatch", {(int64_t)sess, size});
+}
+
+mlsl_handle_t mlsl_session_create_operation_reg_info(mlsl_handle_t sess,
+                                                     mlsl_op_type_t op_type) {
+  return (mlsl_handle_t)call_i("session_create_reginfo",
+                               {(int64_t)sess, (int64_t)op_type}, 0);
+}
+
+int64_t mlsl_operation_reg_info_add_input(mlsl_handle_t reg, int64_t count,
+                                          int64_t size, mlsl_data_type_t dt) {
+  return call_i("reginfo_add_input", {(int64_t)reg, count, size, (int64_t)dt});
+}
+
+int64_t mlsl_operation_reg_info_add_output(mlsl_handle_t reg, int64_t count,
+                                           int64_t size, mlsl_data_type_t dt) {
+  return call_i("reginfo_add_output", {(int64_t)reg, count, size, (int64_t)dt});
+}
+
+int64_t mlsl_operation_reg_info_add_parameter_set(
+    mlsl_handle_t reg, int64_t kernel_count, int64_t kernel_size,
+    mlsl_data_type_t dt, int dist_update, mlsl_compression_t comp) {
+  return call_i("reginfo_add_parameter_set",
+                {(int64_t)reg, kernel_count, kernel_size, (int64_t)dt,
+                 (int64_t)dist_update, (int64_t)comp});
+}
+
+mlsl_handle_t mlsl_session_add_operation(mlsl_handle_t sess, mlsl_handle_t reg,
+                                         mlsl_handle_t dist) {
+  return (mlsl_handle_t)call_i(
+      "session_add_operation", {(int64_t)sess, (int64_t)reg, (int64_t)dist}, 0);
+}
+
+int mlsl_session_commit(mlsl_handle_t sess) {
+  return (int)call_i("session_commit", {(int64_t)sess});
+}
+
+int mlsl_operation_set_next(mlsl_handle_t op, mlsl_handle_t next,
+                            int64_t out_idx, int64_t in_idx) {
+  return (int)call_i("operation_set_next",
+                     {(int64_t)op, (int64_t)next, out_idx, in_idx});
+}
+
+int64_t mlsl_operation_get_local_minibatch_size(mlsl_handle_t op) {
+  return call_i("operation_local_minibatch", {(int64_t)op});
+}
+
+int64_t mlsl_operation_get_parameter_local_count(mlsl_handle_t op,
+                                                 int64_t idx) {
+  return call_i("operation_param_local_count", {(int64_t)op, idx});
+}
+
+int64_t mlsl_operation_get_parameter_owned_count(mlsl_handle_t op,
+                                                 int64_t idx) {
+  return call_i("operation_param_owned_count", {(int64_t)op, idx});
+}
+
+int mlsl_parameter_set_start_gradient_comm(mlsl_handle_t op, int64_t ps_idx,
+                                           const void* grads,
+                                           mlsl_data_type_t dt) {
+  return (int)call_i(
+      "param_start_gradient_comm",
+      {(int64_t)op, ps_idx, (int64_t)(intptr_t)grads, (int64_t)dt});
+}
+
+int64_t mlsl_parameter_set_wait_gradient_comm(mlsl_handle_t op, int64_t ps_idx,
+                                              void* recv, mlsl_data_type_t dt) {
+  return call_i("param_wait_gradient_comm",
+                {(int64_t)op, ps_idx, (int64_t)(intptr_t)recv, (int64_t)dt});
+}
+
+int mlsl_handle_release(mlsl_handle_t h) {
+  return (int)call_i("handle_release", {(int64_t)h});
+}
+
+}  /* extern "C" */

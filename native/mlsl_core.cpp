@@ -1,0 +1,214 @@
+/* mlsl_core implementation — see mlsl_core.h for the contract and the
+ * reference file:line provenance of each algorithm. */
+
+#include "mlsl_core.h"
+
+#include <algorithm>
+#include <deque>
+#include <mutex>
+#include <unordered_set>
+
+/* ---- grid math ---- */
+
+int mlsl_grid_coords(int64_t rank, int64_t data_parts, int64_t seq_parts,
+                     int64_t model_parts, int64_t coords[4]) {
+  if (data_parts <= 0 || seq_parts <= 0 || model_parts <= 0 || rank < 0)
+    return MLSL_FAIL;
+  const int64_t l_size = data_parts * seq_parts * model_parts;
+  const int64_t l_id = rank % l_size;
+  coords[0] = rank / l_size;                       /* replica */
+  coords[1] = l_id / (model_parts * seq_parts);    /* data */
+  coords[2] = (l_id / model_parts) % seq_parts;    /* seq */
+  coords[3] = l_id % model_parts;                  /* model */
+  return MLSL_OK;
+}
+
+int64_t mlsl_grid_rank(const int64_t c[4], int64_t data_parts,
+                       int64_t seq_parts, int64_t model_parts) {
+  return ((c[0] * data_parts + c[1]) * seq_parts + c[2]) * model_parts + c[3];
+}
+
+int mlsl_grid_colors(int64_t rank, int64_t data_parts, int64_t model_parts,
+                     int64_t* data_color, int64_t* model_color,
+                     int64_t* replica_color) {
+  /* exact reference formulas (src/mlsl_impl.hpp:224-240), seq absent there */
+  if (data_parts <= 0 || model_parts <= 0 || rank < 0) return MLSL_FAIL;
+  const int64_t l_size = data_parts * model_parts;
+  const int64_t l_id = rank % l_size;
+  const int64_t i_r = rank / l_size;
+  const int64_t i_m = l_id / model_parts;
+  const int64_t i_f = l_id % model_parts;
+  if (model_color) *model_color = i_r * l_size + i_m;
+  if (data_color) *data_color = i_r * l_size + i_f;
+  if (replica_color) *replica_color = l_id;
+  return MLSL_OK;
+}
+
+/* ---- case selection (reference src/mlsl_impl.cpp:139-241) ---- */
+
+int mlsl_select_case(int out_need_reduce, int same_dist, int64_t world,
+                     int64_t out_data, int64_t out_model, int64_t in_data,
+                     int64_t in_model) {
+  const bool need_comm = world > 1 && (out_need_reduce || !same_dist);
+  if (!need_comm) return 0;
+  if (out_need_reduce && same_dist) return 1;
+  if (out_need_reduce && in_model == 1 && out_data == in_data) return 2;
+  if (out_need_reduce && in_model == 1 && out_data > 0 &&
+      in_data % out_data == 0 && in_data == out_model * out_data)
+    return 3;
+  if (!out_need_reduce && out_model == 1) return 4;
+  if (!out_need_reduce && in_model == 1) return 5;
+  return MLSL_FAIL;
+}
+
+/* ---- block layouts (reference src/mlsl_impl.cpp:243-347) ---- */
+
+int mlsl_blocks_pack_reduce_scatter(int64_t model_parts, int64_t local_mb,
+                                    int64_t local_fm, int64_t fm_size,
+                                    mlsl_block_t* out) {
+  if (model_parts <= 0 || local_fm % model_parts != 0) return MLSL_FAIL;
+  const int64_t fm = local_fm / model_parts;
+  for (int64_t i = 0; i < model_parts; ++i)
+    out[i] = {0, local_mb, i * fm, fm, fm_size, i * local_mb * fm * fm_size};
+  return MLSL_OK;
+}
+
+int mlsl_blocks_pack_reduce_scatter2(int64_t model_parts, int64_t local_mb,
+                                     int64_t local_fm, int64_t fm_size,
+                                     mlsl_block_t* out) {
+  if (model_parts <= 0 || local_mb % model_parts != 0) return MLSL_FAIL;
+  const int64_t mb = local_mb / model_parts;
+  for (int64_t i = 0; i < model_parts; ++i)
+    out[i] = {i * mb, mb, 0, local_fm, fm_size, i * mb * local_fm * fm_size};
+  return MLSL_OK;
+}
+
+int mlsl_blocks_unpack_allgather(int64_t model_parts, int64_t local_mb,
+                                 int64_t local_fm, int64_t fm_size,
+                                 mlsl_block_t* out) {
+  return mlsl_blocks_pack_reduce_scatter(model_parts, local_mb, local_fm,
+                                         fm_size, out);
+}
+
+int mlsl_blocks_unpack_allgather2(int64_t model_parts, int64_t local_mb,
+                                  int64_t local_fm, int64_t fm_size,
+                                  mlsl_block_t* out) {
+  return mlsl_blocks_pack_reduce_scatter2(model_parts, local_mb, local_fm,
+                                          fm_size, out);
+}
+
+int64_t mlsl_blocks_alltoall(int64_t my_local_mb, int64_t my_local_fm,
+                             int64_t my_fm_size, int64_t other_local_mb,
+                             int64_t other_local_fm, int64_t other_fm_size,
+                             mlsl_block_t* out) {
+  const int64_t local_mb = std::min(my_local_mb, other_local_mb);
+  const int64_t fmx =
+      std::min(my_local_fm * my_fm_size, other_local_fm * other_fm_size);
+  if (local_mb <= 0 || fmx <= 0 || fmx % my_fm_size != 0) return MLSL_FAIL;
+  const int64_t my_fm = fmx / my_fm_size;
+  int64_t idx = 0;
+  for (int64_t i = 0; i < my_local_mb; i += local_mb)
+    for (int64_t j = 0; j < my_local_fm; j += my_fm) {
+      if (out)
+        out[idx] = {i, local_mb, j, my_fm, my_fm_size, idx * local_mb * fmx};
+      ++idx;
+    }
+  return idx;
+}
+
+/* ---- parameter-set partitioning ---- */
+
+int mlsl_param_partition(int64_t global_kernel_count, int64_t model_parts,
+                         int64_t grad_group_size, int distributed_update,
+                         mlsl_param_part_t* out) {
+  if (model_parts <= 0 || grad_group_size <= 0 ||
+      global_kernel_count % model_parts != 0)
+    return MLSL_FAIL;
+  int64_t local = global_kernel_count / model_parts;
+  int64_t owned = local;
+  if (distributed_update) {
+    owned = (local + grad_group_size - 1) / grad_group_size;
+    local = owned * grad_group_size; /* padded (reference :403-405) */
+  }
+  out->local_kernel_count = local;
+  out->owned_kernel_count = owned;
+  out->need_comm = grad_group_size > 1 ? 1 : 0;
+  return MLSL_OK;
+}
+
+/* ---- priority scheduler ---- */
+
+struct mlsl_sched {
+  int64_t threshold;
+  bool lifo;
+  std::deque<uint64_t> q;
+  std::mutex mu;
+};
+
+mlsl_sched_t* mlsl_sched_create(int64_t threshold, int lifo) {
+  auto* s = new mlsl_sched();
+  s->threshold = threshold;
+  s->lifo = lifo != 0;
+  return s;
+}
+
+void mlsl_sched_destroy(mlsl_sched_t* s) { delete s; }
+
+int mlsl_sched_submit(mlsl_sched_t* s, uint64_t req_id, int64_t bytes) {
+  if (bytes <= s->threshold) return 1; /* small: dispatch immediately */
+  std::lock_guard<std::mutex> lk(s->mu);
+  /* a restart supersedes the stale entry */
+  for (auto it = s->q.begin(); it != s->q.end();) {
+    if (*it == req_id)
+      it = s->q.erase(it);
+    else
+      ++it;
+  }
+  s->q.push_back(req_id);
+  return 0;
+}
+
+int mlsl_sched_next(mlsl_sched_t* s, uint64_t* req_id) {
+  std::lock_guard<std::mutex> lk(s->mu);
+  if (s->q.empty()) return 0;
+  if (s->lifo) {
+    *req_id = s->q.back();
+    s->q.pop_back();
+  } else {
+    *req_id = s->q.front();
+    s->q.pop_front();
+  }
+  return 1;
+}
+
+int64_t mlsl_sched_pending(mlsl_sched_t* s) {
+  std::lock_guard<std::mutex> lk(s->mu);
+  return static_cast<int64_t>(s->q.size());
+}
+
+/* ---- request storage ---- */
+
+struct mlsl_reqstore {
+  std::unordered_set<uint64_t> live;
+  std::mutex mu;
+};
+
+mlsl_reqstore_t* mlsl_reqstore_create(void) { return new mlsl_reqstore(); }
+void mlsl_reqstore_destroy(mlsl_reqstore_t* r) { delete r; }
+
+void mlsl_reqstore_register(mlsl_reqstore_t* r, uint64_t req_id) {
+  std::lock_guard<std::mutex> lk(r->mu);
+  r->live.insert(req_id);
+}
+
+void mlsl_reqstore_remove(mlsl_reqstore_t* r, uint64_t req_id) {
+  std::lock_guard<std::mutex> lk(r->mu);
+  r->live.erase(req_id);
+}
+
+int64_t mlsl_reqstore_size(mlsl_reqstore_t* r) {
+  std::lock_guard<std::mutex> lk(r->mu);
+  return static_cast<int64_t>(r->live.size());
+}
+
+const char* mlsl_core_version(void) { return "mlsl_core 0.1.0"; }

@@ -1,0 +1,130 @@
+/* mlsl_core — native control plane for the TPU MLSL framework.
+ *
+ * C++ implementation of the framework's DL-semantics bookkeeping, mirroring the
+ * role the reference implements in src/mlsl_impl.{hpp,cpp}: process-grid math,
+ * activation peer-connection case selection (the five topology cases,
+ * reference src/mlsl_impl.cpp:139-241), CommBlockInfo pack/unpack layouts
+ * (:243-347), parameter-set partitioning (:388-444), a newest-first priority
+ * dispatch queue (the eplib allreduce_pr scheduling capability,
+ * eplib/allreduce_pr.c:76-79) and request storage (src/mlsl_impl.hpp:60-94).
+ *
+ * The XLA data plane (collective execution) stays in Python/JAX; this library
+ * is the graph-builder/scheduler control plane, consumed via ctypes
+ * (the reference's flat-C + ctypes binding pattern, src/c_bind.cpp +
+ * include/mlsl/mlsl.py).
+ */
+
+#ifndef MLSL_CORE_H
+#define MLSL_CORE_H
+
+#include <stddef.h>
+#include <stdint.h>
+
+#ifdef __cplusplus
+extern "C" {
+#endif
+
+#define MLSL_OK 0
+#define MLSL_FAIL -1
+
+/* ---- grid math (reference src/mlsl_impl.hpp:224-266, + seq axis) ---- */
+
+/* global rank -> (replica, data, seq, model); returns MLSL_OK. */
+int mlsl_grid_coords(int64_t rank, int64_t data_parts, int64_t seq_parts,
+                     int64_t model_parts, int64_t coords[4]);
+
+/* (replica, data, seq, model) -> global rank. */
+int64_t mlsl_grid_rank(const int64_t coords[4], int64_t data_parts,
+                       int64_t seq_parts, int64_t model_parts);
+
+/* reference color formulas: fills data_color, model_color, replica_color. */
+int mlsl_grid_colors(int64_t rank, int64_t data_parts, int64_t model_parts,
+                     int64_t* data_color, int64_t* model_color,
+                     int64_t* replica_color);
+
+/* ---- activation peer-connection case selection ---- */
+
+/* Returns 1..5 (the case), or 0 if no comm is needed, or MLSL_FAIL if the
+ * topology combination is unsupported. Mirrors reference
+ * src/mlsl_impl.cpp:139-241 / mlsl_tpu/core/activation.py. */
+int mlsl_select_case(int out_need_reduce, int same_dist, int64_t world,
+                     int64_t out_data, int64_t out_model,
+                     int64_t in_data, int64_t in_model);
+
+/* ---- CommBlockInfo layouts ---- */
+
+typedef struct {
+  int64_t mb_offset;
+  int64_t mb_count;
+  int64_t fm_offset;
+  int64_t fm_count;
+  int64_t fm_size;
+  int64_t buf_offset;
+} mlsl_block_t;
+
+/* Fill pack blocks for ReduceScatter (case 1). n_blocks == model_parts. */
+int mlsl_blocks_pack_reduce_scatter(int64_t model_parts, int64_t local_mb,
+                                    int64_t local_fm, int64_t fm_size,
+                                    mlsl_block_t* out);
+int mlsl_blocks_pack_reduce_scatter2(int64_t model_parts, int64_t local_mb,
+                                     int64_t local_fm, int64_t fm_size,
+                                     mlsl_block_t* out);
+int mlsl_blocks_unpack_allgather(int64_t model_parts, int64_t local_mb,
+                                 int64_t local_fm, int64_t fm_size,
+                                 mlsl_block_t* out);
+int mlsl_blocks_unpack_allgather2(int64_t model_parts, int64_t local_mb,
+                                  int64_t local_fm, int64_t fm_size,
+                                  mlsl_block_t* out);
+/* AlltoAll block build (reference :313-347). Returns block count or MLSL_FAIL.
+ * out may be NULL to query the count. */
+int64_t mlsl_blocks_alltoall(int64_t my_local_mb, int64_t my_local_fm,
+                             int64_t my_fm_size, int64_t other_local_mb,
+                             int64_t other_local_fm, int64_t other_fm_size,
+                             mlsl_block_t* out);
+
+/* ---- parameter-set partitioning (reference src/mlsl_impl.cpp:388-444) ---- */
+
+typedef struct {
+  int64_t local_kernel_count;  /* possibly padded when distributed_update */
+  int64_t owned_kernel_count;
+  int64_t need_comm;           /* 0/1 */
+} mlsl_param_part_t;
+
+int mlsl_param_partition(int64_t global_kernel_count, int64_t model_parts,
+                         int64_t grad_group_size, int distributed_update,
+                         mlsl_param_part_t* out);
+
+/* ---- priority dispatch queue ---- */
+
+/* Opaque scheduler. Requests above `threshold` bytes are deferred and flushed
+ * newest-first (LIFO) when lifo != 0, FIFO otherwise; submissions at or below
+ * the threshold dispatch immediately (return 1). A resubmitted id supersedes
+ * its stale queue entry. */
+typedef struct mlsl_sched mlsl_sched_t;
+
+mlsl_sched_t* mlsl_sched_create(int64_t threshold, int lifo);
+void mlsl_sched_destroy(mlsl_sched_t* s);
+/* returns 1 = dispatch now, 0 = deferred */
+int mlsl_sched_submit(mlsl_sched_t* s, uint64_t req_id, int64_t bytes);
+/* pops the next deferred request to dispatch; returns 0 when empty */
+int mlsl_sched_next(mlsl_sched_t* s, uint64_t* req_id);
+int64_t mlsl_sched_pending(mlsl_sched_t* s);
+
+/* ---- request storage (reference src/mlsl_impl.hpp:60-94) ---- */
+
+typedef struct mlsl_reqstore mlsl_reqstore_t;
+
+mlsl_reqstore_t* mlsl_reqstore_create(void);
+void mlsl_reqstore_destroy(mlsl_reqstore_t* r);
+void mlsl_reqstore_register(mlsl_reqstore_t* r, uint64_t req_id);
+void mlsl_reqstore_remove(mlsl_reqstore_t* r, uint64_t req_id);
+int64_t mlsl_reqstore_size(mlsl_reqstore_t* r);
+
+/* library version for the ctypes loader's sanity check */
+const char* mlsl_core_version(void);
+
+#ifdef __cplusplus
+}
+#endif
+
+#endif /* MLSL_CORE_H */

@@ -1,0 +1,107 @@
+/* End-to-end C consumer of the mlsl_tpu C API: allreduce through a
+ * Distribution + a 2-op Session with gradient sync — the same flow as the
+ * reference's cmlsl_test (tests/examples/mlsl_test/cmlsl_test.c), compressed.
+ * Exits 0 on success; prints FAILED lines otherwise. */
+
+#include <stdio.h>
+#include <stdlib.h>
+#include <string.h>
+
+#include "../include/mlsl_tpu.h"
+
+#define CHECK(cond, msg)                              \
+  do {                                                \
+    if (!(cond)) {                                    \
+      fprintf(stderr, "FAILED: %s\n", msg);           \
+      return 1;                                       \
+    }                                                 \
+  } while (0)
+
+int main(void) {
+  CHECK(mlsl_environment_init() == MLSL_TPU_SUCCESS, "env init");
+  int64_t world = mlsl_environment_get_process_count();
+  CHECK(world >= 1, "process count");
+  printf("world = %lld\n", (long long)world);
+
+  mlsl_handle_t dist = mlsl_environment_create_distribution(world, 1, 1);
+  CHECK(dist != 0, "create distribution");
+  CHECK(mlsl_distribution_get_process_count(dist, MLSL_GT_DATA) == world,
+        "data group size");
+
+  /* allreduce: rank p contributes p+1 everywhere; expect world*(world+1)/2 */
+  const int64_t n = 16;
+  float* send = malloc(sizeof(float) * world * n);
+  float* recv = malloc(sizeof(float) * world * n);
+  for (int64_t p = 0; p < world; ++p)
+    for (int64_t i = 0; i < n; ++i) send[p * n + i] = (float)(p + 1);
+  mlsl_handle_t req = mlsl_distribution_all_reduce(dist, send, n, MLSL_DT_FLOAT,
+                                                   MLSL_RT_SUM, MLSL_GT_DATA);
+  CHECK(req != 0, "allreduce start");
+  CHECK(mlsl_request_wait(req, recv, n, MLSL_DT_FLOAT) == MLSL_TPU_SUCCESS,
+        "allreduce wait");
+  float expect = (float)(world * (world + 1) / 2);
+  for (int64_t p = 0; p < world; ++p)
+    for (int64_t i = 0; i < n; ++i)
+      CHECK(recv[p * n + i] == expect, "allreduce value");
+  printf("allreduce OK (%.0f)\n", expect);
+
+  /* session graph with per-layer gradient sync */
+  mlsl_handle_t sess = mlsl_environment_create_session();
+  CHECK(sess != 0, "create session");
+  CHECK(mlsl_session_set_global_minibatch_size(sess, 4 * world) == 0, "set mb");
+
+  mlsl_handle_t reg1 = mlsl_session_create_operation_reg_info(sess, MLSL_OT_CC);
+  mlsl_operation_reg_info_add_input(reg1, 8, 4, MLSL_DT_FLOAT);
+  mlsl_operation_reg_info_add_output(reg1, 8, 4, MLSL_DT_FLOAT);
+  mlsl_operation_reg_info_add_parameter_set(reg1, 64, 1, MLSL_DT_FLOAT, 0,
+                                            MLSL_CT_NONE);
+  mlsl_handle_t op1 = mlsl_session_add_operation(sess, reg1, dist);
+  CHECK(op1 != 0, "add op1");
+
+  mlsl_handle_t reg2 = mlsl_session_create_operation_reg_info(sess, MLSL_OT_CC);
+  mlsl_operation_reg_info_add_input(reg2, 8, 4, MLSL_DT_FLOAT);
+  mlsl_operation_reg_info_add_output(reg2, 8, 4, MLSL_DT_FLOAT);
+  mlsl_operation_reg_info_add_parameter_set(reg2, 64, 1, MLSL_DT_FLOAT, 1,
+                                            MLSL_CT_NONE);
+  mlsl_handle_t op2 = mlsl_session_add_operation(sess, reg2, dist);
+  CHECK(op2 != 0, "add op2");
+  CHECK(mlsl_operation_set_next(op1, op2, 0, 0) == 0, "wire edge");
+  CHECK(mlsl_session_commit(sess) == 0, "commit");
+
+  int64_t cnt = mlsl_operation_get_parameter_local_count(op1, 0);
+  CHECK(cnt == 64, "param local count");
+  float* grads = malloc(sizeof(float) * world * cnt);
+  for (int64_t p = 0; p < world; ++p)
+    for (int64_t i = 0; i < cnt; ++i) grads[p * cnt + i] = (float)i;
+  CHECK(mlsl_parameter_set_start_gradient_comm(op1, 0, grads, MLSL_DT_FLOAT) ==
+            0, "start grad comm");
+  float* gout = malloc(sizeof(float) * world * cnt);
+  int64_t got = mlsl_parameter_set_wait_gradient_comm(op1, 0, gout,
+                                                      MLSL_DT_FLOAT);
+  if (world > 1) {
+    CHECK(got == cnt, "grad recv count");
+    for (int64_t i = 0; i < cnt; ++i)
+      CHECK(gout[i] == (float)(i * world), "grad value");
+    printf("grad allreduce OK\n");
+    /* distributed-update op: reduce-scattered owned shard */
+    int64_t owned = mlsl_operation_get_parameter_owned_count(op2, 0);
+    int64_t local2 = mlsl_operation_get_parameter_local_count(op2, 0);
+    CHECK(owned * world == local2, "owned partitioning");
+    CHECK(mlsl_parameter_set_start_gradient_comm(op2, 0, grads, MLSL_DT_FLOAT)
+          == 0, "du start");
+    int64_t got2 = mlsl_parameter_set_wait_gradient_comm(op2, 0, gout,
+                                                         MLSL_DT_FLOAT);
+    CHECK(got2 == owned, "du recv count");
+    printf("distributed-update reduce-scatter OK (owned=%lld)\n",
+           (long long)owned);
+  } else {
+    CHECK(got == 0, "no comm on single process");
+    printf("single-process no-comm OK\n");
+  }
+
+  CHECK(mlsl_distribution_barrier(dist, MLSL_GT_GLOBAL) == 0, "barrier");
+  CHECK(mlsl_environment_finalize() == 0, "finalize");
+  printf("C API TEST PASSED\n");
+  free(send); free(recv); free(grads); free(gout);
+  return 0;
+}

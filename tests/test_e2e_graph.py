@@ -1,0 +1,211 @@
+"""End-to-end graph tests in the style of the reference's mlsl_test
+(tests/examples/mlsl_test/mlsl_test.cpp): a 2-layer network driven through the
+Forward/Backward phases with algebraic fill patterns, over the configuration matrix
+{model group count} x {distributed update} x {compression} (reference Makefile matrix
+:56-105). Every rank's buffers are deterministic functions of (rank, index), so
+expected wire contents are computed per rank with NumPy and compared exactly.
+"""
+
+import numpy as np
+import pytest
+
+from mlsl_tpu.core.activation import pack_local, unpack_local
+from mlsl_tpu.types import CompressionType, DataType, GroupType, OpType, ReductionType
+
+MB = 8          # global minibatch
+FM1, FM2 = 16, 8
+FM_SIZE = 4
+
+
+def _build_net(env, dist, distributed_update=False, compression=CompressionType.NONE):
+    s = env.create_session()
+    s.set_global_minibatch_size(MB)
+    r1 = s.create_operation_reg_info(OpType.CC)
+    r1.add_input(FM1, FM_SIZE)
+    r1.add_output(FM2, FM_SIZE)
+    r1.add_parameter_set(FM1 * FM2, 1, distributed_update=distributed_update,
+                         compression_type=compression)
+    op1 = s.get_operation(s.add_operation(r1, dist))
+    r2 = s.create_operation_reg_info(OpType.CC)
+    r2.add_input(FM2, FM_SIZE)
+    r2.add_output(FM1, FM_SIZE)
+    r2.add_parameter_set(FM2 * FM1, 1, distributed_update=distributed_update,
+                         compression_type=compression)
+    op2 = s.get_operation(s.add_operation(r2, dist))
+    op1.set_next(op2, 0, 0)
+    s.commit()
+    return s, op1, op2
+
+
+def _rank_fill(p, n):
+    return (p * 1000.0 + np.arange(n, dtype=np.float64)).astype(np.float32)
+
+
+@pytest.mark.parametrize("model_parts", [1, 2, 4])
+def test_forward_activation_exchange_case1(env, model_parts):
+    """Case 1 (same dist, CC output needs reduce): pack -> ReduceScatter over the
+    model group -> unpack must reproduce the per-rank NumPy simulation."""
+    data_parts = 8 // model_parts
+    dist = env.create_distribution(data_parts, model_parts)
+    s, op1, op2 = _build_net(env, dist)
+    out_act, in_act = op1.get_output(0), op2.get_input(0)
+    if model_parts == 1:
+        assert not out_act.need_comm  # no comm on a degenerate model group
+        return
+
+    local_mb = op1.get_local_minibatch_size()
+    # out activation: CC output holds ALL feature maps as partial sums
+    n_local = local_mb * out_act.local_fm_count * out_act.fm_size
+    assert out_act.local_fm_count == FM2
+
+    # every rank packs its local activation into the wire layout
+    wires = {}
+    for p in range(8):
+        act = _rank_fill(p, n_local).reshape(local_mb, FM2, FM_SIZE)
+        wires[p] = pack_local(
+            act, out_act.pack_blocks, local_mb, FM2, FM_SIZE
+        )
+    buf = dist.make_buffer(lambda p: np.asarray(wires[p]), n_local)
+
+    out_act.start_comm(buf)
+    received = in_act.wait_comm()
+    assert received is not None
+
+    # oracle: reduce_scatter over each model group, then unpack
+    for p in range(8):
+        g = dist.model_group
+        members = [q for q in range(8)
+                   if dist.topology.coords(q)[:3] == dist.topology.coords(p)[:3]]
+        members.sort(key=g.group_idx_of)
+        summed = sum(np.asarray(wires[q], np.float32) for q in members)
+        my = g.group_idx_of(p)
+        rc = n_local // model_parts
+        want_wire = summed[my * rc:(my + 1) * rc]
+        got_wire = np.asarray(dist.local_part(received, p))
+        np.testing.assert_allclose(got_wire, want_wire, rtol=1e-6)
+        # unpack into the input activation layout (localFm = FM2 / modelParts)
+        got_act = unpack_local(
+            got_wire, in_act.unpack_blocks, local_mb, in_act.local_fm_count, FM_SIZE
+        )
+        assert got_act.shape == (local_mb, FM2 // model_parts, FM_SIZE)
+
+
+@pytest.mark.parametrize("model_parts", [2, 4])
+def test_backward_activation_exchange_case1(env, model_parts):
+    """Case 1 backward: AllGather over the model group (input owns BPROP)."""
+    data_parts = 8 // model_parts
+    dist = env.create_distribution(data_parts, model_parts)
+    s, op1, op2 = _build_net(env, dist)
+    out_act, in_act = op1.get_output(0), op2.get_input(0)
+    local_mb = op1.get_local_minibatch_size()
+    n_local = local_mb * in_act.local_fm_count * in_act.fm_size
+
+    grads = {p: _rank_fill(p, n_local) for p in range(8)}
+    buf = dist.make_buffer(lambda p: grads[p], n_local)
+    in_act.start_comm(buf)          # BPROP: input activation owns the request
+    received = out_act.wait_comm()  # output waits on the peer's request
+    assert received is not None
+
+    for p in range(8):
+        g = dist.model_group
+        members = [q for q in range(8)
+                   if dist.topology.coords(q)[:3] == dist.topology.coords(p)[:3]]
+        members.sort(key=g.group_idx_of)
+        want = np.concatenate([grads[q] for q in members])
+        np.testing.assert_allclose(
+            np.asarray(dist.local_part(received, p)), want, rtol=1e-6
+        )
+
+
+def test_redistribution_case4_and_5(env):
+    """Edges between different distributions: AlltoAll redistribution (no reduce)."""
+    dist_a = env.create_distribution(8, 1)  # pure data-parallel
+    dist_b = env.create_distribution(2, 4)  # hybrid
+    s = env.create_session()
+    s.set_global_minibatch_size(MB)
+    r1 = s.create_operation_reg_info(OpType.ACT)   # no reduce on output
+    r1.add_input(FM1, FM_SIZE)
+    r1.add_output(FM1, FM_SIZE)
+    op1 = s.get_operation(s.add_operation(r1, dist_a))
+    r2 = s.create_operation_reg_info(OpType.ACT)
+    r2.add_input(FM1, FM_SIZE)
+    r2.add_output(FM1, FM_SIZE)
+    op2 = s.get_operation(s.add_operation(r2, dist_b))
+    op1.set_next(op2, 0, 0)
+    s.commit()
+    out_act, in_act = op1.get_output(0), op2.get_input(0)
+    # case 4: out model group == 1, AlltoAll over IN dist's model group
+    assert out_act.need_comm and out_act.comm_req is not None
+    assert out_act.comm_req.desc.kind == "alltoall"
+    assert out_act.comm_req.desc.group is dist_b.model_group
+    # block layouts cover the full local activation
+    total_pack = sum(b.mb_count * b.fm_count * b.fm_size for b in out_act.pack_blocks)
+    assert total_pack == op1.get_local_minibatch_size() * out_act.local_fm_count * FM_SIZE
+
+    # reversed direction -> case 5
+    s2 = env.create_session()
+    s2.set_global_minibatch_size(MB)
+    r3 = s2.create_operation_reg_info(OpType.ACT)
+    r3.add_input(FM1, FM_SIZE)
+    r3.add_output(FM1, FM_SIZE)
+    op3 = s2.get_operation(s2.add_operation(r3, dist_b))
+    r4 = s2.create_operation_reg_info(OpType.ACT)
+    r4.add_input(FM1, FM_SIZE)
+    r4.add_output(FM1, FM_SIZE)
+    op4 = s2.get_operation(s2.add_operation(r4, dist_a))
+    op3.set_next(op4, 0, 0)
+    s2.commit()
+    assert op3.get_output(0).comm_req.desc.group is dist_b.model_group
+
+
+@pytest.mark.parametrize("model_parts", [1, 2, 4])
+@pytest.mark.parametrize("dist_update", [False, True])
+@pytest.mark.parametrize("quant", [False, True])
+def test_training_phases_matrix(env, model_parts, dist_update, quant):
+    """The reference's full matrix (Makefile run loop): 2 epochs x 3 minibatches of
+    Forward/Backward/Update with gradient sync; gradients follow the algebraic
+    pattern so the reduced values have closed form."""
+    if quant and dist_update:
+        pytest.skip("reference exercises quant on the plain allreduce path")
+    data_parts = 8 // model_parts
+    dist = env.create_distribution(data_parts, model_parts)
+    comp = CompressionType.QUANTIZATION if quant else CompressionType.NONE
+    s, op1, op2 = _build_net(env, dist, distributed_update=dist_update,
+                             compression=comp)
+
+    for epoch in range(2):
+        for mb in range(3):
+            for op in (op2, op1):  # backward order
+                ps = op.get_parameter_set(0)
+                n = ps.get_local_kernel_count() * ps.get_kernel_size()
+                scale = 1.0 + epoch + 0.1 * mb
+                grads = {
+                    p: scale * _rank_fill(p, n) for p in range(8)
+                }
+                buf = dist.make_buffer(lambda p: grads[p], n)
+                ps.start_gradient_comm(buf)
+                out = ps.wait_gradient_comm()
+                if data_parts == 1:
+                    assert out is None  # no comm needed
+                    continue
+                g = dist.grad_group
+                for p in range(8):
+                    members = [
+                        q for q in range(8)
+                        if dist.topology.coords(q)[3] == dist.topology.coords(p)[3]
+                        and dist.topology.coords(q)[0] == dist.topology.coords(p)[0]
+                    ]
+                    members.sort(key=g.group_idx_of)
+                    want_full = sum(np.asarray(grads[q], np.float64) for q in members)
+                    got = np.asarray(dist.local_part(out, p), np.float64)
+                    if dist_update:
+                        my = g.group_idx_of(p)
+                        owned = ps.get_owned_kernel_count() * ps.get_kernel_size()
+                        want = want_full[my * owned:(my + 1) * owned]
+                    else:
+                        want = want_full
+                    if quant:
+                        rel = np.linalg.norm(got - want) / (np.linalg.norm(want) + 1e-9)
+                        assert rel < 0.02, rel
+                    else:
+                        np.testing.assert_allclose(got, want, rtol=1e-6)

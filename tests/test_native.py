@@ -1,0 +1,157 @@
+"""Native control plane: C++ and Python implementations must agree exactly."""
+
+import ctypes
+
+import numpy as np
+import pytest
+
+from mlsl_tpu import native
+
+
+lib = native.load()
+pytestmark = pytest.mark.skipif(lib is None, reason="native core unavailable")
+
+
+def test_version():
+    assert lib.mlsl_core_version().decode().startswith("mlsl_core")
+
+
+def test_grid_coords_match_python(env):
+    for dp, sp, mp in [(2, 2, 2), (8, 1, 1), (1, 1, 8), (4, 1, 2), (1, 2, 2)]:
+        if 8 % (dp * sp * mp) != 0:
+            continue
+        dist = env.create_distribution(dp, mp, seq_parts=sp)
+        topo = dist.topology
+        c = (ctypes.c_int64 * 4)()
+        for p in range(8):
+            assert lib.mlsl_grid_coords(p, dp, sp, mp, c) == 0
+            assert tuple(c) == topo.coords(p)
+            assert lib.mlsl_grid_rank(c, dp, sp, mp) == p
+
+
+def test_grid_colors_match_reference_formulas():
+    from tests.conftest import ref_coords
+
+    dc = ctypes.c_int64()
+    mc = ctypes.c_int64()
+    rc = ctypes.c_int64()
+    for dp, mp in [(2, 4), (4, 2), (8, 1), (1, 8), (2, 2)]:
+        for p in range(16):
+            assert lib.mlsl_grid_colors(p, dp, mp, dc, mc, rc) == 0
+            _, _, _, data_color, model_color = ref_coords(p, dp, mp)
+            assert dc.value == data_color
+            assert mc.value == model_color
+
+
+def test_case_selection_matches_python_engine(env):
+    """Drive the Python graph engine over topology combos; the C++ selector must
+    pick the same case (inferred from the requests it builds)."""
+    from mlsl_tpu.types import OpType
+
+    def python_case(out_reduce, same, world, od, om, ind, inm):
+        return lib.mlsl_select_case(out_reduce, same, world, od, om, ind, inm)
+
+    # case 1: reduce within one dist
+    assert python_case(1, 1, 8, 2, 4, 2, 4) == 1
+    # case 2: model -> pure data, same data size
+    assert python_case(1, 0, 8, 4, 2, 4, 1) == 2
+    # case 3: redistribution model*data -> data
+    assert python_case(1, 0, 8, 2, 4, 8, 1) == 3
+    # case 4/5: no-reduce redistribution
+    assert python_case(0, 0, 8, 8, 1, 2, 4) == 4
+    assert python_case(0, 0, 8, 2, 4, 8, 1) == 5
+    # no comm: single process or same dist without reduce
+    assert python_case(0, 1, 8, 2, 4, 2, 4) == 0
+    assert python_case(1, 1, 1, 1, 1, 1, 1) == 0
+    # unsupported
+    assert python_case(1, 0, 8, 2, 2, 2, 2) == -1
+
+
+def test_block_layouts_match_python(env):
+    from mlsl_tpu.types import OpType
+
+    dist = env.create_distribution(2, 4)
+    s = env.create_session()
+    s.set_global_minibatch_size(8)
+
+    def mk(fm_in, fm_out):
+        r = s.create_operation_reg_info(OpType.CC)
+        r.add_input(fm_in, 4)
+        r.add_output(fm_out, 4)
+        return s.get_operation(s.add_operation(r, dist))
+
+    o1, o2 = mk(16, 32), mk(32, 8)
+    o1.set_next(o2, 0, 0)
+    s.commit()
+    out_act = o1.get_output(0)
+    in_act = o2.get_input(0)
+
+    n = len(out_act.pack_blocks)
+    blocks = (native.Block * n)()
+    assert (
+        lib.mlsl_blocks_pack_reduce_scatter(
+            4, o1.get_local_minibatch_size(), out_act.local_fm_count,
+            out_act.fm_size, blocks,
+        )
+        == 0
+    )
+    for got, want in zip(blocks, out_act.pack_blocks):
+        assert (
+            got.mb_offset, got.mb_count, got.fm_offset,
+            got.fm_count, got.fm_size, got.buf_offset,
+        ) == (
+            want.mb_offset, want.mb_count, want.fm_offset,
+            want.fm_count, want.fm_size, want.buf_offset,
+        )
+
+    n2 = len(in_act.unpack_blocks)
+    assert n2 == 1  # unpack reduce_scatter is a single block
+
+
+def test_param_partition_matches_python(env):
+    from mlsl_tpu.types import OpType
+
+    part = native.ParamPart()
+    for du in (0, 1):
+        for count, mp, dsize in [(1024, 4, 2), (100, 1, 8), (96, 2, 3)]:
+            dist = env.create_distribution(dsize, mp, devices=env.devices[: dsize * mp])
+            s = env.create_session()
+            s.set_global_minibatch_size(dsize)
+            r = s.create_operation_reg_info(OpType.CC)
+            r.add_input(mp, 1)
+            r.add_output(mp, 1)
+            r.add_parameter_set(count, 1, distributed_update=bool(du))
+            op = s.get_operation(s.add_operation(r, dist))
+            ps = op.get_parameter_set(0)
+            assert lib.mlsl_param_partition(count, mp, dsize, du, part) == 0
+            assert part.local_kernel_count == ps.get_local_kernel_count()
+            assert part.owned_kernel_count == ps.get_owned_kernel_count()
+            assert bool(part.need_comm) == ps.need_comm
+
+
+def test_native_scheduler_lifo_and_supersede():
+    s = native.NativeScheduler(threshold=100, lifo=True)
+    assert s.submit(1, 50)      # small -> immediate
+    assert not s.submit(2, 500)
+    assert not s.submit(3, 500)
+    assert not s.submit(2, 500)  # resubmit supersedes: 2 moves to newest
+    assert s.pending() == 2
+    assert s.drain() == [2, 3]  # newest first
+    assert s.pending() == 0
+
+
+def test_dispatcher_uses_native_queue(env):
+    from mlsl_tpu.types import DataType, GroupType, ReductionType
+
+    env.config.msg_priority = True
+    env.config.msg_priority_threshold = 0
+    try:
+        dist = env.create_distribution(8, 1)
+        buf = dist.make_buffer(lambda p: np.full(4, float(p)), 4)
+        r1 = dist.all_reduce(buf, 4, DataType.FLOAT, ReductionType.SUM, GroupType.DATA)
+        assert env.dispatcher._native is not None  # the C++ queue is live
+        assert env.dispatcher.pending_count == 1
+        out = env.wait(r1)
+        np.testing.assert_allclose(dist.local_part(out, 0), np.full(4, 28.0))
+    finally:
+        env.config.msg_priority = False

@@ -38,13 +38,13 @@ def test_priority_deferral_and_restart_supersede(env):
         buf1 = dist.make_buffer(lambda p: np.full(4, 1.0), 4)
         buf2 = dist.make_buffer(lambda p: np.full(4, 2.0), 4)
         req.start(buf1)
-        assert len(env.dispatcher._pending) == 1
+        assert env.dispatcher.pending_count == 1
         # Restart before any wait: the stale deferred entry must be superseded.
         req.start(buf2)
-        assert len(env.dispatcher._pending) == 1
+        assert env.dispatcher.pending_count == 1
         out = req.wait()
         np.testing.assert_allclose(dist.local_part(out, 0), np.full(4, 16.0))
-        assert len(env.dispatcher._pending) == 0
+        assert env.dispatcher.pending_count == 0
     finally:
         env.config.msg_priority = False
 
@@ -57,7 +57,7 @@ def test_priority_lifo_order(env):
         buf = dist.make_buffer(lambda p: np.full(4, float(p)), 4)
         r1 = dist.all_reduce(buf, 4, DataType.FLOAT, ReductionType.SUM, GroupType.DATA)
         r2 = dist.all_reduce(buf, 4, DataType.FLOAT, ReductionType.SUM, GroupType.DATA)
-        assert len(env.dispatcher._pending) == 2
+        assert env.dispatcher.pending_count == 2
         out1 = env.wait(r1)  # flush dispatches LIFO; both results must be correct
         out2 = env.wait(r2)
         np.testing.assert_allclose(dist.local_part(out1, 0), np.full(4, 28.0))
@@ -89,6 +89,19 @@ def test_test_polling(env):
         if done:
             break
     np.testing.assert_allclose(dist.local_part(out, 0), np.full(64, 28.0))
+
+
+def test_wait_after_test_delivers_result(env):
+    """MPI semantics: Wait on a test-completed request returns the result."""
+    dist = env.create_distribution(8, 1)
+    buf = dist.make_buffer(lambda p: np.full(8, float(p)), 8)
+    req = dist.all_reduce(buf, 8, DataType.FLOAT, ReductionType.SUM, GroupType.DATA)
+    while True:
+        done, _ = req.test()
+        if done:
+            break
+    out = req.wait()  # must not raise; must deliver the cached result
+    np.testing.assert_allclose(dist.local_part(out, 0), np.full(8, 28.0))
 
 
 def test_double_pairing_rejected(env):
