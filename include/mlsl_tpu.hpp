@@ -1,0 +1,171 @@
+/* mlsl_tpu C++ API — class-based wrapper over the flat C API.
+ *
+ * Mirrors the shape of the reference's C++ surface (include/mlsl.hpp:
+ * Environment singleton, Session/Operation/Distribution handle classes with
+ * Start/Wait semantics) for C++ frameworks. Header-only over mlsl_tpu.h.
+ */
+
+#ifndef MLSL_TPU_HPP
+#define MLSL_TPU_HPP
+
+#include <cstdint>
+#include <stdexcept>
+#include <vector>
+
+#include "mlsl_tpu.h"
+
+namespace mlsl_tpu {
+
+using DataType = mlsl_data_type_t;
+using GroupType = mlsl_group_type_t;
+using ReductionType = mlsl_reduction_t;
+using OpType = mlsl_op_type_t;
+using CompressionType = mlsl_compression_t;
+
+inline void Check(int status, const char* what) {
+  if (status != MLSL_TPU_SUCCESS) throw std::runtime_error(what);
+}
+
+class CommReq {
+ public:
+  explicit CommReq(mlsl_handle_t h) : h_(h) {
+    if (h_ == 0) throw std::runtime_error("collective start failed");
+  }
+  /* recv: (world, recv_count) */
+  void Wait(void* recv, int64_t recv_count, DataType dt) {
+    Check(mlsl_request_wait(h_, recv, recv_count, dt), "request wait");
+  }
+  bool Test() { return mlsl_request_test(h_) == 1; }
+  mlsl_handle_t handle() const { return h_; }
+
+ private:
+  mlsl_handle_t h_;
+};
+
+class Distribution {
+ public:
+  Distribution(int64_t data_parts, int64_t model_parts, int64_t seq_parts = 1)
+      : h_(mlsl_environment_create_distribution(data_parts, model_parts,
+                                                seq_parts)) {
+    if (h_ == 0) throw std::runtime_error("create distribution failed");
+  }
+  int64_t GetProcessCount(GroupType g) const {
+    return mlsl_distribution_get_process_count(h_, g);
+  }
+  CommReq AllReduce(const void* send, int64_t count, DataType dt,
+                    ReductionType op, GroupType g) {
+    return CommReq(mlsl_distribution_all_reduce(h_, send, count, dt, op, g));
+  }
+  CommReq Bcast(const void* send, int64_t count, DataType dt, int64_t root,
+                GroupType g) {
+    return CommReq(mlsl_distribution_bcast(h_, send, count, dt, root, g));
+  }
+  CommReq AllGather(const void* send, int64_t count, DataType dt, GroupType g) {
+    return CommReq(mlsl_distribution_all_gather(h_, send, count, dt, g));
+  }
+  CommReq ReduceScatter(const void* send, int64_t count, DataType dt,
+                        ReductionType op, GroupType g) {
+    return CommReq(
+        mlsl_distribution_reduce_scatter(h_, send, count, dt, op, g));
+  }
+  CommReq AlltoAll(const void* send, int64_t count, DataType dt, GroupType g) {
+    return CommReq(mlsl_distribution_all_to_all(h_, send, count, dt, g));
+  }
+  void Barrier(GroupType g) { Check(mlsl_distribution_barrier(h_, g), "barrier"); }
+  mlsl_handle_t handle() const { return h_; }
+
+ private:
+  mlsl_handle_t h_;
+};
+
+class Operation {
+ public:
+  explicit Operation(mlsl_handle_t h) : h_(h) {}
+  void SetNext(const Operation& next, int64_t out_idx, int64_t in_idx) {
+    Check(mlsl_operation_set_next(h_, next.h_, out_idx, in_idx), "set next");
+  }
+  int64_t GetLocalMinibatchSize() const {
+    return mlsl_operation_get_local_minibatch_size(h_);
+  }
+  int64_t GetParameterLocalCount(int64_t idx) const {
+    return mlsl_operation_get_parameter_local_count(h_, idx);
+  }
+  int64_t GetParameterOwnedCount(int64_t idx) const {
+    return mlsl_operation_get_parameter_owned_count(h_, idx);
+  }
+  void StartGradientComm(int64_t ps_idx, const void* grads, DataType dt) {
+    Check(mlsl_parameter_set_start_gradient_comm(h_, ps_idx, grads, dt),
+          "start gradient comm");
+  }
+  /* returns per-rank element count written (0 = no comm needed) */
+  int64_t WaitGradientComm(int64_t ps_idx, void* recv, DataType dt) {
+    int64_t n = mlsl_parameter_set_wait_gradient_comm(h_, ps_idx, recv, dt);
+    if (n < 0) throw std::runtime_error("wait gradient comm");
+    return n;
+  }
+  mlsl_handle_t handle() const { return h_; }
+
+ private:
+  mlsl_handle_t h_;
+};
+
+class OperationRegInfo {
+ public:
+  explicit OperationRegInfo(mlsl_handle_t h) : h_(h) {}
+  int64_t AddInput(int64_t count, int64_t size, DataType dt) {
+    return mlsl_operation_reg_info_add_input(h_, count, size, dt);
+  }
+  int64_t AddOutput(int64_t count, int64_t size, DataType dt) {
+    return mlsl_operation_reg_info_add_output(h_, count, size, dt);
+  }
+  int64_t AddParameterSet(int64_t kernel_count, int64_t kernel_size, DataType dt,
+                          bool dist_update = false,
+                          CompressionType comp = MLSL_CT_NONE) {
+    return mlsl_operation_reg_info_add_parameter_set(
+        h_, kernel_count, kernel_size, dt, dist_update ? 1 : 0, comp);
+  }
+  mlsl_handle_t handle() const { return h_; }
+
+ private:
+  mlsl_handle_t h_;
+};
+
+class Session {
+ public:
+  Session() : h_(mlsl_environment_create_session()) {
+    if (h_ == 0) throw std::runtime_error("create session failed");
+  }
+  void SetGlobalMinibatchSize(int64_t size) {
+    Check(mlsl_session_set_global_minibatch_size(h_, size), "set minibatch");
+  }
+  OperationRegInfo CreateOperationRegInfo(OpType t) {
+    return OperationRegInfo(mlsl_session_create_operation_reg_info(h_, t));
+  }
+  Operation AddOperation(const OperationRegInfo& reg, const Distribution& d) {
+    mlsl_handle_t op = mlsl_session_add_operation(h_, reg.handle(), d.handle());
+    if (op == 0) throw std::runtime_error("add operation failed");
+    return Operation(op);
+  }
+  void Commit() { Check(mlsl_session_commit(h_), "commit"); }
+  mlsl_handle_t handle() const { return h_; }
+
+ private:
+  mlsl_handle_t h_;
+};
+
+class Environment {
+ public:
+  static Environment& GetEnv() {
+    static Environment env;
+    return env;
+  }
+  void Init() { Check(mlsl_environment_init(), "environment init"); }
+  void Finalize() { Check(mlsl_environment_finalize(), "environment finalize"); }
+  int64_t GetProcessCount() const {
+    return mlsl_environment_get_process_count();
+  }
+};
+
+}  // namespace mlsl_tpu
+
+#endif /* MLSL_TPU_HPP */

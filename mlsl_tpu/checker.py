@@ -1,0 +1,83 @@
+"""Buffer checker: runtime validation of every buffer handed to a collective.
+
+TPU-native analog of the reference's PointerChecker (src/pointer_checker.{hpp,cpp}:
+a debug allocator-range tracker consulted before every MPI call under
+ENABLE_CHKP_INT). Raw pointers don't exist here; the failure modes that do are wrong
+global shape, wrong dtype, wrong sharding (buffer laid out for a different topology)
+and non-finite payloads. Enabled via MLSL_CHKP=1 (off by default — it syncs the
+device to inspect values when MLSL_CHKP=2).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import jax
+
+from mlsl_tpu.log import mlsl_assert
+from mlsl_tpu.types import jnp_dtype
+
+CHKP_OFF = 0
+CHKP_SHAPE = 1   # shape/dtype/sharding checks (cheap, no sync)
+CHKP_VALUES = 2  # + finiteness check (syncs the device)
+
+
+def level() -> int:
+    from mlsl_tpu.config import _env_int
+
+    return _env_int("MLSL_CHKP", 0)
+
+
+def check_buffer(buf, desc, lvl: int = None) -> None:
+    """Validate a distributed buffer against its request descriptor.
+
+    Raises MLSLError (like the reference's CHECK_RANGE failures) on mismatch.
+    """
+    if lvl is None:
+        lvl = level()
+    if lvl == CHKP_OFF:
+        return
+    topo = desc.group.topology
+    mlsl_assert(
+        hasattr(buf, "shape") and buf.ndim >= 5,
+        "CHKP: buffer must be a distributed (R,D,S,M,n) array, got %r",
+        type(buf).__name__,
+    )
+    mlsl_assert(
+        tuple(buf.shape[:4]) == topo.grid_shape,
+        "CHKP: buffer grid %s does not match topology %s",
+        tuple(buf.shape[:4]),
+        topo.grid_shape,
+    )
+    want_elems = desc.count
+    got_elems = int(np.prod(buf.shape[4:]))
+    mlsl_assert(
+        got_elems >= want_elems,
+        "CHKP: buffer payload %d < descriptor count %d (OUT_OF_RANGE)",
+        got_elems,
+        want_elems,
+    )
+    want_dt = np.dtype(jnp_dtype(desc.data_type))
+    mlsl_assert(
+        np.dtype(buf.dtype) == want_dt,
+        "CHKP: buffer dtype %s != descriptor dtype %s",
+        buf.dtype,
+        want_dt,
+    )
+    if isinstance(buf, jax.Array) and buf.sharding is not None:
+        # the buffer must be laid out on this topology's mesh (UNKNOWN_PTR analog)
+        try:
+            buf_mesh = buf.sharding.mesh
+            mlsl_assert(
+                tuple(buf_mesh.axis_names) == tuple(topo.mesh.axis_names)
+                and buf_mesh.devices.shape == topo.mesh.devices.shape,
+                "CHKP: buffer sharded over mesh %s, request targets mesh %s",
+                buf_mesh.devices.shape,
+                topo.mesh.devices.shape,
+            )
+        except AttributeError:
+            pass
+    if lvl >= CHKP_VALUES and np.issubdtype(buf.dtype, np.floating):
+        mlsl_assert(
+            bool(jax.device_get(jax.numpy.isfinite(buf).all())),
+            "CHKP: buffer contains non-finite values",
+        )

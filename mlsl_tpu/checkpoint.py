@@ -1,0 +1,85 @@
+"""Checkpoint/resume: orbax-backed persistence of training state.
+
+The reference has no checkpointing (SURVEY.md §5.4 — MLSL only moves bytes; its
+closest artifact is the endpoint-server async file-IO offload). A *framework* needs
+one, so this module provides it TPU-natively: async orbax saves (the save executes in
+the background while training continues — the same overlap idea as eplib's offloaded
+file reads), sharding-preserving restore, and trainer integration.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Optional
+
+import jax
+
+try:
+    import orbax.checkpoint as ocp
+
+    _HAVE_ORBAX = True
+except ImportError:  # pragma: no cover
+    _HAVE_ORBAX = False
+
+
+class CheckpointManager:
+    """Save/restore pytrees of (possibly sharded) jax.Arrays by step number."""
+
+    def __init__(self, directory: str, max_to_keep: int = 3):
+        if not _HAVE_ORBAX:
+            raise RuntimeError("orbax-checkpoint is not available")
+        self.directory = os.path.abspath(directory)
+        os.makedirs(self.directory, exist_ok=True)
+        self._mgr = ocp.CheckpointManager(
+            self.directory,
+            options=ocp.CheckpointManagerOptions(
+                max_to_keep=max_to_keep, enable_async_checkpointing=True
+            ),
+        )
+
+    def save(self, step: int, state: Any, wait: bool = False) -> None:
+        """Dispatch an async save of ``state`` (any pytree of arrays)."""
+        self._mgr.save(step, args=ocp.args.StandardSave(state))
+        if wait:
+            self._mgr.wait_until_finished()
+
+    def restore(self, step: Optional[int] = None, template: Any = None) -> Any:
+        """Restore the given (or latest) step. ``template`` — a pytree of arrays or
+        ShapeDtypeStructs with shardings — reproduces the original placement."""
+        if step is None:
+            step = self.latest_step()
+        if step is None:
+            return None
+        if template is not None:
+            target = jax.tree.map(
+                lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype, sharding=getattr(x, "sharding", None))
+                if hasattr(x, "shape")
+                else x,
+                template,
+            )
+            return self._mgr.restore(step, args=ocp.args.StandardRestore(target))
+        return self._mgr.restore(step)
+
+    def latest_step(self) -> Optional[int]:
+        return self._mgr.latest_step()
+
+    def wait(self) -> None:
+        self._mgr.wait_until_finished()
+
+    def close(self) -> None:
+        self._mgr.wait_until_finished()
+        self._mgr.close()
+
+
+def save_trainer(mgr: CheckpointManager, trainer, step: int, wait: bool = False) -> None:
+    """Persist a DataParallelTrainer/HybridTrainer's parameters."""
+    mgr.save(step, {"params": trainer.params, "step": step}, wait=wait)
+
+
+def restore_trainer(mgr: CheckpointManager, trainer, step: Optional[int] = None) -> Optional[int]:
+    """Restore parameters in place; returns the restored step or None."""
+    state = mgr.restore(step, template={"params": trainer.params, "step": 0})
+    if state is None:
+        return None
+    trainer.params = state["params"]
+    return int(state["step"])

@@ -170,6 +170,11 @@ class CommRequest:
 
     def start(self, buf: jax.Array) -> "CommRequest":
         mlsl_assert(self.is_setup, "request must be setup() before start()")
+        from mlsl_tpu import checker
+
+        chkp = checker.level()
+        if chkp:
+            checker.check_buffer(buf, self.desc, chkp)
         self._epoch += 1
         self._results = []
         self._result = None

@@ -1,0 +1,57 @@
+/* C++-class-API consumer (the analog of the reference's mlsl_test.cpp usage
+ * of the include/mlsl.hpp classes). */
+
+#include <cstdio>
+#include <vector>
+
+#include "../include/mlsl_tpu.hpp"
+
+int main() {
+  using namespace mlsl_tpu;
+  try {
+    Environment::GetEnv().Init();
+    const int64_t world = Environment::GetEnv().GetProcessCount();
+    std::printf("world = %lld\n", (long long)world);
+
+    Distribution dist(world, 1);
+    const int64_t n = 8;
+    std::vector<float> send(world * n), recv(world * n);
+    for (int64_t p = 0; p < world; ++p)
+      for (int64_t i = 0; i < n; ++i) send[p * n + i] = (float)(p + 1);
+    CommReq req =
+        dist.AllReduce(send.data(), n, MLSL_DT_FLOAT, MLSL_RT_SUM, MLSL_GT_DATA);
+    while (!req.Test()) { /* poll (Test-then-Wait must deliver) */ }
+    req.Wait(recv.data(), n, MLSL_DT_FLOAT);
+    const float expect = (float)(world * (world + 1) / 2);
+    for (int64_t i = 0; i < n; ++i)
+      if (recv[i] != expect) {
+        std::fprintf(stderr, "FAILED: allreduce %f != %f\n", recv[i], expect);
+        return 1;
+      }
+    std::printf("allreduce OK (%.0f)\n", expect);
+
+    Session sess;
+    sess.SetGlobalMinibatchSize(4 * world);
+    OperationRegInfo reg = sess.CreateOperationRegInfo(MLSL_OT_CC);
+    reg.AddInput(8, 4, MLSL_DT_FLOAT);
+    reg.AddOutput(8, 4, MLSL_DT_FLOAT);
+    reg.AddParameterSet(32, 1, MLSL_DT_FLOAT);
+    Operation op = sess.AddOperation(reg, dist);
+    sess.Commit();
+    const int64_t cnt = op.GetParameterLocalCount(0);
+    std::vector<float> grads(world * cnt, 2.0f), gout(world * cnt);
+    op.StartGradientComm(0, grads.data(), MLSL_DT_FLOAT);
+    const int64_t got = op.WaitGradientComm(0, gout.data(), MLSL_DT_FLOAT);
+    if (world > 1 && (got != cnt || gout[0] != 2.0f * world)) {
+      std::fprintf(stderr, "FAILED: grad sync\n");
+      return 1;
+    }
+    dist.Barrier(MLSL_GT_GLOBAL);
+    Environment::GetEnv().Finalize();
+    std::printf("CPP API TEST PASSED\n");
+    return 0;
+  } catch (const std::exception& e) {
+    std::fprintf(stderr, "FAILED: %s\n", e.what());
+    return 1;
+  }
+}

@@ -1,0 +1,116 @@
+"""Auxiliary subsystems: buffer checker, checkpoint/resume, async data loader."""
+
+import os
+
+import numpy as np
+import pytest
+import jax
+
+from mlsl_tpu.types import DataType, GroupType, ReductionType
+
+
+class TestChecker:
+    def test_checker_catches_wrong_shape(self, env, monkeypatch):
+        from mlsl_tpu.log import MLSLError
+
+        monkeypatch.setenv("MLSL_CHKP", "1")
+        dist = env.create_distribution(8, 1)
+        other = env.create_distribution(4, 2)
+        buf = other.make_buffer(lambda p: np.zeros(8), 8)  # wrong topology layout
+        with pytest.raises(MLSLError):
+            dist.all_reduce(buf, 8, DataType.FLOAT, ReductionType.SUM, GroupType.DATA)
+
+    def test_checker_catches_short_buffer(self, env, monkeypatch):
+        from mlsl_tpu.log import MLSLError
+
+        monkeypatch.setenv("MLSL_CHKP", "1")
+        dist = env.create_distribution(8, 1)
+        buf = dist.make_buffer(lambda p: np.zeros(4), 4)
+        with pytest.raises(MLSLError):
+            dist.all_reduce(buf, 8, DataType.FLOAT, ReductionType.SUM, GroupType.DATA)
+
+    def test_checker_catches_nonfinite(self, env, monkeypatch):
+        from mlsl_tpu.log import MLSLError
+
+        monkeypatch.setenv("MLSL_CHKP", "2")
+        dist = env.create_distribution(8, 1)
+        buf = dist.make_buffer(lambda p: np.full(8, np.nan), 8)
+        with pytest.raises(MLSLError):
+            dist.all_reduce(buf, 8, DataType.FLOAT, ReductionType.SUM, GroupType.DATA)
+
+    def test_checker_passes_valid(self, env, monkeypatch):
+        monkeypatch.setenv("MLSL_CHKP", "2")
+        dist = env.create_distribution(8, 1)
+        buf = dist.make_buffer(lambda p: np.full(8, float(p)), 8)
+        out = env.wait(
+            dist.all_reduce(buf, 8, DataType.FLOAT, ReductionType.SUM, GroupType.DATA)
+        )
+        np.testing.assert_allclose(dist.local_part(out, 0), np.full(8, 28.0))
+
+
+class TestCheckpoint:
+    def test_roundtrip_trainer_state(self, env, tmp_path):
+        from mlsl_tpu.checkpoint import CheckpointManager, restore_trainer, save_trainer
+        from mlsl_tpu.models.mlp import LAYERS, get_layer, init, loss_fn
+        from mlsl_tpu.models.train import DataParallelTrainer
+
+        dist = env.create_distribution(8, 1)
+        sess = env.create_session()
+        sess.set_global_minibatch_size(16)
+        trainer = DataParallelTrainer(
+            env, dist, sess, init(jax.random.PRNGKey(0)), loss_fn, LAYERS, get_layer,
+            lr=0.1,
+        )
+        rng = np.random.default_rng(0)
+        x = rng.normal(size=(16, 8)).astype(np.float32)
+        y = rng.integers(0, 4, size=(16,)).astype(np.int32)
+        for _ in range(2):
+            trainer.step(trainer.shard_batch(x, y))
+        before = jax.device_get(trainer.params)
+
+        mgr = CheckpointManager(str(tmp_path / "ckpt"))
+        save_trainer(mgr, trainer, step=2, wait=True)
+
+        # keep training, then restore and confirm exact rollback
+        trainer.step(trainer.shard_batch(x, y))
+        step = restore_trainer(mgr, trainer)
+        assert step == 2
+        after = jax.device_get(trainer.params)
+        for a, b in zip(jax.tree.leaves(before), jax.tree.leaves(after)):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+        mgr.close()
+
+
+class TestAsyncLoader:
+    def test_prefetch_delivers_in_order(self, env):
+        from mlsl_tpu.data import AsyncLoader, synthetic_source
+        from mlsl_tpu.models.mlp import LAYERS, get_layer, init, loss_fn
+        from mlsl_tpu.models.train import DataParallelTrainer
+
+        dist = env.create_distribution(8, 1)
+        sess = env.create_session()
+        sess.set_global_minibatch_size(16)
+        trainer = DataParallelTrainer(
+            env, dist, sess, init(jax.random.PRNGKey(0)), loss_fn, LAYERS, get_layer,
+        )
+        loader = AsyncLoader(
+            synthetic_source(16, (8,), 4, steps=5), trainer.shard_batch, depth=2
+        )
+        losses = [float(np.asarray(trainer.step(b)).reshape(-1)[0]) for b in loader]
+        assert len(losses) == 5 and np.isfinite(losses).all()
+        loader.close()
+
+    def test_worker_exception_surfaces(self, env):
+        from mlsl_tpu.data import AsyncLoader
+
+        def bad_source():
+            yield from ()
+            raise RuntimeError("boom")  # pragma: no cover
+
+        def explode():
+            raise RuntimeError("boom")
+
+        loader = AsyncLoader(explode, lambda *a: a, depth=1)
+        with pytest.raises(RuntimeError, match="boom"):
+            next(iter(loader))
+        loader.close()

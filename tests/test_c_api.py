@@ -31,3 +31,22 @@ def test_c_api_end_to_end():
     assert "C API TEST PASSED" in run.stdout
     assert "world = 8" in run.stdout
     assert "allreduce OK (36)" in run.stdout
+
+
+@pytest.mark.skipif(shutil.which("g++") is None, reason="no C++ toolchain")
+def test_cpp_api_end_to_end():
+    build = subprocess.run(
+        ["make", "-s", "test_cpp_api"], cwd=NATIVE, capture_output=True, text=True,
+        timeout=180,
+    )
+    assert build.returncode == 0, build.stderr
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    env["MLSL_TPU_PLATFORM"] = "cpu"
+    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=8"
+    run = subprocess.run(
+        [os.path.join(NATIVE, "test_cpp_api")], capture_output=True, text=True,
+        timeout=300, env=env,
+    )
+    assert run.returncode == 0, f"stdout:\n{run.stdout}\nstderr:\n{run.stderr}"
+    assert "CPP API TEST PASSED" in run.stdout
