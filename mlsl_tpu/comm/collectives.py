@@ -139,6 +139,24 @@ def _body_reduce_scatter(x, *, axes, sizes, op, recv_count, **_):
     return lax.dynamic_slice_in_dim(red, me * recv_count, recv_count, axis=0)
 
 
+def _body_sendrecv(x, *, axes, sizes, pairs, **_):
+    """Neighbor/point-to-point exchange list: member src -> member dst for each
+    (src, dst) pair; members not receiving get zeros.
+
+    Implements the reference's declared-but-unimplemented SendRecvList CommOp
+    (src/comm.hpp:212-248) — on TPU this IS lax.ppermute, whose transfers ride the
+    ICI neighbor links directly.
+    """
+    if len(axes) == 1:
+        return lax.ppermute(x, axes[0], [(int(s), int(d)) for s, d in pairs])
+    g = _gather_group(x, axes)           # (G, n)
+    me = _group_rank(axes, sizes)
+    out = jnp.zeros_like(x)
+    for s, d in pairs:
+        out = jnp.where(me == d, g[int(s)], out)
+    return out
+
+
 def _body_alltoall(x, *, axes, sizes, send_count, **_):
     if len(axes) == 1:
         return lax.all_to_all(x, axes[0], split_axis=0, concat_axis=0, tiled=True)
@@ -207,7 +225,8 @@ def _color_tables(group: ProcessGroup):
     return member, pos
 
 
-def _make_color_body(kind: str, group: ProcessGroup, *, op=None, root=None, recv_count=None):
+def _make_color_body(kind: str, group: ProcessGroup, *, op=None, root=None,
+                     recv_count=None, pairs=None):
     member_np, pos_np = _color_tables(group)
     sizes = _axis_sizes(group.topology.mesh)
 
@@ -237,12 +256,19 @@ def _make_color_body(kind: str, group: ProcessGroup, *, op=None, root=None, recv
             blocks = vals.reshape(g, g, -1)                    # (G, G, count)
             mine = lax.dynamic_index_in_dim(blocks, mypos, axis=1, keepdims=False)
             return mine.reshape(-1)
+        if kind == "sendrecv":
+            mypos = jnp.take(jnp.asarray(pos_np), me)
+            out = jnp.zeros_like(x)
+            for s, d in pairs:
+                out = jnp.where(mypos == d, vals[int(s)], out)
+            return out
         raise NotImplementedError(kind)
 
     return body
 
 
 _AXIS_BODIES = {
+    "sendrecv": _body_sendrecv,
     "allreduce": _body_allreduce,
     "reduce": _body_reduce,
     "bcast": _body_bcast,
@@ -308,6 +334,7 @@ def build_collective(kind: str, group: ProcessGroup, dtype, **kw) -> Callable:
             op=kw.get("op"),
             root=kw.get("root"),
             recv_count=kw.get("recv_count"),
+            pairs=kw.get("pairs"),
         )
     else:
         raw = _AXIS_BODIES[kind]

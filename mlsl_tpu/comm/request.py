@@ -63,6 +63,7 @@ class CommDesc:
     send_counts: Optional[tuple] = None
     send_offsets: Optional[tuple] = None
     recv_offsets: Optional[tuple] = None
+    pairs: Optional[tuple] = None  # sendrecv: ((src, dst), ...) member indices
     compression: CompressionType = CompressionType.NONE
 
     def payload_bytes(self) -> int:
@@ -138,6 +139,8 @@ class CommRequest:
             kw["recv_counts"] = tuple(int(c) for c in d.recv_counts)
         if d.kind == "alltoall":
             kw["send_count"] = int(d.count)
+        if d.kind == "sendrecv":
+            kw["pairs"] = tuple((int(s), int(t)) for s, t in d.pairs)
         if d.kind == "alltoallv":
             kw.pop("recv_counts", None)
             kw.update(_normalize_alltoallv(d))

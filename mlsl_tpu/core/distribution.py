@@ -343,6 +343,39 @@ class Distribution:
             send_buffer,
         )
 
+    def send_recv_list(self, buffer, count, data_type, pairs, group_type) -> CommRequest:
+        """Point-to-point exchange list: each (src, dst) member pair moves ``count``
+        elements; non-recipients get zeros. Implements the reference's SendRecvList
+        CommOp (src/comm.hpp:212-248, declared there but never built) via
+        lax.ppermute — the pipeline-parallel boundary-transfer primitive."""
+        g = self._group(group_type)
+        gsize = 1 if g.is_self else g.size
+        srcs = [int(s) for s, _ in pairs]
+        dsts = [int(d) for _, d in pairs]
+        for s, d in zip(srcs, dsts):
+            mlsl_assert(
+                0 <= s < gsize and 0 <= d < gsize,
+                "SendRecvList pair (%d, %d) out of range for group size %d",
+                s, d, gsize,
+            )
+        # ppermute (the fast path) requires unique sources and destinations;
+        # enforce the same contract on every path so semantics never depend on
+        # the group's shape.
+        mlsl_assert(
+            len(set(srcs)) == len(srcs) and len(set(dsts)) == len(dsts),
+            "SendRecvList sources and destinations must be unique",
+        )
+        return self._start(
+            CommDesc(
+                "sendrecv",
+                g,
+                int(count),
+                DataType(data_type),
+                pairs=tuple(zip(srcs, dsts)),
+            ),
+            buffer,
+        )
+
     def barrier(self, group_type) -> None:
         import jax.numpy as jnp
 
@@ -370,4 +403,5 @@ class Distribution:
     AllGatherv = all_gatherv
     Scatter = scatter
     ReduceScatter = reduce_scatter
+    SendRecvList = send_recv_list
     Barrier = barrier

@@ -30,6 +30,7 @@ class Environment:
 
     _instance: Optional["Environment"] = None
     _lock = threading.Lock()
+    _jax_distributed_up = False  # process-wide: jax.distributed inits at most once
 
     def __init__(self):
         self._initialized = False
@@ -58,9 +59,27 @@ class Environment:
 
     # -- lifecycle (reference src/mlsl.cpp:684-746) -----------------------
 
-    def init(self, devices: Optional[Sequence[jax.Device]] = None) -> "Environment":
+    def init(
+        self,
+        devices: Optional[Sequence[jax.Device]] = None,
+        coordinator_address: Optional[str] = None,
+        num_processes: Optional[int] = None,
+        process_id: Optional[int] = None,
+    ) -> "Environment":
+        """Bootstrap. For multi-host slices/pods pass the jax.distributed
+        coordination parameters (the DCN analog of the reference's multi-node MPI
+        launch); single-host/single-controller needs none."""
         if self._initialized:
             return self
+        if coordinator_address is not None and not Environment._jax_distributed_up:
+            # jax.distributed.initialize may only run once per process; init/finalize
+            # cycles of the Environment must not re-run it.
+            jax.distributed.initialize(
+                coordinator_address=coordinator_address,
+                num_processes=num_processes,
+                process_id=process_id,
+            )
+            Environment._jax_distributed_up = True
         self.config = Config.from_env()
         set_log_level(self.config.log_level)
         sysinfo.auto_config(self.config)
@@ -68,7 +87,23 @@ class Environment:
         self.devices = tuple(devices) if devices is not None else tuple(jax.devices())
         self._initialized = True
         self._init_pid = os.getpid()
+        self._dump_config()
         return self
+
+    def _dump_config(self) -> None:
+        """One-time config/world dump at init (the reference's rank-0 env-var dump,
+        src/comm_ep.cpp:1701-1739), at INFO level."""
+        from mlsl_tpu.log import log_info
+
+        if jax.process_index() != 0:  # rank-0 only, like the reference
+            return
+        si = sysinfo.probe()
+        log_info(
+            "mlsl_tpu init: platform=%s kind=%s devices=%d hosts=%d",
+            si.platform, si.device_kind, len(self.devices), si.num_hosts,
+        )
+        for field, value in sorted(vars(self.config).items()):
+            log_info("  config %s = %r", field, value)
 
     def finalize(self) -> None:
         # Fork-safety: a forked child must not tear down the parent's state

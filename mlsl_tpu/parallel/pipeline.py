@@ -1,0 +1,103 @@
+"""Pipeline parallelism: GPipe-style microbatch schedule over the model axis.
+
+The reference declares (but never implements) the point-to-point primitive a pipeline
+needs — SendRecvList (src/comm.hpp:212-248). This module is that capability completed:
+pipeline stages live on the 'model' mesh axis, microbatch activations flow stage->
+stage+1 via lax.ppermute (the SendRecvList realization), and a fill-drain schedule
+keeps every stage busy once the pipeline is full. Differentiating through the schedule
+gives the reversed (drain-fill) backward automatically — JAX transposes ppermute to
+the opposite shift — so training just calls jax.grad on the pipelined loss.
+
+Usage (inside or outside shard_map via the provided driver):
+    out = gpipe_forward(stage_fn, stage_params, x_micro, axis, n_stages)
+with stage_fn(params, x) -> y applied at every stage (all stages share the fn shape;
+per-stage weights differ — the usual homogeneous-blocks pipeline).
+"""
+
+from __future__ import annotations
+
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from mlsl_tpu.parallel.sequence import _pvary
+
+
+def gpipe_forward(
+    stage_fn: Callable,
+    stage_params,
+    x_micro: jax.Array,
+    axis: str,
+    n_stages: int,
+):
+    """SPMD body (call inside shard_map over ``axis`` of size n_stages).
+
+    stage_params: this stage's weights (the caller shards them over ``axis``).
+    x_micro: (M, mb, d_in) microbatches — the stage-0 input (replicated copies on
+    other stages are ignored).
+    Returns (M, mb, d_out): the last stage's outputs (zeros elsewhere; reduce with
+    a psum/select or read the last stage's shard).
+    """
+    m_count, mb, _ = x_micro.shape
+    me = lax.axis_index(axis)
+    perm = [(i, (i + 1) % n_stages) for i in range(n_stages)]
+    ticks = m_count + n_stages - 1
+
+    probe = jax.eval_shape(stage_fn, stage_params, x_micro[0])
+    d_out = probe.shape[-1]
+    assert d_out == x_micro.shape[-1], (
+        "pipeline stages must be homogeneous (d_in == d_out); got "
+        f"{x_micro.shape[-1]} -> {d_out}"
+    )
+
+    outs = _pvary(jnp.zeros((m_count, mb, d_out), probe.dtype), axis)
+    recv = _pvary(jnp.zeros((mb, d_out), probe.dtype), axis)
+
+    def tick(t, state):
+        recv, outs = state
+        mb_idx = t - me                       # which microbatch this stage handles
+        active = jnp.logical_and(mb_idx >= 0, mb_idx < m_count)
+        safe_idx = jnp.clip(mb_idx, 0, m_count - 1)
+        inp = jnp.where(
+            me == 0,
+            lax.dynamic_index_in_dim(x_micro, safe_idx, axis=0, keepdims=False),
+            recv,
+        )
+        y = stage_fn(stage_params, inp)
+        y = jnp.where(active, y, jnp.zeros_like(y))
+        # last stage banks its result for microbatch mb_idx
+        write_idx = jnp.clip(mb_idx, 0, m_count - 1)
+        is_last = me == n_stages - 1
+        banked = lax.dynamic_update_index_in_dim(
+            outs,
+            jnp.where(jnp.logical_and(is_last, active), y,
+                      lax.dynamic_index_in_dim(outs, write_idx, axis=0, keepdims=False)),
+            write_idx,
+            axis=0,
+        )
+        # boundary transfer: stage s -> s+1 (the SendRecvList ring)
+        recv_next = lax.ppermute(y, axis, perm)
+        return recv_next, banked
+
+    _, outs = lax.fori_loop(0, ticks, tick, (recv, outs))
+    return outs
+
+
+def pipeline_loss(
+    stage_fn: Callable,
+    loss_head: Callable,
+    stage_params,
+    x_micro: jax.Array,
+    y_micro: jax.Array,
+    axis: str,
+    n_stages: int,
+):
+    """Pipelined forward + loss on the last stage, psum'd so every stage holds the
+    scalar (ready for jax.grad: the backward replays the schedule in reverse)."""
+    outs = gpipe_forward(stage_fn, stage_params, x_micro, axis, n_stages)
+    me = lax.axis_index(axis)
+    per_micro = jax.vmap(loss_head)(outs, y_micro)          # (M,)
+    local = jnp.where(me == n_stages - 1, jnp.sum(per_micro), 0.0)
+    return lax.psum(local, axis)
