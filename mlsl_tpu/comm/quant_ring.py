@@ -26,11 +26,6 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
-try:
-    from jax import shard_map as _shard_map
-except ImportError:  # pragma: no cover
-    from jax.experimental.shard_map import shard_map as _shard_map
-
 from jax.sharding import PartitionSpec as P
 
 from mlsl_tpu.comm.mesh import NUM_GRID_AXES, ProcessGroup
@@ -185,11 +180,16 @@ def build_quantized_collective(
         )
         return out[None, None, None, None], new_err[None, None, None, None]
 
-    sm = _shard_map(
+    from mlsl_tpu.comm.collectives import smap
+
+    # check=False: pallas_call outputs carry no VMA annotation, which the strict
+    # checker rejects even though the program is correct.
+    sm = smap(
         local_fn,
-        mesh=mesh,
+        mesh,
         in_specs=(_BUF_SPEC, _BUF_SPEC),
         out_specs=(_BUF_SPEC, _BUF_SPEC),
+        check=False,
     )
     fn = jax.jit(sm)
     _cache[key] = fn

@@ -26,11 +26,6 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import NamedSharding, PartitionSpec as P
 
-try:
-    from jax import shard_map as _shard_map_raw
-except ImportError:  # pragma: no cover
-    from jax.experimental.shard_map import shard_map as _shard_map_raw
-
 from mlsl_tpu.comm.collectives import _BUF_SPEC
 from mlsl_tpu.comm.mesh import (
     DATA_AXIS,
@@ -43,19 +38,7 @@ from mlsl_tpu.log import mlsl_assert
 from mlsl_tpu.types import CompressionType, DataType, OpType
 
 
-def smap(f, mesh, in_specs, out_specs, check: bool = True):
-    """shard_map with a version-compatible way to disable replication checking
-    (needed when an out_spec claims replication the compiler can't prove)."""
-    if check:
-        return _shard_map_raw(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs)
-    for kw in ({"check_vma": False}, {"check_rep": False}):
-        try:
-            return _shard_map_raw(
-                f, mesh=mesh, in_specs=in_specs, out_specs=out_specs, **kw
-            )
-        except TypeError:
-            continue
-    return _shard_map_raw(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs)
+from mlsl_tpu.comm.collectives import smap  # noqa: F401  (canonical home)
 
 
 def _flatten_layer(tree) -> jax.Array:

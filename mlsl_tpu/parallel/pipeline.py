@@ -67,14 +67,14 @@ def gpipe_forward(
         )
         y = stage_fn(stage_params, inp)
         y = jnp.where(active, y, jnp.zeros_like(y))
-        # last stage banks its result for microbatch mb_idx
-        write_idx = jnp.clip(mb_idx, 0, m_count - 1)
+        # last stage banks its result for microbatch mb_idx (same clamped index
+        # as the input selection)
         is_last = me == n_stages - 1
         banked = lax.dynamic_update_index_in_dim(
             outs,
             jnp.where(jnp.logical_and(is_last, active), y,
-                      lax.dynamic_index_in_dim(outs, write_idx, axis=0, keepdims=False)),
-            write_idx,
+                      lax.dynamic_index_in_dim(outs, safe_idx, axis=0, keepdims=False)),
+            safe_idx,
             axis=0,
         )
         # boundary transfer: stage s -> s+1 (the SendRecvList ring)
