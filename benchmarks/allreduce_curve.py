@@ -1,0 +1,88 @@
+"""AllReduce algorithmic-bandwidth curve: the isolation-benchmark harness.
+
+Produces the algbw-vs-message-size table that is the BASELINE metric (SURVEY.md §6:
+"allreduce algbw (GB/s) vs msg size"), using the Statistics isolation methodology
+(10 iterations, 4 warm-up skipped — reference src/mlsl_impl_stats.cpp:48-49).
+
+algbw for an allreduce of S bytes over n ranks uses the standard convention
+busbw = algbw * 2(n-1)/n. On a single real chip the group is degenerate (the curve
+then measures framework dispatch floor); on a v5p slice this is the ≥90%-of-ICI-peak
+north-star measurement. Run with MLSL_TPU_PLATFORM=cpu and
+XLA_FLAGS=--xla_force_host_platform_device_count=8 for the virtual-mesh curve.
+
+Output: one row per size, plus a JSON summary line.
+"""
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), ".."))
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--min-kb", type=int, default=4)
+    ap.add_argument("--max-mb", type=int, default=64)
+    ap.add_argument("--quant", action="store_true", help="also run int8 ring")
+    args = ap.parse_args()
+
+    import jax
+
+    if os.environ.get("MLSL_TPU_PLATFORM"):
+        jax.config.update("jax_platforms", os.environ["MLSL_TPU_PLATFORM"])
+
+    import numpy as np
+
+    import mlsl_tpu as mlsl
+    from mlsl_tpu.comm.request import CommDesc, CommRequest
+    from mlsl_tpu.core.stats import isolation_time_request
+    from mlsl_tpu.types import CompressionType, DataType, ReductionType
+
+    env = mlsl.Environment.get_env().init()
+    world = env.get_process_count()
+    dist = env.create_distribution(world, 1)
+    n_ranks = dist.get_process_count_data()
+    bus_factor = 2 * (n_ranks - 1) / n_ranks if n_ranks > 1 else 1.0
+
+    sizes = []
+    s = args.min_kb * 1024
+    while s <= args.max_mb * 1024 * 1024:
+        sizes.append(s)
+        s *= 4
+
+    modes = [("fp32", CompressionType.NONE)]
+    if args.quant:
+        modes.append(("int8", CompressionType.QUANTIZATION))
+
+    print(f"{'bytes':>12} {'mode':>6} {'us/iter':>10} {'algbw GB/s':>11} {'busbw GB/s':>11}")
+    best = 0.0
+    for nbytes in sizes:
+        count = nbytes // 4
+        for name, comp in modes:
+            req = CommRequest(
+                CommDesc(
+                    "allreduce", dist.data_group, count, DataType.FLOAT,
+                    op=ReductionType.SUM, compression=comp,
+                ),
+                env.dispatcher,
+            )
+            req.setup()
+            ns, _ = isolation_time_request(req)
+            algbw = nbytes / max(ns, 1)  # bytes/ns == GB/s
+            best = max(best, algbw * bus_factor)
+            print(
+                f"{nbytes:>12} {name:>6} {ns / 1e3:>10.1f} {algbw:>11.2f} "
+                f"{algbw * bus_factor:>11.2f}"
+            )
+    print(json.dumps({
+        "metric": "allreduce_busbw_peak",
+        "value": round(best, 3),
+        "unit": "GB/s",
+        "ranks": n_ranks,
+    }))
+
+
+if __name__ == "__main__":
+    main()
