@@ -71,7 +71,11 @@ def main():
             req.setup()
             ns, _ = isolation_time_request(req)
             algbw = nbytes / max(ns, 1)  # bytes/ns == GB/s
-            best = max(best, algbw * bus_factor)
+            # the headline busbw uses uncompressed fp32 only: int8's algbw is
+            # computed from the uncompressed payload, so folding it in would
+            # overstate the physical bus bandwidth ~4x
+            if comp == CompressionType.NONE:
+                best = max(best, algbw * bus_factor)
             print(
                 f"{nbytes:>12} {name:>6} {ns / 1e3:>10.1f} {algbw:>11.2f} "
                 f"{algbw * bus_factor:>11.2f}"

@@ -63,6 +63,11 @@ class DataParallelTrainer:
       params: pytree; loss_fn(params, batch) -> scalar;
       layers: ordered list of names; get_layer(params, name) -> subtree (its flattened
       size is the Operation's kernel count).
+
+    Attribute contract: ``trainer.params`` is replaced every step; on the fused
+    (no-comm) path the previous value's buffers are DONATED to XLA and deleted, so a
+    reference held across a step() becomes unreadable. Snapshot with
+    ``jax.device_get(trainer.params)`` (or construct with donate_params=False).
     """
 
     def __init__(
@@ -77,6 +82,7 @@ class DataParallelTrainer:
         distributed_update: bool = False,
         compression: CompressionType = CompressionType.NONE,
         lr: float = 0.05,
+        donate_params: bool = True,
     ):
         self.env = env
         self.dist = dist
@@ -135,7 +141,7 @@ class DataParallelTrainer:
             self.ops[n].get_parameter_set(0).need_comm for n in layers
         )
         sharding = NamedSharding(self.mesh, P())
-        if needs_comm:
+        if needs_comm or not donate_params:
             self.params = jax.device_put(params, sharding)
         else:
             # Owning copy: the fused step donates self.params, so the trainer must
@@ -149,7 +155,9 @@ class DataParallelTrainer:
         self._du_inc_fn = self._build_du_inc_fn() if distributed_update else None
         self._du_apply_fn = self._build_du_apply_fn() if distributed_update else None
         self.distributed_update = distributed_update
-        self._fused_fn = None if needs_comm else self._build_fused_fn()
+        self._fused_fn = (
+            None if needs_comm else self._build_fused_fn(donate=donate_params)
+        )
 
     # -- compiled pieces ---------------------------------------------------
 
@@ -248,13 +256,13 @@ class DataParallelTrainer:
 
         return jax.jit(apply)
 
-    def _build_fused_fn(self):
+    def _build_fused_fn(self, donate: bool = True):
         loss_fn, lr = self.loss_fn, self.lr
 
         # Donating the params lets XLA update weights in place (the trainer owns
         # self.params and always replaces it) — halves parameter HBM traffic in the
         # optimizer tail, something a caller-owned raw-JAX step cannot safely do.
-        @functools.partial(jax.jit, donate_argnums=(0,))
+        @functools.partial(jax.jit, donate_argnums=(0,) if donate else ())
         def fused(params, batch):
             x, y = batch
             x = x.reshape(x.shape[NUM_GRID_AXES:])

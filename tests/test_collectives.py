@@ -232,6 +232,41 @@ def test_color_groups(env):
         np.testing.assert_allclose(dist.local_part(out2, p), expected)
 
 
+def test_byte_bcast_and_int32_allreduce(env):
+    """Non-float dtypes: BYTE bcast (gather+index path) and INT32 sum."""
+    dist = env.create_distribution(1, 8)
+    bbuf = dist.make_buffer(
+        lambda p: np.arange(16, dtype=np.uint8) + p, 16, DataType.BYTE
+    )
+    out = env.wait(dist.bcast(bbuf, 16, DataType.BYTE, 2, GroupType.MODEL))
+    for p in range(8):
+        np.testing.assert_array_equal(
+            dist.local_part(out, p), np.arange(16, dtype=np.uint8) + 2
+        )
+    ibuf = dist.make_buffer(
+        lambda p: np.full(8, p + 1, dtype=np.int32), 8, DataType.INT32
+    )
+    iout = env.wait(
+        dist.all_reduce(ibuf, 8, DataType.INT32, ReductionType.SUM, GroupType.MODEL)
+    )
+    np.testing.assert_array_equal(
+        dist.local_part(iout, 0), np.full(8, 36, dtype=np.int32)
+    )
+
+
+def test_bf16_allreduce(env):
+    from mlsl_tpu.types import DataType as DT
+
+    dist = env.create_distribution(8, 1)
+    buf = dist.make_buffer(lambda p: np.full(8, 0.5 * (p + 1)), 8, DT.BFLOAT16)
+    out = env.wait(
+        dist.all_reduce(buf, 8, DT.BFLOAT16, ReductionType.SUM, GroupType.DATA)
+    )
+    np.testing.assert_allclose(
+        np.asarray(dist.local_part(out, 0), np.float32), np.full(8, 18.0), rtol=0.02
+    )
+
+
 def test_self_group_identity(env):
     dist = env.create_distribution(8, 1)
     buf = fill(dist)
