@@ -29,15 +29,12 @@ def main():
     env = mlsl.Environment.get_env().init()
     world = env.get_process_count()
 
-    # Factor the world into data x seq x model parallelism. With 8 devices:
-    # 2-way batch sharding, 2-way sequence sharding (ring attention), 2-way
-    # tensor parallelism (heads + MLP width over the 'model' axis).
-    if world >= 8:
-        dp, sp, tp = 2, 2, 2
-    elif world >= 2:
-        dp, sp, tp = world // 2, 1, 2
-    else:
-        dp = sp = tp = 1
+    # Factor the world into data x seq x model parallelism so dp*sp*tp == world
+    # for ANY device count: peel a factor of 2 for tensor parallelism, another
+    # for sequence sharding (ring attention), and give the rest to the batch.
+    tp = 2 if world % 2 == 0 else 1
+    sp = 2 if (world // tp) % 2 == 0 and world // tp > 1 else 1
+    dp = world // (tp * sp)
 
     cfg = tfm.TransformerConfig(
         vocab=128, d_model=64, n_heads=8, head_dim=8, n_blocks=2, seq_len=64,

@@ -42,27 +42,35 @@ class CommBlockInfo:
     data_type: DataType
     buf_offset: int  # element offset into the wire buffer
 
-    # PascalCase parity accessors
-    def GetMbOffset(self):
+    # accessor parity (reference mlsl.py get_mb_offset etc. / C++ GetMbOffset)
+    def get_mb_offset(self):
         return self.mb_offset
 
-    def GetMbCount(self):
+    def get_mb_count(self):
         return self.mb_count
 
-    def GetFmOffset(self):
+    def get_fm_offset(self):
         return self.fm_offset
 
-    def GetFmCount(self):
+    def get_fm_count(self):
         return self.fm_count
 
-    def GetFmSize(self):
+    def get_fm_size(self):
         return self.fm_size
 
-    def GetDataType(self):
+    def get_data_type(self):
         return self.data_type
 
-    def GetBufOffset(self):
+    def get_buf_offset(self):
         return self.buf_offset
+
+    GetMbOffset = get_mb_offset
+    GetMbCount = get_mb_count
+    GetFmOffset = get_fm_offset
+    GetFmCount = get_fm_count
+    GetFmSize = get_fm_size
+    GetDataType = get_data_type
+    GetBufOffset = get_buf_offset
 
 
 def pack_local(act_local, blocks: List[CommBlockInfo], local_mb: int, local_fm: int, fm_size: int):
@@ -449,6 +457,22 @@ class Activation:
 
     # -- runtime ----------------------------------------------------------
 
+    def get_comm_buf_size(self) -> int:
+        """Required wire-buffer element count for this activation's collective
+        (reference Activation::GetCommBuf sizing; buffers are functional here, so
+        this is the size the packed distributed buffer must have)."""
+        if self.comm_req is None:
+            return 0
+        return self.comm_req.desc.count
+
+    def get_comm_buf(self):
+        """The most recent communication result for this activation's request, or
+        None (the reference returns the staging buffer; functional arrays make the
+        last result the analog)."""
+        if self.comm_req is None:
+            return None
+        return self.comm_req._result
+
     def start_comm(self, buf) -> None:
         """Dispatch this activation's collective on the packed distributed buffer
         (reference ActivationImpl::StartComm src/mlsl_impl.cpp:354-369)."""
@@ -481,5 +505,7 @@ class Activation:
     GetPackBlock = get_pack_block
     GetUnpackBlockCount = get_unpack_block_count
     GetUnpackBlock = get_unpack_block
+    GetCommBufSize = get_comm_buf_size
+    GetCommBuf = get_comm_buf
     StartComm = start_comm
     WaitComm = wait_comm

@@ -248,3 +248,28 @@ class Environment:
 
     def get_quantization_params(self) -> Optional[QuantParams]:
         return self.quant_params
+
+    def get_version(self) -> str:
+        from mlsl_tpu import __version__
+
+        return __version__
+
+    # PascalCase parity aliases (reference include/mlsl.hpp:799-915)
+    GetVersion = get_version
+    GetEnv = get_env
+    Init = init
+    Finalize = finalize
+    GetProcessCount = get_process_count
+    GetProcessIdx = get_process_idx
+    Configure = configure
+    CreateDistribution = create_distribution
+    CreateDistributionWithColors = create_distribution_with_colors
+    DeleteDistribution = delete_distribution
+    CreateSession = create_session
+    DeleteSession = delete_session
+    Alloc = alloc
+    Free = free
+    Wait = wait
+    Test = test
+    SetQuantizationParams = set_quantization_params
+    GetQuantizationParams = get_quantization_params

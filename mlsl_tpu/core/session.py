@@ -94,13 +94,30 @@ class Operation:
     def __init__(self, reg: OperationRegInfo, session: "Session", distribution, op_idx: int):
         reg.validate()
         self.session = session
-        self.distribution = distribution
+        self.distribution = None
+        self._reg = reg
         self.op_type = reg.op_type
         self.name = reg.name or f"op{op_idx}"
         self.op_idx = op_idx
+        self.inputs: List[Activation] = []
+        self.outputs: List[Activation] = []
+        self.parameter_sets: List[ParameterSet] = []
+        if distribution is not None:
+            self.set_distribution(distribution)
+
+    def set_distribution(self, distribution) -> None:
+        """Bind (or late-bind) the parallelism layout. The reference allows
+        AddOperation(regInfo, NULL) followed by Operation::SetDistribution
+        (include/mlsl.hpp:765-767, :574); activations and parameter sets are
+        derived here because their partitioning depends on the grid."""
+        mlsl_assert(
+            self.distribution is None, "distribution can be set only once"
+        )
+        self.distribution = distribution
+        reg = self._reg
 
         data_size = distribution.get_process_count_data()
-        global_mb = session.global_minibatch_size
+        global_mb = self.session.global_minibatch_size
         mlsl_assert(
             global_mb % data_size == 0,
             "global minibatch %d not divisible by data parts %d",
@@ -154,6 +171,9 @@ class Operation:
     def get_parameter_set_count(self) -> int:
         return len(self.parameter_sets)
 
+    def has_parameter_sets(self) -> bool:
+        return bool(self.parameter_sets)
+
     def get_parameter_set(self, idx: int) -> ParameterSet:
         return self.parameter_sets[idx]
 
@@ -189,6 +209,8 @@ class Operation:
     GetOutput = get_output
     GetParameterSetCount = get_parameter_set_count
     GetParameterSet = get_parameter_set
+    HasParameterSets = has_parameter_sets
+    SetDistribution = set_distribution
     SetPrev = set_prev
     SetNext = set_next
 
@@ -225,11 +247,17 @@ class Session:
     def delete_operation_reg_info(self, reg: OperationRegInfo) -> None:
         return None
 
-    def add_operation(self, reg: OperationRegInfo, distribution) -> int:
+    def add_operation(self, reg: OperationRegInfo, distribution=None) -> int:
+        """Register an operation. distribution may be None (reference
+        AddOperation(regInfo, NULL)) and bound later with
+        Operation.set_distribution — it must be bound before Commit."""
         mlsl_assert(self.global_minibatch_size > 0, "set global minibatch size first")
         op = Operation(reg, self, distribution, len(self.operations))
         self.operations.append(op)
         return len(self.operations) - 1
+
+    # reference mlsl.py exposes both spellings
+    add_operation_with_distribution = add_operation
 
     def remove_operations(self) -> None:
         self.operations.clear()
@@ -247,6 +275,11 @@ class Session:
     def commit(self) -> None:
         """Finalize all graph edges and build the collectives
         (reference SessionImpl::Commit src/mlsl_impl.cpp:567-578)."""
+        for op in self.operations:
+            mlsl_assert(
+                op.distribution is not None,
+                "operation %s has no distribution bound at Commit", op.name,
+            )
         for op in self.operations:
             for act in op.outputs:
                 act.init_peer_connection()

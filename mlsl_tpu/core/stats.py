@@ -186,6 +186,17 @@ class Statistics:
             pass
         return text
 
+    def trace(self, log_dir: str):
+        """Device-level profiler trace context (the jax.profiler complement to the
+        host-side byte/time accounting; view in TensorBoard/Perfetto). Usage:
+
+            with session.get_stats().trace("/tmp/trace"):
+                trainer.step(batch)
+        """
+        import jax
+
+        return jax.profiler.trace(log_dir)
+
     # PascalCase parity aliases
     Start = start
     Stop = stop

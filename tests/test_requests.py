@@ -91,6 +91,53 @@ def test_test_polling(env):
     np.testing.assert_allclose(dist.local_part(out, 0), np.full(64, 28.0))
 
 
+def test_overlapped_requests_with_interleaved_compute(env):
+    """BASELINE config 3: several requests in flight while independent compute
+    dispatches between Start and Wait; all results must be correct."""
+    import jax
+    import jax.numpy as jnp
+
+    dist = env.create_distribution(8, 1)
+    reqs = []
+    for k in range(4):
+        buf = dist.make_buffer(lambda p, k=k: np.full(256, float(p + k)), 256)
+        reqs.append(
+            dist.all_reduce(buf, 256, DataType.FLOAT, ReductionType.SUM, GroupType.DATA)
+        )
+        # independent compute dispatched while the collectives are in flight
+        z = jax.jit(lambda x: (x @ x).sum())(jnp.ones((64, 64)))
+    jax.block_until_ready(z)
+    for k, req in enumerate(reqs):
+        out = env.wait(req)
+        expected = sum(p + k for p in range(8))
+        np.testing.assert_allclose(dist.local_part(out, 0), np.full(256, expected))
+
+
+def test_request_storage_drains(env):
+    """Environment.wait/test must free generic requests (RequestStorage parity)."""
+    dist = env.create_distribution(8, 1)
+    buf = dist.make_buffer(lambda p: np.full(8, 1.0), 8)
+    assert len(env.request_storage) == 0
+    r1 = dist.all_reduce(buf, 8, DataType.FLOAT, ReductionType.SUM, GroupType.DATA)
+    r2 = dist.all_reduce(buf, 8, DataType.FLOAT, ReductionType.SUM, GroupType.DATA)
+    assert len(env.request_storage) == 2
+    env.wait(r1)
+    assert len(env.request_storage) == 1
+    while not env.test(r2)[0]:
+        pass
+    assert len(env.request_storage) == 0
+
+
+def test_stats_trace_context(env, tmp_path):
+    dist = env.create_distribution(8, 1)
+    s = env.create_session()
+    s.set_global_minibatch_size(8)
+    buf = dist.make_buffer(lambda p: np.full(8, 1.0), 8)
+    with s.get_stats().trace(str(tmp_path / "trace")):
+        env.wait(dist.all_reduce(buf, 8, DataType.FLOAT, ReductionType.SUM, GroupType.DATA))
+    assert any((tmp_path / "trace").rglob("*"))
+
+
 def test_wait_after_test_delivers_result(env):
     """MPI semantics: Wait on a test-completed request returns the result."""
     dist = env.create_distribution(8, 1)
