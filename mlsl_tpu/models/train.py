@@ -46,6 +46,21 @@ def _flatten_layer(tree) -> jax.Array:
     return jnp.concatenate([l.reshape(-1).astype(jnp.float32) for l in leaves])
 
 
+def build_owned_increment_fn(mesh, lr: float, norm: float):
+    """Jitted fn: owned-shard gradient buffer -> owned-shard SGD increment
+    (-lr * g / norm), shared by every distributed-update trainer."""
+
+    def inc(g):
+        def body(g):
+            return (-lr * g.reshape(g.shape[NUM_GRID_AXES:]) / norm)[
+                None, None, None, None
+            ]
+
+        return smap(body, mesh, in_specs=_BUF_SPEC, out_specs=_BUF_SPEC)(g)
+
+    return jax.jit(inc)
+
+
 def _unflatten_like(tree, flat: jax.Array):
     leaves, treedef = jax.tree.flatten(tree)
     out, off = [], 0
@@ -220,15 +235,7 @@ class DataParallelTrainer:
 
     def _build_du_inc_fn(self):
         """distributed-update: owned-shard gradient -> owned-shard increment."""
-        lr, data_size = self.lr, self.data_size
-
-        def inc(g):
-            def body(g):
-                return (-lr * g.reshape(g.shape[NUM_GRID_AXES:]) / data_size)[None, None, None, None]
-
-            return smap(body, self.mesh, in_specs=_BUF_SPEC, out_specs=_BUF_SPEC)(g)
-
-        return jax.jit(inc)
+        return build_owned_increment_fn(self.mesh, self.lr, self.data_size)
 
     def _build_du_apply_fn(self):
         layers, get_layer = self.layers, self.get_layer

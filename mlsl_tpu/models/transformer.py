@@ -29,9 +29,9 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 from mlsl_tpu.comm.collectives import _BUF_SPEC
 from mlsl_tpu.comm.mesh import DATA_AXIS, MODEL_AXIS, SEQ_AXIS
 from mlsl_tpu.log import mlsl_assert
-from mlsl_tpu.models.train import smap, _unflatten_like
+from mlsl_tpu.models.train import build_owned_increment_fn, smap, _unflatten_like
 from mlsl_tpu.parallel.sequence import ring_attention, ulysses_attention
-from mlsl_tpu.types import DataType, OpType
+from mlsl_tpu.types import CompressionType, DataType, OpType
 
 
 @dataclasses.dataclass(frozen=True)
@@ -180,14 +180,19 @@ class HybridTrainer:
     """dp x sp x tp training with per-layer MLSL gradient sync over data x seq."""
 
     def __init__(self, env, cfg: TransformerConfig, dp: int, sp: int, tp: int,
-                 batch: int = None, lr: float = 0.1, seed: int = 0):
+                 batch: int = None, lr: float = 0.1, seed: int = 0,
+                 distributed_update: bool = False,
+                 compression=None,
+                 devices=None):
         self.env = env
         self.cfg = cfg
         self.dp, self.sp, self.tp = dp, sp, tp
         self.batch = batch if batch is not None else dp
         mlsl_assert(self.batch % dp == 0, "batch %d %% dp %d", self.batch, dp)
         self.lr = lr
-        self.dist = env.create_distribution(dp, tp, seq_parts=sp)
+        self.dist = env.create_distribution(
+            dp, tp, seq_parts=sp, devices=devices
+        )
         mlsl_assert(
             self.dist.replica_count == 1,
             "device count must equal dp*sp*tp (got %d replicas)",
@@ -230,6 +235,8 @@ class HybridTrainer:
                 n += size
             self.local_counts[name] = n
 
+        self.distributed_update = bool(distributed_update)
+        comp = CompressionType(compression) if compression is not None else CompressionType.NONE
         self.ops = {}
         for name in self.layers:
             reg = self.session.create_operation_reg_info(OpType.CC)
@@ -238,7 +245,11 @@ class HybridTrainer:
             reg.add_output(tp, 1)  # here; grads flow through the parameter sets)
             # MLSL kernel counts are global: the ParameterSet partitions them over the
             # model group, recovering the per-device length local_counts[name]
-            reg.add_parameter_set(self.local_counts[name] * tp, 1, DataType.FLOAT)
+            reg.add_parameter_set(
+                self.local_counts[name] * tp, 1, DataType.FLOAT,
+                distributed_update=self.distributed_update,
+                compression_type=comp,
+            )
             self.ops[name] = self.session.get_operation(
                 self.session.add_operation(reg, self.dist)
             )
@@ -250,6 +261,10 @@ class HybridTrainer:
 
         self._grad_fn = self._build_grad_fn()
         self._update_fn = self._build_update_fn()
+        self._du_inc_fn = self._build_du_inc_fn() if self.distributed_update else None
+        self._du_apply_fn = (
+            self._build_du_apply_fn() if self.distributed_update else None
+        )
 
     # -- compiled programs -------------------------------------------------
 
@@ -330,6 +345,41 @@ class HybridTrainer:
 
         return jax.jit(update)
 
+    def _build_du_inc_fn(self):
+        """distributed update: owned-shard gradient -> owned-shard SGD increment."""
+        return build_owned_increment_fn(
+            self.mesh, self.lr, self.batch * self.cfg.seq_len
+        )
+
+    def _build_du_apply_fn(self):
+        """Apply all-gathered increments: params += inc (per model shard)."""
+        layers, counts = self.layers, self.local_counts
+
+        def body(params, *flat_incs):
+            new = dict(params)
+            for name, inc in zip(layers, flat_incs):
+                inc = inc.reshape(-1)[: counts[name]]
+                sub = params[name]
+                new[name] = jax.tree.map(
+                    lambda p, dd: (p + dd).astype(p.dtype),
+                    sub,
+                    _unflatten_like(sub, inc),
+                )
+            return new
+
+        sm = smap(
+            body, self.mesh,
+            in_specs=(self.specs,) + tuple(_BUF_SPEC for _ in layers),
+            out_specs=self.specs,
+            check=False,
+        )
+        jitted = jax.jit(sm)
+
+        def apply(params, incs):
+            return jitted(params, *[incs[n] for n in layers])
+
+        return apply
+
     # -- step --------------------------------------------------------------
 
     def shard_tokens(self, tokens: np.ndarray, labels: np.ndarray):
@@ -343,12 +393,29 @@ class HybridTrainer:
         loss, grads = self._grad_fn(self.params, tokens, labels)
         for name in reversed(self.layers):
             self.ops[name].get_parameter_set(0).start_gradient_comm(grads[name])
-        reduced = {}
-        for name in self.layers:
-            ps = self.ops[name].get_parameter_set(0)
-            out = ps.wait_gradient_comm()
-            reduced[name] = out if out is not None else grads[name]
-        self.params = self._update_fn(self.params, reduced)
+        if self.distributed_update:
+            # ZeRO-1: update only the owned shard, all-gather the increments
+            incs = {}
+            for name in self.layers:
+                ps = self.ops[name].get_parameter_set(0)
+                owned = ps.wait_gradient_comm()
+                if owned is None:  # degenerate grad group: full local increment
+                    incs[name] = self._du_inc_fn(grads[name])
+                    continue
+                ps.start_increment_comm(self._du_inc_fn(owned))
+            for name in self.layers:
+                ps = self.ops[name].get_parameter_set(0)
+                inc = ps.wait_increment_comm()
+                if inc is not None:
+                    incs[name] = inc
+            self.params = self._du_apply_fn(self.params, incs)
+        else:
+            reduced = {}
+            for name in self.layers:
+                ps = self.ops[name].get_parameter_set(0)
+                out = ps.wait_gradient_comm()
+                reduced[name] = out if out is not None else grads[name]
+            self.params = self._update_fn(self.params, reduced)
         # loss buffer holds per-(data,seq)-shard partial CE sums (replicated over the
         # model axis -> take slot 0); mean = total / (batch * seq_len)
         return jnp.sum(loss[:, :, :, 0]) / (self.batch * self.cfg.seq_len)
