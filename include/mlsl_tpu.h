@@ -117,6 +117,12 @@ int64_t mlsl_parameter_set_wait_gradient_comm(mlsl_handle_t op, int64_t ps_idx,
 
 int mlsl_handle_release(mlsl_handle_t h);
 
+/* Last error message ("ExceptionType: message") from the most recent failed
+ * call on any thread (process-wide, best effort), or "" if none. The returned
+ * pointer refers to thread-local storage: valid on the calling thread until
+ * its next mlsl_get_last_error call. */
+const char* mlsl_get_last_error(void);
+
 #ifdef __cplusplus
 }
 #endif

@@ -14,11 +14,37 @@
 #include <cstdio>
 #include <mutex>
 
+#include <string>
+
 namespace {
 
 PyObject* g_shim = nullptr;
 std::once_flag g_init_flag;
 bool g_owns_interpreter = false;
+std::mutex g_err_mu;
+std::string g_last_error;
+
+void record_error_locked_gil() {
+  /* Capture the pending Python exception as a string (GIL must be held). */
+  PyObject *type = nullptr, *value = nullptr, *tb = nullptr;
+  PyErr_Fetch(&type, &value, &tb);
+  if (value != nullptr) {
+    PyObject* s = PyObject_Str(value);
+    const char* tname = "";
+    if (type != nullptr && PyType_Check(type))
+      tname = reinterpret_cast<PyTypeObject*>(type)->tp_name;
+    const char* text = nullptr;
+    if (s != nullptr) text = PyUnicode_AsUTF8(s);
+    {
+      std::lock_guard<std::mutex> lk(g_err_mu);
+      g_last_error = std::string(tname) + ": " +
+                     (text != nullptr ? text : "<unprintable error>");
+    }
+    Py_XDECREF(s);
+  }
+  PyErr_Restore(type, value, tb);
+  PyErr_Print();
+}
 
 void interpreter_init() {
   if (!Py_IsInitialized()) {
@@ -28,7 +54,7 @@ void interpreter_init() {
   PyGILState_STATE gil = PyGILState_Ensure();
   g_shim = PyImport_ImportModule("mlsl_tpu.c_shim");
   if (g_shim == nullptr) {
-    PyErr_Print();
+    record_error_locked_gil();  // the most common failure: module not on path
     std::fprintf(stderr,
                  "mlsl_tpu: failed to import mlsl_tpu.c_shim "
                  "(is mlsl_tpu on PYTHONPATH?)\n");
@@ -59,16 +85,16 @@ int64_t call_i(const char* name, std::initializer_list<int64_t> args,
     if (res != nullptr) {
       result = PyLong_AsLongLong(res);
       if (PyErr_Occurred()) {
-        PyErr_Print();
+        record_error_locked_gil();
         result = fail;
       }
       Py_DECREF(res);
     } else {
-      PyErr_Print();
+      record_error_locked_gil();
     }
     Py_DECREF(fn);
   } else {
-    PyErr_Print();
+    record_error_locked_gil();
   }
   Py_DECREF(tuple);
   PyGILState_Release(gil);
@@ -90,12 +116,12 @@ mlsl_handle_t collective_start(mlsl_handle_t dist, const char* kind,
   if (res != nullptr) {
     handle = (mlsl_handle_t)PyLong_AsUnsignedLongLong(res);
     if (PyErr_Occurred()) {
-      PyErr_Print();
+      record_error_locked_gil();
       handle = 0;
     }
     Py_DECREF(res);
   } else {
-    PyErr_Print();
+    record_error_locked_gil();
   }
   PyGILState_Release(gil);
   return handle;
@@ -254,6 +280,18 @@ int64_t mlsl_parameter_set_wait_gradient_comm(mlsl_handle_t op, int64_t ps_idx,
 
 int mlsl_handle_release(mlsl_handle_t h) {
   return (int)call_i("handle_release", {(int64_t)h});
+}
+
+const char* mlsl_get_last_error(void) {
+  // Copy under the lock into a thread-local so the returned pointer stays
+  // valid for this thread even if another thread's failure reassigns the
+  // shared string concurrently.
+  static thread_local std::string tl_copy;
+  {
+    std::lock_guard<std::mutex> lk(g_err_mu);
+    tl_copy = g_last_error;
+  }
+  return tl_copy.c_str();
 }
 
 }  /* extern "C" */
