@@ -97,14 +97,25 @@ class Statistics:
 
     def update(self, entity, action: str, is_param: bool, is_increment: bool) -> None:
         """Pre-events ('start','wait','test') attribute elapsed time to compute;
-        post-events ('*_done') attribute it to comm; bytes counted on start."""
+        post-events ('*_done') attribute it to comm; bytes counted on start.
+
+        Peer-op redirection (reference UpdateStats src/mlsl_impl_stats.cpp:564-668):
+        WaitComm on an activation completes the PEER's transfer, so its comm time is
+        charged to the peer's (op, entity) slot."""
         if not self._started:
             return
         now = time.perf_counter_ns()
         delta = now - (self._last_event_ns or now)
         self._last_event_ns = now
-        op_idx = entity.op.op_idx
-        slot = self._slot(op_idx, _entity_key(entity, is_param, is_increment))
+        target = entity
+        if (
+            not is_param
+            and action in ("wait", "wait_done")
+            and getattr(entity, "peer_act", None) is not None
+        ):
+            target = entity.peer_act
+        op_idx = target.op.op_idx
+        slot = self._slot(op_idx, _entity_key(target, is_param, is_increment))
         if action.endswith("_done"):
             slot.comm_ns += delta
         else:

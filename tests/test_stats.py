@@ -1,0 +1,109 @@
+"""Statistics engine tests: online accounting, isolation replay, queries, printer."""
+
+import numpy as np
+import pytest
+
+from mlsl_tpu.types import DataType, GroupType, OpType, ReductionType
+
+
+@pytest.fixture()
+def stats_env(env, monkeypatch):
+    env.config.enable_stats = True
+    yield env
+    env.config.enable_stats = False
+
+
+def _grad_session(env, dist, count=256):
+    s = env.create_session()
+    s.set_global_minibatch_size(8)
+    r = s.create_operation_reg_info(OpType.CC)
+    r.add_input(8, 4)
+    r.add_output(8, 4)
+    r.add_parameter_set(count, 1)
+    op = s.get_operation(s.add_operation(r, dist))
+    s.commit()
+    return s, op
+
+
+def test_online_accounting_and_queries(stats_env):
+    env = stats_env
+    dist = env.create_distribution(8, 1)
+    s, op = _grad_session(env, dist)
+    ps = op.get_parameter_set(0)
+    buf = dist.make_buffer(lambda p: np.ones(256, np.float32), 256)
+    for _ in range(3):
+        ps.start_gradient_comm(buf)
+        ps.wait_gradient_comm()
+    # bytes: 3 starts x 256 elems x 4 B
+    assert s.get_stats().get_comm_size(op.op_idx) == 3 * 256 * 4
+    assert s.get_stats().get_comm_cycles(op.op_idx) > 0
+    assert s.get_stats().get_total_comm_size() == 3 * 256 * 4
+    assert s.get_stats().get_total_compute_cycles() >= 0
+
+
+def test_isolation_replay_runs_at_commit(stats_env):
+    env = stats_env
+    dist = env.create_distribution(8, 1)
+    s, op = _grad_session(env, dist)
+    assert s.get_stats().get_isolation_comm_cycles(op.op_idx) > 0
+    assert s.get_stats().get_total_isolation_comm_cycles() > 0
+
+
+def test_printer_and_reset(stats_env, tmp_path):
+    env = stats_env
+    dist = env.create_distribution(8, 1)
+    s, op = _grad_session(env, dist)
+    ps = op.get_parameter_set(0)
+    buf = dist.make_buffer(lambda p: np.ones(256, np.float32), 256)
+    ps.start_gradient_comm(buf)
+    ps.wait_gradient_comm()
+    text = s.get_stats().print_(str(tmp_path / "stats.log"))
+    assert "GRAD0" in text and "ISOLATE" in text
+    assert (tmp_path / "stats.log").exists()
+    s.get_stats().reset()
+    assert s.get_stats().get_total_comm_size() == 0
+
+
+def test_start_stop_gating(stats_env):
+    env = stats_env
+    dist = env.create_distribution(8, 1)
+    s, op = _grad_session(env, dist)
+    ps = op.get_parameter_set(0)
+    buf = dist.make_buffer(lambda p: np.ones(256, np.float32), 256)
+    s.get_stats().reset()
+    s.get_stats().stop()
+    ps.start_gradient_comm(buf)
+    ps.wait_gradient_comm()
+    assert s.get_stats().get_total_comm_size() == 0  # gated off
+    s.get_stats().start()
+    ps.start_gradient_comm(buf)
+    ps.wait_gradient_comm()
+    assert s.get_stats().get_total_comm_size() == 256 * 4
+
+
+def test_peer_op_redirection(stats_env):
+    """WaitComm on op2's input must charge comm time to op1 (the FPROP owner)."""
+    env = stats_env
+    dist = env.create_distribution(2, 4)
+    s = env.create_session()
+    s.set_global_minibatch_size(8)
+
+    def mk(fm_in, fm_out):
+        r = s.create_operation_reg_info(OpType.CC)
+        r.add_input(fm_in, 4)
+        r.add_output(fm_out, 4)
+        return s.get_operation(s.add_operation(r, dist))
+
+    op1, op2 = mk(16, 32), mk(32, 8)
+    op1.set_next(op2, 0, 0)
+    s.commit()
+    out_act, in_act = op1.get_output(0), op2.get_input(0)
+    n = out_act.comm_req.desc.count
+    buf = dist.make_buffer(lambda p: np.ones(n, np.float32), n)
+    s.get_stats().reset()
+    out_act.start_comm(buf)
+    before_wait_op1 = s.get_stats().get_comm_cycles(op1.op_idx)
+    in_act.wait_comm()  # waits op1's FPROP request
+    # the wait's comm time lands on op1's OA slot, not op2's IA slot
+    assert s.get_stats().get_comm_cycles(op1.op_idx) > before_wait_op1
+    assert s.get_stats().get_comm_cycles(op2.op_idx) == 0
