@@ -38,7 +38,8 @@ typedef enum { MLSL_RT_SUM = 0, MLSL_RT_MIN = 1, MLSL_RT_MAX = 2 } mlsl_reductio
 typedef enum { MLSL_OT_CC = 0, MLSL_OT_BIAS = 1, MLSL_OT_ACT = 2, MLSL_OT_POOL = 3,
                MLSL_OT_SPLIT = 4, MLSL_OT_CONCAT = 5, MLSL_OT_BCAST = 6,
                MLSL_OT_REDUCE = 7, MLSL_OT_DATA = 8, MLSL_OT_EVAL = 9 } mlsl_op_type_t;
-typedef enum { MLSL_CT_NONE = 0, MLSL_CT_QUANTIZATION = 1 } mlsl_compression_t;
+typedef enum { MLSL_CT_NONE = 0, MLSL_CT_QUANTIZATION = 1,
+               MLSL_CT_TOPK = 2 } mlsl_compression_t;
 
 /* ---- environment ---- */
 int mlsl_environment_init(void);

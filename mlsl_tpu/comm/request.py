@@ -104,6 +104,22 @@ class CommRequest:
     def setup(self) -> None:
         """Build (and implicitly compile on first run) the collective programs."""
         d = self.desc
+        if d.compression == CompressionType.TOPK:
+            from mlsl_tpu.comm import sparse
+
+            mlsl_assert(
+                d.kind in ("allreduce", "reduce_scatter")
+                and d.op in (None, ReductionType.SUM),
+                "TOPK compression supports allreduce/reduce_scatter SUM only "
+                "(got %s/%s)",
+                d.kind, d.op,
+            )
+            self._quant_fn, self._err_len = sparse.build_sparse_collective(
+                d.kind, d.group, d.count, self.dispatcher.config.topk_ratio
+            )
+            self._chunk_slices = [slice(None)]
+            self.is_setup = True
+            return
         if d.compression == CompressionType.QUANTIZATION and d.kind in (
             "allreduce",
             "reduce_scatter",

@@ -1,0 +1,106 @@
+"""Top-k sparse gradient allreduce with error feedback.
+
+Motivated by importance-weighted pruning on ring allreduce (PAPERS.md; the
+family of gradient-compression methods the reference's quantization hook anticipated):
+each rank contributes only its k largest-magnitude gradient elements per step; the
+un-sent residual is carried in an error-feedback buffer so every coordinate is
+eventually applied (same accumulator discipline as the int8 path / reference
+quant/quant.c's diff map).
+
+Wire format per member: (k fp32 values, k int32 indices) all-gathered over the group,
+scatter-added into the dense result on every rank. Bytes per member: 8k vs 4n dense —
+a win for k << n (the typical top-k regime is k/n ~ 1%). Exactness contract: the
+result equals the sum of every member's top-k-sparsified contribution.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from mlsl_tpu.comm.collectives import _BUF_SPEC, _gather_group, smap
+from mlsl_tpu.comm.mesh import NUM_GRID_AXES, ProcessGroup
+from mlsl_tpu.log import mlsl_assert
+
+_cache: dict = {}
+
+
+def _sparse_body(x, err, *, axes, sizes, k, n, recv_count):
+    """Local body: (n,), (n,) -> (result, new_err).
+
+    result is the dense sum of sparsified contributions (allreduce), or this
+    member's slice of it (reduce_scatter, recv_count is not None)."""
+    xq = x.astype(jnp.float32) + err
+    _, idx = lax.top_k(jnp.abs(xq), k)
+    vals = jnp.take(xq, idx)
+    # residual: everything not selected this step
+    sparse_mine = jnp.zeros((n,), jnp.float32).at[idx].set(vals)
+    new_err = xq - sparse_mine
+
+    if axes:
+        all_vals = _gather_group(vals, axes)            # (G, k)
+        all_idx = _gather_group(idx, axes)              # (G, k)
+        out = jnp.zeros((n,), jnp.float32).at[all_idx.reshape(-1)].add(
+            all_vals.reshape(-1)
+        )
+    else:
+        out = sparse_mine
+    if recv_count is not None:
+        from mlsl_tpu.comm.collectives import _group_rank
+
+        me = _group_rank(axes, sizes) if axes else 0
+        out = lax.dynamic_slice_in_dim(out, me * recv_count, recv_count, axis=0)
+    return out, new_err
+
+
+def build_sparse_collective(
+    kind: str, group: ProcessGroup, count: int, ratio: float
+) -> Tuple[Callable, int]:
+    """-> (compiled fn (buf, err) -> (result, new_err), err length).
+
+    kind: 'allreduce' or 'reduce_scatter' (MPI slice placement). SUM only,
+    axis-aligned groups (like the quantized path)."""
+    from mlsl_tpu.comm.collectives import _axis_sizes, _group_key
+
+    mlsl_assert(group.colors is None, "sparse collectives require axis-aligned groups")
+    mlsl_assert(0.0 < ratio <= 1.0, "topk ratio must be in (0, 1], got %s", ratio)
+    g = 1 if group.is_self else group.size
+    recv_count = None
+    if kind == "reduce_scatter":
+        mlsl_assert(count % g == 0, "reduce_scatter count %d %% group %d", count, g)
+        recv_count = count // g
+    k = max(1, int(count * ratio))
+    key = (kind, _group_key(group), count, k)
+    fn = _cache.get(key)
+    if fn is not None:
+        return fn, count
+
+    topo = group.topology
+    axes = () if group.is_self else group.axes
+    sizes = _axis_sizes(topo.mesh)
+
+    def local_fn(x, e):
+        out, new_err = _sparse_body(
+            x.reshape(x.shape[NUM_GRID_AXES:]),
+            e.reshape(e.shape[NUM_GRID_AXES:]),
+            axes=axes,
+            sizes=sizes,
+            k=k,
+            n=count,
+            recv_count=recv_count,
+        )
+        return out[None, None, None, None], new_err[None, None, None, None]
+
+    sm = smap(
+        local_fn,
+        topo.mesh,
+        in_specs=(_BUF_SPEC, _BUF_SPEC),
+        out_specs=(_BUF_SPEC, _BUF_SPEC),
+        check=False,
+    )
+    fn = jax.jit(sm)
+    _cache[key] = fn
+    return fn, count

@@ -19,6 +19,11 @@ def _env_int(name: str, default: int) -> int:
     return int(v) if v not in (None, "") else default
 
 
+def _env_float(name: str, default: float) -> float:
+    v = os.environ.get(name)
+    return float(v) if v not in (None, "") else default
+
+
 def _env_bool(name: str, default: bool) -> bool:
     v = os.environ.get(name)
     if v in (None, ""):
@@ -52,8 +57,9 @@ class Config:
     msg_priority_threshold: int = 10000  # MLSL_MSG_PRIORITY_THRESHOLD (bytes)
     msg_priority_mode: bool = True    # MLSL_MSG_PRIORITY_MODE: 1 = LIFO
 
-    # --- quantization ---
+    # --- compression ---
     quant_block_elems: int = 256
+    topk_ratio: float = 0.01       # MLSL_TOPK_RATIO: fraction of elements kept
 
     # --- accepted-for-parity no-ops (MPI/shm specific) ---
     server_affinity: str = ""       # MLSL_SERVER_AFFINITY
@@ -78,6 +84,7 @@ class Config:
         )
         c.msg_priority_mode = _env_bool("MLSL_MSG_PRIORITY_MODE", c.msg_priority_mode)
         c.quant_block_elems = _env_int("MLSL_QUANT_BLOCK_ELEMS", c.quant_block_elems)
+        c.topk_ratio = _env_float("MLSL_TOPK_RATIO", c.topk_ratio)
         c.server_affinity = os.environ.get("MLSL_SERVER_AFFINITY", c.server_affinity)
         c.heap_size_gb = _env_int("MLSL_HEAP_SIZE_GB", c.heap_size_gb)
         c.alltoall_split = _env_int("MLSL_ALLTOALL_SPLIT", c.alltoall_split)

@@ -108,10 +108,14 @@ class OpType(enum.IntEnum):
 
 
 class CompressionType(enum.IntEnum):
-    """Gradient-compression selector (reference include/mlsl.hpp:151-155)."""
+    """Gradient-compression selector (reference include/mlsl.hpp:151-155).
+
+    TOPK (extension): top-k magnitude sparsification with error feedback — the
+    importance-weighted-pruning family of ring-allreduce compressors."""
 
     NONE = 0
     QUANTIZATION = 1
+    TOPK = 2
 
 
 @dataclasses.dataclass
