@@ -364,6 +364,31 @@ def build_collective(kind: str, group: ProcessGroup, dtype, **kw) -> Callable:
     return fn
 
 
+def build_stateful_collective(body, mesh) -> Callable:
+    """Compile a (local_x, local_err) -> (local_out, local_new_err) body into a
+    jitted shard_map over distributed buffers — the shared scaffolding for the
+    error-feedback compressed collectives (int8 ring, top-k sparse).
+
+    check=False: compressed bodies may contain pallas_call, whose outputs carry no
+    VMA annotation."""
+    from mlsl_tpu.comm.mesh import NUM_GRID_AXES
+
+    def local_fn(x, e):
+        out, new_err = body(
+            x.reshape(x.shape[NUM_GRID_AXES:]), e.reshape(e.shape[NUM_GRID_AXES:])
+        )
+        return out[None, None, None, None], new_err[None, None, None, None]
+
+    sm = smap(
+        local_fn,
+        mesh,
+        in_specs=(_BUF_SPEC, _BUF_SPEC),
+        out_specs=(_BUF_SPEC, _BUF_SPEC),
+        check=False,
+    )
+    return jax.jit(sm)
+
+
 def build_barrier(group: ProcessGroup) -> Callable:
     """A tiny psum over the group; Wait-ing its result is the barrier
     (reference Distribution::Barrier src/mlsl.cpp; EP backend uses MPI_Barrier)."""

@@ -174,23 +174,8 @@ def build_quantized_collective(
                 return red_chunks[0, :rc], new_err
             return red_chunks[:, :rc].reshape(-1)[:count], new_err
 
-    def local_fn(x, e):
-        out, new_err = body(
-            x.reshape(x.shape[NUM_GRID_AXES:]), e.reshape(e.shape[NUM_GRID_AXES:])
-        )
-        return out[None, None, None, None], new_err[None, None, None, None]
+    from mlsl_tpu.comm.collectives import build_stateful_collective
 
-    from mlsl_tpu.comm.collectives import smap
-
-    # check=False: pallas_call outputs carry no VMA annotation, which the strict
-    # checker rejects even though the program is correct.
-    sm = smap(
-        local_fn,
-        mesh,
-        in_specs=(_BUF_SPEC, _BUF_SPEC),
-        out_specs=(_BUF_SPEC, _BUF_SPEC),
-        check=False,
-    )
-    fn = jax.jit(sm)
+    fn = build_stateful_collective(body, mesh)
     _cache[key] = fn
     return fn, err_len

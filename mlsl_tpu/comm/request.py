@@ -114,6 +114,7 @@ class CommRequest:
                 "(got %s/%s)",
                 d.kind, d.op,
             )
+            _check_recv_count(d)
             self._quant_fn, self._err_len = sparse.build_sparse_collective(
                 d.kind, d.group, d.count, self.dispatcher.config.topk_ratio
             )
@@ -131,6 +132,7 @@ class CommRequest:
                 "quantized collectives support SUM only (got %s)",
                 d.op,
             )
+            _check_recv_count(d)
             block = self.dispatcher.config.quant_block_elems
             self._quant_fn, self._err_len = quant_ring.build_quantized_collective(
                 d.kind, d.group, d.count, block
@@ -254,6 +256,20 @@ class CommRequest:
             self._completed_via_test = True
             return True, out
         return False, None
+
+
+def _check_recv_count(d: CommDesc) -> None:
+    """Compressed reduce_scatter derives recv_count as count // group_size; a
+    caller-supplied value that disagrees would silently change placement."""
+    if d.kind != "reduce_scatter" or d.recv_count is None:
+        return
+    g = d.group.size if not d.group.is_self else 1
+    mlsl_assert(
+        d.recv_count == d.count // g,
+        "compressed reduce_scatter recv_count %d != count//group %d",
+        d.recv_count,
+        d.count // g,
+    )
 
 
 def _normalize_alltoallv(d: CommDesc) -> dict:

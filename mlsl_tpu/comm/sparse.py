@@ -82,25 +82,13 @@ def build_sparse_collective(
     axes = () if group.is_self else group.axes
     sizes = _axis_sizes(topo.mesh)
 
-    def local_fn(x, e):
-        out, new_err = _sparse_body(
-            x.reshape(x.shape[NUM_GRID_AXES:]),
-            e.reshape(e.shape[NUM_GRID_AXES:]),
-            axes=axes,
-            sizes=sizes,
-            k=k,
-            n=count,
-            recv_count=recv_count,
-        )
-        return out[None, None, None, None], new_err[None, None, None, None]
+    import functools
 
-    sm = smap(
-        local_fn,
-        topo.mesh,
-        in_specs=(_BUF_SPEC, _BUF_SPEC),
-        out_specs=(_BUF_SPEC, _BUF_SPEC),
-        check=False,
+    from mlsl_tpu.comm.collectives import build_stateful_collective
+
+    body = functools.partial(
+        _sparse_body, axes=axes, sizes=sizes, k=k, n=count, recv_count=recv_count
     )
-    fn = jax.jit(sm)
+    fn = build_stateful_collective(body, topo.mesh)
     _cache[key] = fn
     return fn, count

@@ -1,0 +1,177 @@
+"""Flash attention forward kernel (Pallas TPU).
+
+Fused online-softmax attention: scores, exp, and the weighted-value accumulation all
+happen in VMEM tile by tile, so the (Sq, Sk) score matrix never touches HBM — the
+memory win that matters for the long sequences the sequence-parallel schedules target
+(HBM traffic O(S*D) instead of O(S^2)).
+
+Autodiff: a custom VJP recomputes with the reference einsum path in the backward
+(forward memory win kept; backward is the standard dense derivation). Training
+through the kernel is therefore exact to the reference implementation.
+
+Grid: (batch*heads, Sq tiles, Sk tiles), Sk innermost and "arbitrary" so the VMEM
+scratch (acc, row-max, row-sum) carries across k tiles; outputs are written on the
+last k tile (the canonical TPU flash pattern).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+NEG = -1e30
+
+
+def _pick_tiles(sq: int, sk: int):
+    """Largest tiles that divide the shapes (tuned on v5e: big k tiles win —
+    fewer scratch-carry round trips per query tile)."""
+    tq = next((t for t in (512, 256, 128) if sq % t == 0), None)
+    tk = next((t for t in (2048, 1024, 512, 256, 128) if sk % t == 0), None)
+    return tq, tk
+
+
+def _flash_kernel(q_off_ref, k_off_ref, q_ref, k_ref, v_ref, o_ref,
+                  acc_ref, m_ref, l_ref, *, causal: bool, k_tiles: int,
+                  scale: float, tq: int, tk: int):
+    ki = pl.program_id(2)
+    qi = pl.program_id(1)
+
+    @pl.when(ki == 0)
+    def _init():
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+        m_ref[:] = jnp.full_like(m_ref, NEG)
+        l_ref[:] = jnp.zeros_like(l_ref)
+
+    if causal:
+        # whole-tile visibility: skip k tiles entirely in this q tile's future
+        q_pos_max = q_off_ref[0] + (qi + 1) * tq - 1
+        k_pos_min = k_off_ref[0] + ki * tk
+        visible = k_pos_min <= q_pos_max
+    else:
+        visible = True
+
+    @pl.when(visible)
+    def _accumulate():
+        q = q_ref[0].astype(jnp.float32)              # (tq, D)
+        k = k_ref[0].astype(jnp.float32)              # (tk, D)
+        v = v_ref[0].astype(jnp.float32)              # (tk, D)
+        s = jnp.dot(q, k.T, preferred_element_type=jnp.float32) * scale
+
+        if causal:
+            q_pos = (
+                q_off_ref[0] + qi * tq
+                + lax.broadcasted_iota(jnp.int32, (tq, tk), 0)
+            )
+            k_pos = (
+                k_off_ref[0] + ki * tk
+                + lax.broadcasted_iota(jnp.int32, (tq, tk), 1)
+            )
+            s = jnp.where(k_pos <= q_pos, s, NEG)
+
+        m_prev = m_ref[:, 0]                          # (tq,)
+        s_max = jnp.max(s, axis=1)
+        m_new = jnp.maximum(m_prev, s_max)
+        p = jnp.exp(s - m_new[:, None])
+        p = jnp.where(s <= NEG / 2, 0.0, p)
+        corr = jnp.exp(m_prev - m_new)
+        l_new = l_ref[:, 0] * corr + jnp.sum(p, axis=1)
+        acc_ref[:] = acc_ref[:] * corr[:, None] + jnp.dot(
+            p, v, preferred_element_type=jnp.float32
+        )
+        m_ref[:] = jnp.broadcast_to(m_new[:, None], m_ref.shape)
+        l_ref[:] = jnp.broadcast_to(l_new[:, None], l_ref.shape)
+
+    @pl.when(ki == k_tiles - 1)
+    def _finalize():
+        denom = jnp.maximum(l_ref[:, 0], 1e-30)
+        o_ref[0] = (acc_ref[:] / denom[:, None]).astype(o_ref.dtype)
+
+
+@functools.partial(
+    jax.jit, static_argnames=("causal", "interpret")
+)
+def _flash_fwd(q, k, v, q_offset, k_offset, causal=False, interpret=False):
+    """q: (BH, Sq, D), k/v: (BH, Sk, D); shapes must satisfy supports()."""
+    bh, sq, d = q.shape
+    sk = k.shape[1]
+    tq, tk = _pick_tiles(sq, sk)
+    k_tiles = sk // tk
+    scale = 1.0 / (d ** 0.5)
+    grid = (bh, sq // tq, k_tiles)
+    return pl.pallas_call(
+        functools.partial(
+            _flash_kernel, causal=causal, k_tiles=k_tiles, scale=scale,
+            tq=tq, tk=tk,
+        ),
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=2,
+            grid=grid,
+            in_specs=[
+                pl.BlockSpec((1, tq, d), lambda b, i, j, *_: (b, i, 0)),
+                pl.BlockSpec((1, tk, d), lambda b, i, j, *_: (b, j, 0)),
+                pl.BlockSpec((1, tk, d), lambda b, i, j, *_: (b, j, 0)),
+            ],
+            out_specs=pl.BlockSpec((1, tq, d), lambda b, i, j, *_: (b, i, 0)),
+            scratch_shapes=[
+                pltpu.VMEM((tq, d), jnp.float32),
+                pltpu.VMEM((tq, 128), jnp.float32),
+                pltpu.VMEM((tq, 128), jnp.float32),
+            ],
+        ),
+        out_shape=jax.ShapeDtypeStruct((bh, sq, d), q.dtype),
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary"),
+        ),
+        interpret=interpret,
+    )(q_offset, k_offset, q, k, v)
+
+
+def _reference_attention(q, k, v, q_offset, k_offset, causal):
+    scale = 1.0 / (q.shape[-1] ** 0.5)
+    s = jnp.einsum(
+        "bqd,bkd->bqk", q.astype(jnp.float32), k.astype(jnp.float32)
+    ) * scale
+    if causal:
+        q_pos = q_offset[0] + jnp.arange(q.shape[1])
+        k_pos = k_offset[0] + jnp.arange(k.shape[1])
+        s = jnp.where((k_pos[None, :] <= q_pos[:, None])[None], s, NEG)
+    p = jax.nn.softmax(s, axis=-1)
+    return jnp.einsum("bqk,bkd->bqd", p, v.astype(jnp.float32)).astype(q.dtype)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(5, 6))
+def flash_attention(q, k, v, q_offset, k_offset, causal=False, interpret=False):
+    """Fused attention. q: (BH, Sq, D); k, v: (BH, Sk, D); offsets: (1,) int32
+    global position bases (for causal masking across sequence shards)."""
+    return _flash_fwd(q, k, v, q_offset, k_offset, causal=causal, interpret=interpret)
+
+
+def _fwd(q, k, v, q_offset, k_offset, causal, interpret):
+    out = _flash_fwd(q, k, v, q_offset, k_offset, causal=causal, interpret=interpret)
+    return out, (q, k, v, q_offset, k_offset)
+
+
+def _bwd(causal, interpret, res, g):
+    q, k, v, q_offset, k_offset = res
+    # Backward via the dense reference (recompute): exact gradients, no flash bwd
+    # kernel needed; forward memory savings are preserved.
+    _, vjp = jax.vjp(
+        lambda q_, k_, v_: _reference_attention(q_, k_, v_, q_offset, k_offset, causal),
+        q, k, v,
+    )
+    dq, dk, dv = vjp(g)
+    return dq, dk, dv, None, None
+
+
+flash_attention.defvjp(_fwd, _bwd)
+
+
+def supports(sq: int, sk: int, d: int) -> bool:
+    """Whether the kernel's tiling constraints admit these shapes."""
+    tq, tk = _pick_tiles(sq, sk)
+    return tq is not None and tk is not None and d % 8 == 0 and d >= 8
