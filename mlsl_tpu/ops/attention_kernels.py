@@ -35,6 +35,40 @@ def _pick_tiles(sq: int, sk: int):
     return tq, tk
 
 
+def _tile_visible(q_off_ref, k_off_ref, qi, ki, tq, tk, causal: bool):
+    """Whole-tile causal visibility: skip k tiles entirely in this q tile's future."""
+    if not causal:
+        return True
+    q_pos_max = q_off_ref[0] + (qi + 1) * tq - 1
+    k_pos_min = k_off_ref[0] + ki * tk
+    return k_pos_min <= q_pos_max
+
+
+def _tile_accumulate(q_off_ref, k_off_ref, q_ref, k_ref, v_ref,
+                     acc_prev, m_prev, l_prev,
+                     qi, ki, tq, tk, scale, causal: bool):
+    """The online-softmax tile update (shared by both kernels): fold the (tq, tk)
+    score tile into (acc, m, l). Returns the updated triple as values."""
+    q = q_ref[0].astype(jnp.float32)              # (tq, D)
+    k = k_ref[0].astype(jnp.float32)              # (tk, D)
+    v = v_ref[0].astype(jnp.float32)              # (tk, D)
+    s = jnp.dot(q, k.T, preferred_element_type=jnp.float32) * scale
+    if causal:
+        q_pos = q_off_ref[0] + qi * tq + lax.broadcasted_iota(jnp.int32, (tq, tk), 0)
+        k_pos = k_off_ref[0] + ki * tk + lax.broadcasted_iota(jnp.int32, (tq, tk), 1)
+        s = jnp.where(k_pos <= q_pos, s, NEG)
+    s_max = jnp.max(s, axis=1)
+    m_new = jnp.maximum(m_prev, s_max)
+    p = jnp.exp(s - m_new[:, None])
+    p = jnp.where(s <= NEG / 2, 0.0, p)
+    corr = jnp.exp(m_prev - m_new)
+    l_new = l_prev * corr + jnp.sum(p, axis=1)
+    acc_new = acc_prev * corr[:, None] + jnp.dot(
+        p, v, preferred_element_type=jnp.float32
+    )
+    return acc_new, m_new, l_new
+
+
 def _flash_kernel(q_off_ref, k_off_ref, q_ref, k_ref, v_ref, o_ref,
                   acc_ref, m_ref, l_ref, *, causal: bool, k_tiles: int,
                   scale: float, tq: int, tk: int):
@@ -47,42 +81,14 @@ def _flash_kernel(q_off_ref, k_off_ref, q_ref, k_ref, v_ref, o_ref,
         m_ref[:] = jnp.full_like(m_ref, NEG)
         l_ref[:] = jnp.zeros_like(l_ref)
 
-    if causal:
-        # whole-tile visibility: skip k tiles entirely in this q tile's future
-        q_pos_max = q_off_ref[0] + (qi + 1) * tq - 1
-        k_pos_min = k_off_ref[0] + ki * tk
-        visible = k_pos_min <= q_pos_max
-    else:
-        visible = True
-
-    @pl.when(visible)
+    @pl.when(_tile_visible(q_off_ref, k_off_ref, qi, ki, tq, tk, causal))
     def _accumulate():
-        q = q_ref[0].astype(jnp.float32)              # (tq, D)
-        k = k_ref[0].astype(jnp.float32)              # (tk, D)
-        v = v_ref[0].astype(jnp.float32)              # (tk, D)
-        s = jnp.dot(q, k.T, preferred_element_type=jnp.float32) * scale
-
-        if causal:
-            q_pos = (
-                q_off_ref[0] + qi * tq
-                + lax.broadcasted_iota(jnp.int32, (tq, tk), 0)
-            )
-            k_pos = (
-                k_off_ref[0] + ki * tk
-                + lax.broadcasted_iota(jnp.int32, (tq, tk), 1)
-            )
-            s = jnp.where(k_pos <= q_pos, s, NEG)
-
-        m_prev = m_ref[:, 0]                          # (tq,)
-        s_max = jnp.max(s, axis=1)
-        m_new = jnp.maximum(m_prev, s_max)
-        p = jnp.exp(s - m_new[:, None])
-        p = jnp.where(s <= NEG / 2, 0.0, p)
-        corr = jnp.exp(m_prev - m_new)
-        l_new = l_ref[:, 0] * corr + jnp.sum(p, axis=1)
-        acc_ref[:] = acc_ref[:] * corr[:, None] + jnp.dot(
-            p, v, preferred_element_type=jnp.float32
+        acc, m_new, l_new = _tile_accumulate(
+            q_off_ref, k_off_ref, q_ref, k_ref, v_ref,
+            acc_ref[:], m_ref[:, 0], l_ref[:, 0],
+            qi, ki, tq, tk, scale, causal,
         )
+        acc_ref[:] = acc
         m_ref[:] = jnp.broadcast_to(m_new[:, None], m_ref.shape)
         l_ref[:] = jnp.broadcast_to(l_new[:, None], l_ref.shape)
 
@@ -175,3 +181,140 @@ def supports(sq: int, sk: int, d: int) -> bool:
     """Whether the kernel's tiling constraints admit these shapes."""
     tq, tk = _pick_tiles(sq, sk)
     return tq is not None and tk is not None and d % 8 == 0 and d >= 8
+
+
+# ---------------------------------------------------------------------------
+# Carried-state block update: the ring-attention inner step.
+# One k/v block is folded into a running (acc, m, l) online-softmax state that
+# persists across ppermute hops (so it lives in HBM between calls; the kernel
+# fuses score/exp/accumulate for the block without materializing scores).
+# ---------------------------------------------------------------------------
+
+
+def _block_kernel(q_off_ref, k_off_ref, q_ref, k_ref, v_ref,
+                  acc_in_ref, m_in_ref, l_in_ref,
+                  acc_out_ref, m_out_ref, l_out_ref,
+                  *, causal: bool, k_tiles: int, scale: float, tq: int, tk: int):
+    ki = pl.program_id(2)
+    qi = pl.program_id(1)
+
+    @pl.when(ki == 0)
+    def _load_carry():
+        acc_out_ref[0] = acc_in_ref[0]
+        m_out_ref[0] = m_in_ref[0]
+        l_out_ref[0] = l_in_ref[0]
+
+    @pl.when(_tile_visible(q_off_ref, k_off_ref, qi, ki, tq, tk, causal))
+    def _accumulate():
+        acc, m_new, l_new = _tile_accumulate(
+            q_off_ref, k_off_ref, q_ref, k_ref, v_ref,
+            acc_out_ref[0], m_out_ref[0, :, 0], l_out_ref[0, :, 0],
+            qi, ki, tq, tk, scale, causal,
+        )
+        acc_out_ref[0] = acc
+        m_out_ref[0] = jnp.broadcast_to(m_new[:, None], m_out_ref[0].shape)
+        l_out_ref[0] = jnp.broadcast_to(l_new[:, None], l_out_ref[0].shape)
+
+
+@functools.partial(jax.jit, static_argnames=("causal", "interpret"))
+def _block_update_fwd(q, k, v, acc, m, l, q_offset, k_offset,
+                      causal=False, interpret=False):
+    """q: (BH, Sq, D); k/v: (BH, Sk, D); acc: (BH, Sq, D) f32;
+    m, l: (BH, Sq, 128) f32 (lane-padded) -> (acc', m', l')."""
+    bh, sq, d = q.shape
+    sk = k.shape[1]
+    tq, tk = _pick_tiles(sq, sk)
+    k_tiles = sk // tk
+    scale = 1.0 / (d ** 0.5)
+    grid = (bh, sq // tq, k_tiles)
+    return pl.pallas_call(
+        functools.partial(
+            _block_kernel, causal=causal, k_tiles=k_tiles, scale=scale,
+            tq=tq, tk=tk,
+        ),
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=2,
+            grid=grid,
+            in_specs=[
+                pl.BlockSpec((1, tq, d), lambda b, i, j, *_: (b, i, 0)),
+                pl.BlockSpec((1, tk, d), lambda b, i, j, *_: (b, j, 0)),
+                pl.BlockSpec((1, tk, d), lambda b, i, j, *_: (b, j, 0)),
+                pl.BlockSpec((1, tq, d), lambda b, i, j, *_: (b, i, 0)),
+                pl.BlockSpec((1, tq, 128), lambda b, i, j, *_: (b, i, 0)),
+                pl.BlockSpec((1, tq, 128), lambda b, i, j, *_: (b, i, 0)),
+            ],
+            out_specs=[
+                pl.BlockSpec((1, tq, d), lambda b, i, j, *_: (b, i, 0)),
+                pl.BlockSpec((1, tq, 128), lambda b, i, j, *_: (b, i, 0)),
+                pl.BlockSpec((1, tq, 128), lambda b, i, j, *_: (b, i, 0)),
+            ],
+        ),
+        out_shape=[
+            jax.ShapeDtypeStruct((bh, sq, d), jnp.float32),
+            jax.ShapeDtypeStruct((bh, sq, 128), jnp.float32),
+            jax.ShapeDtypeStruct((bh, sq, 128), jnp.float32),
+        ],
+        # alias the carried state in place: operands (2 scalar-prefetch + q,k,v,
+        # acc, m, l) -> acc/m/l reuse their input buffers, saving one HBM copy of
+        # the dominant long-sequence state per ring hop
+        input_output_aliases={5: 0, 6: 1, 7: 2},
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary"),
+        ),
+        interpret=interpret,
+    )(q_offset, k_offset, q, k, v, acc, m, l)
+
+
+def _block_update_ref(q, k, v, acc, m, l, q_offset, k_offset, causal):
+    """jnp twin of the block kernel (used for the VJP and as the oracle)."""
+    scale = 1.0 / (q.shape[-1] ** 0.5)
+    s = jnp.einsum(
+        "bqd,bkd->bqk", q.astype(jnp.float32), k.astype(jnp.float32)
+    ) * scale
+    if causal:
+        q_pos = q_offset[0] + jnp.arange(q.shape[1])
+        k_pos = k_offset[0] + jnp.arange(k.shape[1])
+        s = jnp.where((k_pos[None, :] <= q_pos[:, None])[None], s, NEG)
+    m_prev = m[:, :, 0]
+    s_max = jnp.max(s, axis=-1)
+    m_new = jnp.maximum(m_prev, s_max)
+    p = jnp.exp(s - m_new[..., None])
+    p = jnp.where(s <= NEG / 2, 0.0, p)
+    corr = jnp.exp(m_prev - m_new)
+    l_new = l[:, :, 0] * corr + jnp.sum(p, axis=-1)
+    acc_new = acc * corr[..., None] + jnp.einsum(
+        "bqk,bkd->bqd", p, v.astype(jnp.float32)
+    )
+    bcast = lambda x: jnp.broadcast_to(x[..., None], (*x.shape, 128))
+    return acc_new, bcast(m_new), bcast(l_new)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(8, 9))
+def flash_block_update(q, k, v, acc, m, l, q_offset, k_offset,
+                       causal=False, interpret=False):
+    """Ring-attention inner step: fold one k/v block into (acc, m, l)."""
+    return _block_update_fwd(
+        q, k, v, acc, m, l, q_offset, k_offset, causal=causal, interpret=interpret
+    )
+
+
+def _bu_fwd(q, k, v, acc, m, l, q_offset, k_offset, causal, interpret):
+    out = _block_update_fwd(
+        q, k, v, acc, m, l, q_offset, k_offset, causal=causal, interpret=interpret
+    )
+    return out, (q, k, v, acc, m, l, q_offset, k_offset)
+
+
+def _bu_bwd(causal, interpret, res, g):
+    q, k, v, acc, m, l, q_offset, k_offset = res
+    _, vjp = jax.vjp(
+        lambda q_, k_, v_, acc_, m_, l_: _block_update_ref(
+            q_, k_, v_, acc_, m_, l_, q_offset, k_offset, causal
+        ),
+        q, k, v, acc, m, l,
+    )
+    dq, dk, dv, dacc, dm, dl = vjp(g)
+    return dq, dk, dv, dacc, dm, dl, None, None
+
+
+flash_block_update.defvjp(_bu_fwd, _bu_bwd)

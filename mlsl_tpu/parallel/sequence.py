@@ -25,6 +25,8 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
+from mlsl_tpu.log import mlsl_assert
+
 _NEG = -1e30
 
 
@@ -67,38 +69,97 @@ def ring_attention(
     axis: str,
     axis_size: int,
     causal: bool = False,
+    use_flash: Optional[bool] = None,
 ) -> jax.Array:
-    """Exact attention over the full (sharded) sequence via a k/v ring."""
+    """Exact attention over the full (sharded) sequence via a k/v ring.
+
+    use_flash: None = auto (fused Pallas block kernel on TPU when the tiling
+    admits); True/False forces the choice (True uses interpret mode off-TPU)."""
     if axis_size == 1:
         return _dense_attention(q, k, v, causal, 0)
     b, h, sl, d = q.shape
+    if use_flash is None:
+        use_flash = _use_flash(sl, sl, d)
+    if use_flash:
+        from mlsl_tpu.ops.attention_kernels import supports
+
+        mlsl_assert(
+            supports(sl, sl, d),
+            "flash ring requires local seq %% 128 == 0 and head_dim %% 8 == 0 "
+            "(got seq=%d, head_dim=%d); use use_flash=False",
+            sl, d,
+        )
+        return _ring_flash(q, k, v, axis, axis_size, causal)
     scale = 1.0 / jnp.sqrt(d).astype(q.dtype)
     me = lax.axis_index(axis)
-    perm = [(i, (i + 1) % axis_size) for i in range(axis_size)]
     q_pos = me * sl + jnp.arange(sl)
 
-    acc = jnp.zeros((b, h, sl, d), jnp.float32)
-    m = jnp.full((b, h, sl), _NEG, jnp.float32)
-    l = jnp.zeros((b, h, sl), jnp.float32)
-    # mark the carry as device-varying over the ring axis (shard_map VMA rules:
-    # the loop body mixes in ppermute'd values, so the carry type must be varying)
-    acc, m, l = (_pvary(x, axis) for x in (acc, m, l))
+    init = (
+        _pvary(jnp.zeros((b, h, sl, d), jnp.float32), axis),
+        _pvary(jnp.full((b, h, sl), _NEG, jnp.float32), axis),
+        _pvary(jnp.zeros((b, h, sl), jnp.float32), axis),
+    )
 
-    def step(t, carry):
-        acc, m, l, k_cur, v_cur = carry
-        src = (me - t) % axis_size          # original owner of the current k/v block
+    def step_fn(carry, k_cur, v_cur, src):
+        acc, m, l = carry
         k_pos = src * sl + jnp.arange(sl)
-        acc, m, l = _attn_block_update(
+        return _attn_block_update(
             q.astype(jnp.float32), k_cur.astype(jnp.float32),
             v_cur.astype(jnp.float32), acc, m, l, q_pos, k_pos, causal, scale
         )
-        k_nxt = lax.ppermute(k_cur, axis, perm)
-        v_nxt = lax.ppermute(v_cur, axis, perm)
-        return acc, m, l, k_nxt, v_nxt
 
-    acc, m, l, _, _ = lax.fori_loop(0, axis_size, step, (acc, m, l, k, v))
+    acc, m, l = _ring_schedule(k, v, axis, axis_size, init, step_fn)
     out = acc / jnp.maximum(l[..., None], 1e-30)
     return out.astype(q.dtype)
+
+
+def _ring_schedule(k, v, axis: str, axis_size: int, init_carry, step_fn):
+    """The shared k/v rotation loop: at hop t every device folds the block
+    originally owned by rank (me - t) into its carry, then passes it right."""
+    me = lax.axis_index(axis)
+    perm = [(i, (i + 1) % axis_size) for i in range(axis_size)]
+
+    def step(t, state):
+        carry, k_cur, v_cur = state
+        src = (me - t) % axis_size          # original owner of the current block
+        carry = step_fn(carry, k_cur, v_cur, src)
+        return carry, lax.ppermute(k_cur, axis, perm), lax.ppermute(v_cur, axis, perm)
+
+    carry, _, _ = lax.fori_loop(0, axis_size, step, (init_carry, k, v))
+    return carry
+
+
+def _ring_flash(q, k, v, axis: str, axis_size: int, causal: bool) -> jax.Array:
+    """Ring attention with the fused Pallas block kernel as the inner step: each
+    hop folds the visiting k/v block into the carried (acc, m, l) state without
+    materializing scores (mlsl_tpu.ops.attention_kernels.flash_block_update)."""
+    from mlsl_tpu.ops.attention_kernels import NEG, flash_block_update
+
+    b, h, sl, d = q.shape
+    bh = b * h
+    interpret = jax.default_backend() != "tpu"
+    qf = q.reshape(bh, sl, d)
+    me = lax.axis_index(axis)
+    q_off = jnp.full((1,), me * sl, jnp.int32)
+
+    init = (
+        _pvary(jnp.zeros((bh, sl, d), jnp.float32), axis),
+        _pvary(jnp.full((bh, sl, 128), NEG, jnp.float32), axis),
+        _pvary(jnp.zeros((bh, sl, 128), jnp.float32), axis),
+    )
+
+    def step_fn(carry, k_cur, v_cur, src):
+        acc, m, l = carry
+        k_off = jnp.full((1,), src * sl, jnp.int32)
+        return flash_block_update(
+            qf, k_cur, v_cur, acc, m, l, q_off, k_off, causal, interpret
+        )
+
+    acc, m, l = _ring_schedule(
+        k.reshape(bh, sl, d), v.reshape(bh, sl, d), axis, axis_size, init, step_fn
+    )
+    out = acc / jnp.maximum(l[:, :, :1], 1e-30)
+    return out.reshape(b, h, sl, d).astype(q.dtype)
 
 
 def ulysses_attention(
