@@ -1,0 +1,120 @@
+"""Mixture-of-experts FFN with expert parallelism over the model axis.
+
+Expert parallelism is the modern descendant of the reference's redistribution
+machinery: tokens move to the device holding their expert and back — two AlltoAlls
+over the model group (exactly the reference's case-4/5 AlltoAll redistribution,
+src/mlsl_impl.cpp:203-226, applied per token instead of per feature block).
+
+Switch-style top-1 routing (GShard dispatch algebra): each device routes its local
+tokens, builds a capacity-bounded dispatch tensor, all_to_all's token buffers to the
+expert owners, applies that device's expert FFNs, and returns the outputs for
+gate-weighted combination. Tokens over capacity are dropped (the residual connection
+carries them). Routing gradients flow through the gate probability (argmax is
+non-differentiable by construction).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+def init_moe_params(key, d_model: int, d_ff: int, n_experts: int, std=0.02) -> Dict:
+    k1, k2, k3 = jax.random.split(key, 3)
+    return {
+        "wg": jax.random.normal(k1, (d_model, n_experts)) * std,   # replicated
+        "w1": jax.random.normal(k2, (n_experts, d_model, d_ff)) * std,  # sharded[0]
+        "w2": jax.random.normal(k3, (n_experts, d_ff, d_model)) * std,  # sharded[0]
+    }
+
+
+def _route(x, wg, n_experts: int, capacity: int):
+    """-> (dispatch (T, E, C) f32, combine (T, E, C) f32, aux_loss scalar)."""
+    t = x.shape[0]
+    logits = x.astype(jnp.float32) @ wg.astype(jnp.float32)      # (T, E)
+    probs = jax.nn.softmax(logits, axis=-1)
+    expert = jnp.argmax(probs, axis=-1)                          # (T,)
+    gate = jnp.take_along_axis(probs, expert[:, None], axis=-1)[:, 0]
+    onehot = jax.nn.one_hot(expert, n_experts, dtype=jnp.float32)  # (T, E)
+    # position of each token within its expert's send queue (0-based)
+    pos = (jnp.cumsum(onehot, axis=0) - 1.0) * onehot
+    keep = (pos < capacity).astype(jnp.float32) * onehot
+    slot = jax.nn.one_hot(pos.astype(jnp.int32), capacity, dtype=jnp.float32)
+    dispatch = keep[:, :, None] * slot                            # (T, E, C)
+    combine = dispatch * gate[:, None, None]
+    # switch-transformer load-balancing auxiliary loss
+    frac_tokens = jnp.mean(onehot, axis=0)
+    frac_probs = jnp.mean(probs, axis=0)
+    aux = n_experts * jnp.sum(frac_tokens * frac_probs)
+    return dispatch, combine, aux
+
+
+def _expert_ffn(buf, w1, w2):
+    """buf: (..., El, C, D); w1: (El, D, F); w2: (El, F, D)."""
+    h = jax.nn.gelu(jnp.einsum("...ecd,edf->...ecf", buf, w1.astype(buf.dtype)))
+    return jnp.einsum("...ecf,efd->...ecd", h, w2.astype(buf.dtype))
+
+
+def moe_ffn(
+    x: jax.Array,
+    params: Dict,
+    axis: str,
+    ep: int,
+    capacity_factor: float = 1.25,
+) -> Tuple[jax.Array, jax.Array]:
+    """SPMD MoE feed-forward (call inside shard_map over ``axis`` of size ep).
+
+    x: (T, D) tokens REPLICATED over the expert axis (the transformer's post-psum
+    residual stream). Each rank routes its 1/ep token slice, token buffers
+    all_to_all to the expert owners, expert outputs return and combine, and an
+    all-gather reassembles the replicated output — so routing, expert compute and
+    capacity competition are all sharded over the ep axis.
+    params['w1'/'w2']: this rank's expert shard (El = E/ep experts); 'wg'
+    replicated. -> (out (T, D) f32 replicated, aux-loss scalar for this slice).
+    """
+    t, d = x.shape
+    el = params["w1"].shape[0]
+    n_experts = el * ep
+    if ep == 1:
+        return _moe_slice(x, params, n_experts, capacity_factor)
+
+    me = lax.axis_index(axis)
+    tl = t // ep
+    xs = lax.dynamic_slice_in_dim(x, me * tl, tl, axis=0)         # (Tl, D) distinct
+    capacity = max(1, int(tl * capacity_factor / n_experts))
+    dispatch, combine, aux = _route(xs, params["wg"], n_experts, capacity)
+    buf = jnp.einsum("tec,td->ecd", dispatch, xs.astype(jnp.float32))
+    buf = buf.reshape(ep, el, capacity, d)
+    recv = lax.all_to_all(buf, axis, split_axis=0, concat_axis=0)  # (ep, El, C, D)
+    y = _expert_ffn(recv, params["w1"], params["w2"])              # (ep, El, C, D)
+    back = lax.all_to_all(y, axis, split_axis=0, concat_axis=0)
+    y_full = back.reshape(n_experts, capacity, d)
+    out_slice = jnp.einsum("tec,ecd->td", combine, y_full)         # (Tl, D)
+    out = lax.all_gather(out_slice, axis, axis=0, tiled=True)      # (T, D)
+    return out, aux
+
+
+def _moe_slice(xs, params, n_experts: int, capacity_factor: float):
+    capacity = max(1, int(xs.shape[0] * capacity_factor / n_experts))
+    dispatch, combine, aux = _route(xs, params["wg"], n_experts, capacity)
+    buf = jnp.einsum("tec,td->ecd", dispatch, xs.astype(jnp.float32))
+    y = _expert_ffn(buf, params["w1"], params["w2"])
+    return jnp.einsum("tec,ecd->td", combine, y), aux
+
+
+def moe_ffn_dense(x, wg, w1, w2, ep: int = 1, capacity_factor: float = 1.25):
+    """Single-device oracle reproducing the sharded semantics: tokens are routed in
+    ep independent slices (capacity competition is per slice). w1: (E, D, F)."""
+    t, d = x.shape
+    e = w1.shape[0]
+    params = {"wg": wg, "w1": w1, "w2": w2}
+    outs, auxes = [], []
+    tl = t // ep
+    for s in range(ep):
+        o, a = _moe_slice(x[s * tl : (s + 1) * tl], params, e, capacity_factor)
+        outs.append(o)
+        auxes.append(a)
+    return jnp.concatenate(outs, axis=0), jnp.stack(auxes).mean()

@@ -29,6 +29,7 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 from mlsl_tpu.comm.collectives import _BUF_SPEC
 from mlsl_tpu.comm.mesh import DATA_AXIS, MODEL_AXIS, SEQ_AXIS
 from mlsl_tpu.log import mlsl_assert
+from mlsl_tpu.models.moe import init_moe_params, moe_ffn
 from mlsl_tpu.models.train import build_owned_increment_fn, smap, _unflatten_like
 from mlsl_tpu.parallel.sequence import ring_attention, ulysses_attention
 from mlsl_tpu.types import CompressionType, DataType, OpType
@@ -45,6 +46,9 @@ class TransformerConfig:
     mlp_ratio: int = 4
     attention: str = "ring"  # 'ring' | 'ulysses'
     dtype: str = "bfloat16"  # MXU compute dtype; 'float32' for exactness tests
+    n_experts: int = 0       # >0: MoE FFN with expert parallelism over 'model'
+    moe_aux_weight: float = 0.01
+    capacity_factor: float = 2.0
 
 
 def init_params(key, cfg: TransformerConfig) -> Dict:
@@ -72,12 +76,17 @@ def init_params(key, cfg: TransformerConfig) -> Dict:
             "wqkv": jax.random.normal(next(ks), (dm, 3, h, dh)) * std,
             "wo": jax.random.normal(next(ks), (h, dh, dm)) * std,
         }
-        params[f"blk{i}.mlp"] = {
-            "w1": jax.random.normal(next(ks), (dm, f)) * std,
-            "b1": jnp.zeros((f,)),
-            "w2": jax.random.normal(next(ks), (f, dm)) * std,
-            "b2": jnp.zeros((dm,)),
-        }
+        if cfg.n_experts > 0:
+            params[f"blk{i}.mlp"] = init_moe_params(
+                next(ks), dm, f, cfg.n_experts, std
+            )
+        else:
+            params[f"blk{i}.mlp"] = {
+                "w1": jax.random.normal(next(ks), (dm, f)) * std,
+                "b1": jnp.zeros((f,)),
+                "w2": jax.random.normal(next(ks), (f, dm)) * std,
+                "b2": jnp.zeros((dm,)),
+            }
     return params
 
 
@@ -95,12 +104,20 @@ def param_specs(cfg: TransformerConfig) -> Dict:
             "wqkv": P(None, None, MODEL_AXIS, None),
             "wo": P(MODEL_AXIS, None, None),
         }
-        specs[f"blk{i}.mlp"] = {
-            "w1": P(None, MODEL_AXIS),
-            "b1": P(MODEL_AXIS),
-            "w2": P(MODEL_AXIS, None),
-            "b2": P(),
-        }
+        if cfg.n_experts > 0:
+            # expert parallelism: experts sharded over the model axis
+            specs[f"blk{i}.mlp"] = {
+                "wg": P(),
+                "w1": P(MODEL_AXIS, None, None),
+                "w2": P(MODEL_AXIS, None, None),
+            }
+        else:
+            specs[f"blk{i}.mlp"] = {
+                "w1": P(None, MODEL_AXIS),
+                "b1": P(MODEL_AXIS),
+                "w2": P(MODEL_AXIS, None),
+                "b2": P(),
+            }
     return specs
 
 
@@ -125,11 +142,13 @@ def _ln(x, scale, bias, eps=1e-5):
 def forward_local(params, tokens, cfg: TransformerConfig, sp: int, tp: int):
     """SPMD forward on local shards (call inside shard_map).
 
-    tokens: (Bl, Sl) int32. params: LOCAL shards per param_specs. Returns logits
-    (Bl, Sl, vocab) — replicated over 'model' (psum'd), sharded over data/seq.
+    tokens: (Bl, Sl) int32. params: LOCAL shards per param_specs. Returns
+    (logits (Bl, Sl, vocab) — replicated over 'model' (psum'd), sharded over
+    data/seq — and the MoE aux-loss total, 0.0 without experts).
     """
     emb = params["embed"]
     cdt = jnp.dtype(cfg.dtype)
+    aux_total = jnp.float32(0.0)
     s_idx = lax.axis_index(SEQ_AXIS) if sp > 1 else 0
     sl = tokens.shape[1]
     pos = lax.dynamic_slice_in_dim(emb["pos"], s_idx * sl, sl, axis=0)
@@ -154,26 +173,37 @@ def forward_local(params, tokens, cfg: TransformerConfig, sp: int, tp: int):
         h = (h.astype(jnp.float32) + o).astype(cdt)
 
         a = _ln(h.astype(jnp.float32), lnp["ln2_scale"], lnp["ln2_bias"]).astype(cdt)
-        f = jax.nn.gelu(
-            jnp.einsum("bsd,df->bsf", a, mp["w1"].astype(cdt))
-            + mp["b1"].astype(cdt)
-        )
-        o = jnp.einsum("bsf,fd->bsd", f.astype(jnp.float32), mp["w2"].astype(jnp.float32))
-        o = lax.psum(o, MODEL_AXIS) if tp > 1 else o
-        h = (h.astype(jnp.float32) + o + mp["b2"]).astype(cdt)
+        if cfg.n_experts > 0:
+            bl, sl_, dm = a.shape
+            o2d, aux = moe_ffn(
+                a.reshape(bl * sl_, dm).astype(jnp.float32),
+                mp, MODEL_AXIS, tp, cfg.capacity_factor,
+            )
+            aux_total = aux_total + aux
+            h = (h.astype(jnp.float32) + o2d.reshape(bl, sl_, dm)).astype(cdt)
+        else:
+            f = jax.nn.gelu(
+                jnp.einsum("bsd,df->bsf", a, mp["w1"].astype(cdt))
+                + mp["b1"].astype(cdt)
+            )
+            o = jnp.einsum(
+                "bsf,fd->bsd", f.astype(jnp.float32), mp["w2"].astype(jnp.float32)
+            )
+            o = lax.psum(o, MODEL_AXIS) if tp > 1 else o
+            h = (h.astype(jnp.float32) + o + mp["b2"]).astype(cdt)
 
     fin = params["final"]
     h = _ln(h.astype(jnp.float32), fin["ln_scale"], fin["ln_bias"])
-    return h @ fin["head"]
+    return h @ fin["head"], aux_total
 
 
 def local_loss(params, tokens, labels, cfg, sp, tp):
     """Sum (not mean) of CE over the LOCAL token shard — the reduction across
-    data/seq shards belongs to the MLSL gradient requests."""
-    logits = forward_local(params, tokens, cfg, sp, tp)
+    data/seq shards belongs to the MLSL gradient requests. Returns (ce_sum, aux)."""
+    logits, aux = forward_local(params, tokens, cfg, sp, tp)
     logp = jax.nn.log_softmax(logits.astype(jnp.float32))
     ce = -jnp.take_along_axis(logp, labels[..., None], axis=-1)[..., 0]
-    return jnp.sum(ce)
+    return jnp.sum(ce), aux
 
 
 class HybridTrainer:
@@ -200,6 +230,18 @@ class HybridTrainer:
         )
         mlsl_assert(cfg.n_heads % tp == 0, "heads %d %% tp %d", cfg.n_heads, tp)
         mlsl_assert(cfg.seq_len % sp == 0, "seq %d %% sp %d", cfg.seq_len, sp)
+        if cfg.n_experts > 0:
+            local_tokens = (self.batch // dp) * (cfg.seq_len // sp)
+            mlsl_assert(
+                cfg.n_experts % tp == 0,
+                "n_experts %d must be divisible by tp %d (experts shard over "
+                "the model axis)", cfg.n_experts, tp,
+            )
+            mlsl_assert(
+                local_tokens % tp == 0,
+                "local token count %d (batch/dp * seq/sp) must be divisible by "
+                "tp %d for expert-parallel routing", local_tokens, tp,
+            )
         self.mesh = self.dist.topology.mesh
         self.session = env.create_session()
         self.session.set_global_minibatch_size(self.batch)
@@ -278,16 +320,24 @@ class HybridTrainer:
 
         # SPMD autodiff semantics: differentiating a per-device scalar seeds cotangent
         # 1 on EVERY device, so the computed gradient is d(sum of all devices'
-        # losses)/d(local leaf). The loss is replicated over the model axis (logits
-        # are psum'd), so that sum counts the true loss tp times. Scaling the
-        # differentiated loss by 1/tp makes TP-sharded leaf gradients exact, and
-        # replicated leaves then need exactly one psum over 'model' to collect their
-        # per-branch partials.
+        # losses)/d(local leaf). The CE loss is replicated over the model axis (logits
+        # are psum'd), so that sum counts the true loss tp times — scale it by 1/tp.
+        # The MoE aux loss is per-slice (DEVICE-VARYING over model), so the natural
+        # sum over model ranks is already the total. The synced gradient is later
+        # divided by batch*seq_len (the CE-mean normalizer); pre-scaling aux by
+        # tokens-per-slice makes the effective objective
+        # mean_CE + moe_aux_weight * mean_aux, independent of token count.
+        tokens_per_slice = (self.batch // self.dp) * (cfg.seq_len // self.sp) / tp
+        aux_w = cfg.moe_aux_weight * tokens_per_slice
+
         def scaled_loss(p, t, l):
-            return local_loss(p, t, l, cfg, sp, tp) / tp
+            ce, aux = local_loss(p, t, l, cfg, sp, tp)
+            return ce / tp + aux_w * aux, ce
 
         def body(params, tokens, labels):
-            loss, grads = jax.value_and_grad(scaled_loss)(params, tokens, labels)
+            (_, loss), grads = jax.value_and_grad(scaled_loss, has_aux=True)(
+                params, tokens, labels
+            )
             flat = {}
             for name in layers:
                 parts = []
@@ -303,7 +353,7 @@ class HybridTrainer:
                 flat[name] = jnp.pad(g, (0, padded[name] - g.shape[0]))[
                     None, None, None, None
                 ]
-            return (loss * tp)[None, None, None, None, None], flat
+            return loss[None, None, None, None, None], flat
 
         sm = smap(
             body,

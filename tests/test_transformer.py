@@ -25,9 +25,8 @@ def _oracle_steps(params, toks, labels, lr, n_steps):
     """Single-device full-batch SGD on mean CE (tp=sp=1 path)."""
 
     def mean_loss(p):
-        return tfm.local_loss(p, jnp.asarray(toks), jnp.asarray(labels), CFG, 1, 1) / (
-            toks.shape[0] * CFG.seq_len
-        )
+        ce, _ = tfm.local_loss(p, jnp.asarray(toks), jnp.asarray(labels), CFG, 1, 1)
+        return ce / (toks.shape[0] * CFG.seq_len)
 
     for _ in range(n_steps):
         g = jax.grad(mean_loss)(params)
@@ -120,6 +119,27 @@ def test_hybrid_quantized_converges(env):
     st, sl_ = trainer.shard_tokens(toks, labels)
     losses = [float(trainer.step(st, sl_)) for _ in range(8)]
     assert np.isfinite(losses).all() and losses[-1] < losses[0]
+
+
+def test_hybrid_moe_expert_parallel(env):
+    """MoE transformer with expert parallelism over the model axis (ep=tp=2):
+    trains with finite decreasing loss + aux load balancing. (The moe module's
+    own tests pin SPMD-vs-oracle exactness, forward and gradients.)"""
+    cfg = tfm.TransformerConfig(
+        vocab=32, d_model=16, n_heads=4, head_dim=4, n_blocks=2, seq_len=16,
+        dtype="float32", n_experts=4, moe_aux_weight=0.01,
+    )
+    dp, sp, tp = 2, 1, 2
+    b = 2 * dp
+    trainer = tfm.HybridTrainer(
+        env, cfg, dp, sp, tp, batch=b, lr=0.5, devices=env.devices[: dp * sp * tp]
+    )
+    toks = np.random.default_rng(5).integers(0, 32, size=(b, 16)).astype(np.int32)
+    labels = np.roll(toks, -1, axis=1)
+    st, sl_ = trainer.shard_tokens(toks, labels)
+    losses = [float(np.asarray(trainer.step(st, sl_))) for _ in range(10)]
+    assert np.isfinite(losses).all(), losses
+    assert losses[-1] < losses[0], losses
 
 
 def test_hybrid_ulysses_variant(env):
