@@ -49,6 +49,7 @@ class TransformerConfig:
     n_experts: int = 0       # >0: MoE FFN with expert parallelism over 'model'
     moe_aux_weight: float = 0.01
     capacity_factor: float = 2.0
+    sharded_vocab: bool = False  # shard the LM head over 'model'; CE via collectives
 
 
 def init_params(key, cfg: TransformerConfig) -> Dict:
@@ -94,7 +95,13 @@ def param_specs(cfg: TransformerConfig) -> Dict:
     """PartitionSpec pytree: which leaves are TP-sharded over 'model'."""
     specs = {
         "embed": {"tok": P(), "pos": P()},
-        "final": {"ln_scale": P(), "ln_bias": P(), "head": P()},
+        "final": {
+            "ln_scale": P(),
+            "ln_bias": P(),
+            # large-vocab: the head shards over 'model'; CE is computed from the
+            # per-shard logits with pmax/psum (never materializing full-V logits)
+            "head": P(None, MODEL_AXIS) if cfg.sharded_vocab else P(),
+        },
     }
     for i in range(cfg.n_blocks):
         specs[f"blk{i}.ln"] = {
@@ -143,8 +150,10 @@ def forward_local(params, tokens, cfg: TransformerConfig, sp: int, tp: int):
     """SPMD forward on local shards (call inside shard_map).
 
     tokens: (Bl, Sl) int32. params: LOCAL shards per param_specs. Returns
-    (logits (Bl, Sl, vocab) — replicated over 'model' (psum'd), sharded over
-    data/seq — and the MoE aux-loss total, 0.0 without experts).
+    (final hidden states (Bl, Sl, d_model) f32 — post final-LN, replicated over
+    'model' (psum'd), sharded over data/seq — and the MoE aux-loss total, 0.0
+    without experts). The LM head is applied by the loss (local_loss), which owns
+    the replicated-vs-vocab-sharded distinction.
     """
     emb = params["embed"]
     cdt = jnp.dtype(cfg.dtype)
@@ -194,14 +203,41 @@ def forward_local(params, tokens, cfg: TransformerConfig, sp: int, tp: int):
 
     fin = params["final"]
     h = _ln(h.astype(jnp.float32), fin["ln_scale"], fin["ln_bias"])
-    return h @ fin["head"], aux_total
+    return h, aux_total
+
+
+def _sharded_vocab_ce(h, head_local, labels, vocab_local: int):
+    """CE over a model-axis-sharded vocabulary: per-shard logits + pmax/psum
+    log-sum-exp; the (tokens, V) logits matrix never exists on any device."""
+    logits_l = h @ head_local                                  # (B, S, Vl)
+    # the stability max cancels analytically in d(lse)/d(logits) (= softmax), so
+    # stop_gradient is exact; pmax has no JVP rule, so the cross-shard max rides
+    # a (small) all_gather of the per-shard maxima instead
+    mx = lax.stop_gradient(
+        jnp.max(lax.all_gather(jnp.max(logits_l, axis=-1), MODEL_AXIS, axis=0), axis=0)
+    )                                                          # (B, S)
+    se = lax.psum(
+        jnp.sum(jnp.exp(logits_l - mx[..., None]), axis=-1), MODEL_AXIS
+    )
+    lse = jnp.log(se) + mx
+    off = lax.axis_index(MODEL_AXIS) * vocab_local
+    local_label = jnp.clip(labels - off, 0, vocab_local - 1)
+    in_range = jnp.logical_and(labels >= off, labels < off + vocab_local)
+    picked = jnp.take_along_axis(logits_l, local_label[..., None], axis=-1)[..., 0]
+    label_logit = lax.psum(jnp.where(in_range, picked, 0.0), MODEL_AXIS)
+    return jnp.sum(lse - label_logit)
 
 
 def local_loss(params, tokens, labels, cfg, sp, tp):
     """Sum (not mean) of CE over the LOCAL token shard — the reduction across
-    data/seq shards belongs to the MLSL gradient requests. Returns (ce_sum, aux)."""
-    logits, aux = forward_local(params, tokens, cfg, sp, tp)
-    logp = jax.nn.log_softmax(logits.astype(jnp.float32))
+    data/seq shards belongs to the MLSL gradient requests. Owns the LM head:
+    replicated (dense softmax) or model-axis vocab-sharded (pmax/psum CE, full-V
+    logits never materialize). Returns (ce_sum, aux)."""
+    h, aux = forward_local(params, tokens, cfg, sp, tp)
+    head = params["final"]["head"].astype(jnp.float32)
+    if cfg.sharded_vocab and tp > 1:
+        return _sharded_vocab_ce(h, head, labels, head.shape[-1]), aux
+    logp = jax.nn.log_softmax(h @ head)
     ce = -jnp.take_along_axis(logp, labels[..., None], axis=-1)[..., 0]
     return jnp.sum(ce), aux
 
@@ -230,6 +266,11 @@ class HybridTrainer:
         )
         mlsl_assert(cfg.n_heads % tp == 0, "heads %d %% tp %d", cfg.n_heads, tp)
         mlsl_assert(cfg.seq_len % sp == 0, "seq %d %% sp %d", cfg.seq_len, sp)
+        if cfg.sharded_vocab:
+            mlsl_assert(
+                cfg.vocab % tp == 0, "vocab %d %% tp %d (sharded head)",
+                cfg.vocab, tp,
+            )
         if cfg.n_experts > 0:
             local_tokens = (self.batch // dp) * (cfg.seq_len // sp)
             mlsl_assert(

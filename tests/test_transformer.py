@@ -1,5 +1,7 @@
 """Hybrid dp x sp x tp transformer training vs a single-device oracle."""
 
+import dataclasses
+
 import numpy as np
 import pytest
 import jax
@@ -21,17 +23,27 @@ def _data(b, seed=0):
     return toks, labels
 
 
-def _oracle_steps(params, toks, labels, lr, n_steps):
+def _oracle_steps(params, toks, labels, lr, n_steps, cfg=CFG):
     """Single-device full-batch SGD on mean CE (tp=sp=1 path)."""
 
     def mean_loss(p):
-        ce, _ = tfm.local_loss(p, jnp.asarray(toks), jnp.asarray(labels), CFG, 1, 1)
-        return ce / (toks.shape[0] * CFG.seq_len)
+        ce, _ = tfm.local_loss(p, jnp.asarray(toks), jnp.asarray(labels), cfg, 1, 1)
+        return ce / (toks.shape[0] * cfg.seq_len)
 
     for _ in range(n_steps):
         g = jax.grad(mean_loss)(params)
         params = jax.tree.map(lambda p, gg: p - lr * gg, params, g)
     return params, float(mean_loss(params))
+
+
+def _assert_params_close(trainer, ref_params, atol=2e-2, rtol=2e-2):
+    for g, w in zip(
+        jax.tree.leaves(jax.device_get(trainer.params)),
+        jax.tree.leaves(jax.device_get(ref_params)),
+    ):
+        np.testing.assert_allclose(
+            np.asarray(g, np.float32), np.asarray(w, np.float32), atol=atol, rtol=rtol
+        )
 
 
 @pytest.mark.parametrize("dp,sp,tp", [(2, 2, 2), (8, 1, 1), (1, 4, 2), (2, 4, 1), (1, 2, 4)])
@@ -46,15 +58,7 @@ def test_hybrid_matches_oracle(env, dp, sp, tp):
     for _ in range(2):
         losses.append(float(trainer.step(st, sl_)))
     ref_params, _ = _oracle_steps(ref_params, toks, labels, 0.5, 2)
-
-    got = jax.device_get(trainer.params)
-    want = jax.device_get(ref_params)
-    flat_g = jax.tree.leaves(got)
-    flat_w = jax.tree.leaves(want)
-    for g, w in zip(flat_g, flat_w):
-        np.testing.assert_allclose(
-            np.asarray(g, np.float32), np.asarray(w, np.float32), atol=2e-2, rtol=2e-2
-        )
+    _assert_params_close(trainer, ref_params)
     assert np.isfinite(losses).all()
 
 
@@ -72,13 +76,7 @@ def test_hybrid_distributed_update_matches_oracle(env, dp, sp, tp):
     for _ in range(2):
         trainer.step(st, sl_)
     ref_params, _ = _oracle_steps(ref_params, toks, labels, 0.5, 2)
-    for g, w in zip(
-        jax.tree.leaves(jax.device_get(trainer.params)),
-        jax.tree.leaves(jax.device_get(ref_params)),
-    ):
-        np.testing.assert_allclose(
-            np.asarray(g, np.float32), np.asarray(w, np.float32), atol=2e-2, rtol=2e-2
-        )
+    _assert_params_close(trainer, ref_params)
 
 
 def test_hybrid_zero1_with_quantization(env):
@@ -119,6 +117,22 @@ def test_hybrid_quantized_converges(env):
     st, sl_ = trainer.shard_tokens(toks, labels)
     losses = [float(trainer.step(st, sl_)) for _ in range(8)]
     assert np.isfinite(losses).all() and losses[-1] < losses[0]
+
+
+def test_sharded_vocab_matches_oracle(env):
+    """Model-axis-sharded LM head (CE via pmax/psum, no full-V logits): training
+    must be exactly the replicated-head math."""
+    cfg = dataclasses.replace(CFG, sharded_vocab=True)
+    dp, sp, tp = 2, 2, 2
+    b = 2 * dp
+    trainer = tfm.HybridTrainer(env, cfg, dp, sp, tp, batch=b, lr=0.5)
+    toks, labels = _data(b, seed=6)
+    ref_params = tfm.init_params(jax.random.PRNGKey(0), cfg)
+    st, sl_ = trainer.shard_tokens(toks, labels)
+    for _ in range(2):
+        trainer.step(st, sl_)
+    ref_params, _ = _oracle_steps(ref_params, toks, labels, 0.5, 2, cfg=cfg)
+    _assert_params_close(trainer, ref_params)
 
 
 def test_hybrid_moe_expert_parallel(env):
