@@ -98,6 +98,7 @@ class DataParallelTrainer:
         compression: CompressionType = CompressionType.NONE,
         lr: float = 0.05,
         donate_params: bool = True,
+        overlap_updates: bool = False,
     ):
         self.env = env
         self.dist = dist
@@ -172,6 +173,21 @@ class DataParallelTrainer:
         self.distributed_update = distributed_update
         self._fused_fn = (
             None if needs_comm else self._build_fused_fn(donate=donate_params)
+        )
+        # Test-driven overlap (the reference's canonical loop polls
+        # TestGradientComm and updates each layer as its collective lands,
+        # tests/examples/mlsl_test/mlsl_test.cpp:660-698): per-layer jitted
+        # updates dispatched on completion instead of one barrier-then-update.
+        mlsl_assert(
+            not (overlap_updates and distributed_update),
+            "overlap_updates is not supported together with distributed_update "
+            "(the increment all-gather imposes its own schedule)",
+        )
+        self.overlap_updates = overlap_updates
+        self._layer_update_fns = (
+            {n: self._build_layer_update_fn(n) for n in layers}
+            if self.overlap_updates
+            else None
         )
 
     # -- compiled pieces ---------------------------------------------------
@@ -263,6 +279,25 @@ class DataParallelTrainer:
 
         return jax.jit(apply)
 
+    def _build_layer_update_fn(self, name: str):
+        data_size, lr = self.data_size, self.lr
+        count = self.layer_counts[name]
+
+        def update_layer(sub, g):
+            def body(sub, g):
+                g = g.reshape(-1)[:count] / data_size
+                return jax.tree.map(
+                    lambda p, gg: p - lr * gg, sub, _unflatten_like(sub, g)
+                )
+
+            sm = smap(
+                body, self.mesh, in_specs=(P(), _BUF_SPEC), out_specs=P(),
+                check=False,
+            )
+            return sm(sub, g)
+
+        return jax.jit(update_layer)
+
     def _build_fused_fn(self, donate: bool = True):
         loss_fn, lr = self.loss_fn, self.lr
 
@@ -309,7 +344,36 @@ class DataParallelTrainer:
         for name in reversed(self.layers):
             self.ops[name].get_parameter_set(0).start_gradient_comm(grads[name])
 
-        if not self.distributed_update:
+        if self.overlap_updates:
+            # poll Test and update each layer the moment its collective lands
+            new_params = self.params
+
+            def apply(name, g):
+                nonlocal new_params
+                sub = self._layer_update_fns[name](
+                    self.get_layer(new_params, name), g
+                )
+                new_params = _set_layer(new_params, name, sub)
+
+            pending = list(self.layers)
+            while pending:
+                still = []
+                for name in pending:
+                    ps = self.ops[name].get_parameter_set(0)
+                    done, out = ps.test_gradient_comm()
+                    if done:
+                        apply(name, out if out is not None else grads[name])
+                    else:
+                        still.append(name)
+                if still and len(still) == len(pending):
+                    # nothing landed this pass: block on one to avoid spinning
+                    name = still.pop()
+                    ps = self.ops[name].get_parameter_set(0)
+                    out = ps.wait_gradient_comm()
+                    apply(name, out if out is not None else grads[name])
+                pending = still
+            self.params = new_params
+        elif not self.distributed_update:
             reduced = {}
             for name in self.layers:
                 ps = self.ops[name].get_parameter_set(0)

@@ -54,6 +54,93 @@ def test_dp_training_matches_oracle(env, distributed_update):
             np.testing.assert_allclose(np.asarray(g), np.asarray(w), atol=2e-5, rtol=2e-4)
 
 
+def test_overlap_updates_matches_oracle(env):
+    """Test-driven per-layer updates (the reference's canonical TestGradientComm
+    polling loop) must produce identical training to the barrier-then-update path."""
+    from mlsl_tpu.models.train import DataParallelTrainer
+
+    params = mlp_init(jax.random.PRNGKey(0))
+    dist = env.create_distribution(8, 1)
+    sess = env.create_session()
+    sess.set_global_minibatch_size(32)
+    trainer = DataParallelTrainer(
+        env, dist, sess, params, mlp_loss, LAYERS, get_layer,
+        overlap_updates=True, lr=0.1,
+    )
+    assert trainer.overlap_updates
+    x, y = _make_data(32)
+    ref = params
+    for _ in range(3):
+        trainer.step(trainer.shard_batch(x, y))
+        ref = _oracle_step(ref, x, y, 0.1)
+    for name in LAYERS:
+        for g, w in zip(
+            jax.tree.leaves(get_layer(jax.device_get(trainer.params), name)),
+            jax.tree.leaves(get_layer(jax.device_get(ref), name)),
+        ):
+            np.testing.assert_allclose(np.asarray(g), np.asarray(w), atol=2e-5, rtol=2e-4)
+
+
+def test_overlap_updates_with_nested_layer_names(env):
+    """Overlap updates must address layers through get_layer/_set_layer — nested
+    names like ResNet's 'stage0.0' are not top-level dict keys."""
+    from mlsl_tpu.models.train import DataParallelTrainer
+
+    k1, k2 = jax.random.split(jax.random.PRNGKey(0))
+    params = {
+        # asymmetric init: an all-equal fc would zero the upstream gradient
+        "stage0": [
+            {"w": jax.random.normal(k1, (4, 4)) * 0.3, "b": jnp.zeros((4,))},
+        ],
+        "fc": {"w": jax.random.normal(k2, (4, 2)) * 0.3, "b": jnp.zeros((2,))},
+    }
+
+    def loss_fn(p, batch):
+        x, y = batch
+        h = jnp.tanh(x @ p["stage0"][0]["w"] + p["stage0"][0]["b"])
+        logits = h @ p["fc"]["w"] + p["fc"]["b"]
+        return -jnp.mean(
+            jnp.take_along_axis(jax.nn.log_softmax(logits), y[:, None], axis=1)
+        )
+
+    def getl(p, name):
+        if name == "fc":
+            return p["fc"]
+        stage, idx = name.split(".")
+        return p[stage][int(idx)]
+
+    dist = env.create_distribution(8, 1)
+    sess = env.create_session()
+    sess.set_global_minibatch_size(16)
+    trainer = DataParallelTrainer(
+        env, dist, sess, params, loss_fn, ["stage0.0", "fc"], getl,
+        overlap_updates=True, lr=0.1,
+    )
+    rng = np.random.default_rng(0)
+    x = rng.normal(size=(16, 4)).astype(np.float32)
+    y = rng.integers(0, 2, size=(16,)).astype(np.int32)
+    before = jax.device_get(jax.tree.map(lambda a: a, getl(trainer.params, "stage0.0")))
+    trainer.step(trainer.shard_batch(x, y))
+    after = jax.device_get(getl(trainer.params, "stage0.0"))
+    # the nested block actually trained (and no bogus flat key appeared)
+    assert not np.allclose(np.asarray(before["w"]), np.asarray(after["w"]))
+    assert "stage0.0" not in trainer.params
+
+
+def test_overlap_with_distributed_update_rejected(env):
+    from mlsl_tpu.log import MLSLError
+    from mlsl_tpu.models.train import DataParallelTrainer
+
+    dist = env.create_distribution(8, 1)
+    sess = env.create_session()
+    sess.set_global_minibatch_size(16)
+    with pytest.raises(MLSLError):
+        DataParallelTrainer(
+            env, dist, sess, mlp_init(jax.random.PRNGKey(0)), mlp_loss,
+            LAYERS, get_layer, distributed_update=True, overlap_updates=True,
+        )
+
+
 def test_dp_training_quantized_converges(env):
     """Quantized grad sync: not bit-equal, but loss must decrease."""
     from mlsl_tpu.models.train import DataParallelTrainer
