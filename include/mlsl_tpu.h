@@ -75,6 +75,25 @@ mlsl_handle_t mlsl_distribution_all_to_all(mlsl_handle_t dist, const void* send,
                                            int64_t send_count,
                                            mlsl_data_type_t dt,
                                            mlsl_group_type_t group);
+mlsl_handle_t mlsl_distribution_reduce(mlsl_handle_t dist, const void* send,
+                                       int64_t count, mlsl_data_type_t dt,
+                                       mlsl_reduction_t op, int64_t root,
+                                       mlsl_group_type_t group);
+mlsl_handle_t mlsl_distribution_gather(mlsl_handle_t dist, const void* send,
+                                       int64_t send_count, mlsl_data_type_t dt,
+                                       int64_t root, mlsl_group_type_t group);
+/* send: (world, send_count) where send_count = group_size * recv_count. */
+mlsl_handle_t mlsl_distribution_scatter(mlsl_handle_t dist, const void* send,
+                                        int64_t send_count, mlsl_data_type_t dt,
+                                        int64_t root, mlsl_group_type_t group);
+/* pairs: int64 array [src0, dst0, src1, dst1, ...] of length 2 * n_pairs;
+ * n_pairs counts (src, dst) PAIRS, not array elements. */
+mlsl_handle_t mlsl_distribution_send_recv_list(mlsl_handle_t dist,
+                                               const void* send, int64_t count,
+                                               mlsl_data_type_t dt,
+                                               const int64_t* pairs,
+                                               int64_t n_pairs,
+                                               mlsl_group_type_t group);
 int mlsl_distribution_barrier(mlsl_handle_t dist, mlsl_group_type_t group);
 
 /* ---- request completion (reference Environment::Wait/Test) ---- */

@@ -116,7 +116,9 @@ def dist_collective_start(
         req = dist.reduce(buf, count, data_type, ReductionType(op), root, gt)
     elif kind == "allgather":
         req = dist.all_gather(buf, count, data_type, gt)
-    elif kind in ("reduce_scatter", "alltoall"):
+    elif kind == "gather":
+        req = dist.gather(buf, count, data_type, root, gt)
+    elif kind in ("scatter", "reduce_scatter", "alltoall"):
         from mlsl_tpu.log import mlsl_assert
 
         g = dist._group(gt)
@@ -126,12 +128,13 @@ def dist_collective_start(
             "%s send count %d must be divisible by group size %d",
             kind, count, gsize,
         )
-        if kind == "reduce_scatter":
-            req = dist.reduce_scatter(
-                buf, count // gsize, data_type, ReductionType(op), gt
-            )
+        per = count // gsize
+        if kind == "scatter":
+            req = dist.scatter(buf, per, data_type, root, gt)
+        elif kind == "reduce_scatter":
+            req = dist.reduce_scatter(buf, per, data_type, ReductionType(op), gt)
         else:
-            req = dist.all_to_all(buf, count // gsize, data_type, gt)
+            req = dist.all_to_all(buf, per, data_type, gt)
     else:
         raise ValueError(f"unknown collective {kind}")
     return _put((dist, req))
@@ -151,6 +154,22 @@ def request_test(req_h: int) -> int:
     dist, req = _get(req_h)
     done, _ = req.test()
     return 1 if done else 0
+
+
+def dist_send_recv_list(
+    dist_h: int, addr: int, count: int, data_type: int,
+    pairs_addr: int, n_pairs: int, group: int,
+) -> int:
+    """pairs_addr: int64 array [src0, dst0, src1, dst1, ...] of length 2*n_pairs."""
+    dist = _get(dist_h)
+    flat = np.ctypeslib.as_array(
+        ctypes.cast(int(pairs_addr), ctypes.POINTER(ctypes.c_int64)),
+        shape=(2 * n_pairs,),
+    )
+    pairs = [(int(flat[2 * i]), int(flat[2 * i + 1])) for i in range(n_pairs)]
+    buf = _read_world_buffer(dist, addr, count, data_type)
+    req = dist.send_recv_list(buf, count, data_type, pairs, GroupType(group))
+    return _put((dist, req))
 
 
 def dist_barrier(dist_h: int, group: int) -> int:

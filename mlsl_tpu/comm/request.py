@@ -91,6 +91,9 @@ class CommRequest:
         self._result: Optional[jax.Array] = None
         self._quant_fn: Optional[Callable] = None
         self._err: Optional[jax.Array] = None  # quantization error-feedback state
+        self._quant_fns: Optional[List[Callable]] = None  # chunked quant programs
+        self._err_lens: Optional[List[int]] = None
+        self._errs: Optional[List[jax.Array]] = None
         self._completed_via_test = False
         self.is_started = False
         self.is_setup = False
@@ -134,10 +137,24 @@ class CommRequest:
             )
             _check_recv_count(d)
             block = self.dispatcher.config.quant_block_elems
-            self._quant_fn, self._err_len = quant_ring.build_quantized_collective(
-                d.kind, d.group, d.count, block
-            )
-            self._chunk_slices = [slice(None)]
+            chunks = self._plan_chunks(compressed_ok=True)
+            if chunks is not None and d.kind == "allreduce":
+                # large quantized allreduce: independent per-chunk ring programs,
+                # each with its own error-feedback state (slices are disjoint)
+                self._quant_fns = []
+                self._err_lens = []
+                for sl in chunks:
+                    fn, el = quant_ring.build_quantized_collective(
+                        d.kind, d.group, sl.stop - sl.start, block
+                    )
+                    self._quant_fns.append(fn)
+                    self._err_lens.append(el)
+                self._chunk_slices = chunks
+            else:
+                self._quant_fn, self._err_len = quant_ring.build_quantized_collective(
+                    d.kind, d.group, d.count, block
+                )
+                self._chunk_slices = [slice(None)]
             self.is_setup = True
             return
         if d.kind == "barrier":
@@ -174,11 +191,13 @@ class CommRequest:
             self._chunk_slices = chunks
         self.is_setup = True
 
-    def _plan_chunks(self):
+    def _plan_chunks(self, compressed_ok: bool = False):
         """Chunk only elementwise-decomposable hot collectives (allreduce)."""
         d = self.desc
         cfg = self.dispatcher.config
-        if d.kind != "allreduce" or d.compression != CompressionType.NONE:
+        if d.kind != "allreduce":
+            return None
+        if d.compression != CompressionType.NONE and not compressed_ok:
             return None
         threshold = cfg.large_msg_size_mb * 1024 * 1024
         if threshold <= 0 or d.payload_bytes() <= threshold or cfg.large_msg_chunks <= 1:
@@ -206,9 +225,22 @@ class CommRequest:
 
     def _dispatch(self, buf: jax.Array) -> None:
         """Actually launch the XLA programs (called by the Dispatcher)."""
-        if self._quant_fn is not None:
+        if self._quant_fn is not None or self._quant_fns is not None:
+            topo = self.desc.group.topology
+            if self._quant_fns is not None:
+                if self._errs is None:
+                    self._errs = [
+                        topo.shard_buffer(
+                            np.zeros((*topo.grid_shape, el), dtype=np.float32)
+                        )
+                        for el in self._err_lens
+                    ]
+                self._results = []
+                for i, (fn, sl) in enumerate(zip(self._quant_fns, self._chunk_slices)):
+                    out, self._errs[i] = fn(buf[..., sl], self._errs[i])
+                    self._results.append(out)
+                return
             if self._err is None:
-                topo = self.desc.group.topology
                 self._err = topo.shard_buffer(
                     np.zeros((*topo.grid_shape, self._err_len), dtype=np.float32)
                 )

@@ -191,6 +191,38 @@ mlsl_handle_t mlsl_distribution_all_to_all(mlsl_handle_t dist, const void* send,
   return collective_start(dist, "alltoall", send, send_count, dt, 0, 0, group);
 }
 
+mlsl_handle_t mlsl_distribution_reduce(mlsl_handle_t dist, const void* send,
+                                       int64_t count, mlsl_data_type_t dt,
+                                       mlsl_reduction_t op, int64_t root,
+                                       mlsl_group_type_t group) {
+  return collective_start(dist, "reduce", send, count, dt, op, root, group);
+}
+
+mlsl_handle_t mlsl_distribution_gather(mlsl_handle_t dist, const void* send,
+                                       int64_t send_count, mlsl_data_type_t dt,
+                                       int64_t root, mlsl_group_type_t group) {
+  return collective_start(dist, "gather", send, send_count, dt, 0, root, group);
+}
+
+mlsl_handle_t mlsl_distribution_scatter(mlsl_handle_t dist, const void* send,
+                                        int64_t send_count, mlsl_data_type_t dt,
+                                        int64_t root, mlsl_group_type_t group) {
+  return collective_start(dist, "scatter", send, send_count, dt, 0, root, group);
+}
+
+mlsl_handle_t mlsl_distribution_send_recv_list(mlsl_handle_t dist,
+                                               const void* send, int64_t count,
+                                               mlsl_data_type_t dt,
+                                               const int64_t* pairs,
+                                               int64_t n_pairs,
+                                               mlsl_group_type_t group) {
+  return (mlsl_handle_t)call_i(
+      "dist_send_recv_list",
+      {(int64_t)dist, (int64_t)(intptr_t)send, count, (int64_t)dt,
+       (int64_t)(intptr_t)pairs, n_pairs, (int64_t)group},
+      0);
+}
+
 int mlsl_distribution_barrier(mlsl_handle_t dist, mlsl_group_type_t group) {
   return (int)call_i("dist_barrier", {(int64_t)dist, (int64_t)group});
 }

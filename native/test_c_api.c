@@ -99,6 +99,42 @@ int main(void) {
     printf("single-process no-comm OK\n");
   }
 
+  /* full collective surface: reduce, gather, scatter, send_recv_list */
+  if (world > 1) {
+    mlsl_handle_t r = mlsl_distribution_reduce(dist, send, n, MLSL_DT_FLOAT,
+                                               MLSL_RT_MAX, 0, MLSL_GT_DATA);
+    CHECK(r != 0 && mlsl_request_wait(r, recv, n, MLSL_DT_FLOAT) == 0, "reduce");
+    CHECK(recv[0] == (float)world, "reduce max value");
+
+    mlsl_handle_t g = mlsl_distribution_gather(dist, send, n, MLSL_DT_FLOAT, 0,
+                                               MLSL_GT_DATA);
+    float* gout2 = malloc(sizeof(float) * world * world * n);
+    CHECK(g != 0 && mlsl_request_wait(g, gout2, world * n, MLSL_DT_FLOAT) == 0,
+          "gather");
+    CHECK(gout2[0] == 1.0f && gout2[n] == 2.0f, "gather layout");
+
+    mlsl_handle_t sc = mlsl_distribution_scatter(dist, gout2, world * n,
+                                                 MLSL_DT_FLOAT, 0, MLSL_GT_DATA);
+    CHECK(sc != 0 && mlsl_request_wait(sc, recv, n, MLSL_DT_FLOAT) == 0,
+          "scatter");
+    CHECK(recv[0] == 1.0f && recv[(world - 1) * n] == (float)world,
+          "scatter placement");
+
+    int64_t* pairs = malloc(sizeof(int64_t) * 2 * world);
+    for (int64_t i = 0; i < world; ++i) {
+      pairs[2 * i] = i;
+      pairs[2 * i + 1] = (i + 1) % world;  /* ring shift */
+    }
+    mlsl_handle_t sr = mlsl_distribution_send_recv_list(
+        dist, send, n, MLSL_DT_FLOAT, pairs, world, MLSL_GT_DATA);
+    CHECK(sr != 0 && mlsl_request_wait(sr, recv, n, MLSL_DT_FLOAT) == 0,
+          "send_recv_list");
+    CHECK(recv[0] == (float)world, "ring shift value"); /* rank0 <- rank world-1 */
+    printf("reduce/gather/scatter/sendrecv OK\n");
+    free(gout2);
+    free(pairs);
+  }
+
   CHECK(mlsl_distribution_barrier(dist, MLSL_GT_GLOBAL) == 0, "barrier");
   CHECK(mlsl_environment_finalize() == 0, "finalize");
   printf("C API TEST PASSED\n");

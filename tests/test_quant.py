@@ -173,6 +173,38 @@ def test_quantized_reduce_scatter_unaligned(env):
         assert rel < 0.02, f"rank {p} rel err {rel}"
 
 
+def test_quantized_allreduce_chunked(env):
+    """Quantized + large-message chunking composed: per-chunk rings with
+    independent error feedback must still approximate the exact sum."""
+    env.config.large_msg_size_mb = 1
+    env.config.large_msg_chunks = 4
+    n = 1024 * 1024  # 4 MiB fp32 > 1 MiB threshold
+    dist = env.create_distribution(8, 1)
+    rng = np.random.default_rng(9)
+    vals = {p: rng.normal(size=n).astype(np.float32) for p in range(8)}
+    buf = dist.make_buffer(lambda p: vals[p], n)
+
+    from mlsl_tpu.comm.request import CommDesc, CommRequest
+
+    req = CommRequest(
+        CommDesc(
+            "allreduce", dist.data_group, n, DataType.FLOAT,
+            op=ReductionType.SUM, compression=CompressionType.QUANTIZATION,
+        ),
+        env.dispatcher,
+    )
+    req.setup()
+    assert len(req._chunk_slices) == 4
+    for _ in range(2):  # two iterations: error feedback per chunk persists
+        req.start(buf)
+        out = req.wait()
+    exact = sum(vals[q] for q in range(8))
+    got = np.asarray(dist.local_part(out, 0))
+    assert got.shape == (n,)
+    rel = np.linalg.norm(got - exact) / np.linalg.norm(exact)
+    assert rel < 0.02, rel
+
+
 def test_quantized_non_sum_rejected(env):
     from mlsl_tpu.comm.request import CommDesc, CommRequest
     from mlsl_tpu.log import MLSLError
