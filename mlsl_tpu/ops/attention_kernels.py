@@ -5,9 +5,12 @@ happen in VMEM tile by tile, so the (Sq, Sk) score matrix never touches HBM — 
 memory win that matters for the long sequences the sequence-parallel schedules target
 (HBM traffic O(S*D) instead of O(S^2)).
 
-Autodiff: a custom VJP recomputes with the reference einsum path in the backward
-(forward memory win kept; backward is the standard dense derivation). Training
-through the kernel is therefore exact to the reference implementation.
+Autodiff: a custom VJP with fused Pallas backward kernels — dq accumulates over key
+tiles, dk/dv over query tiles, with the tile probabilities recomputed from the saved
+per-row log-sum-exp, so the O(S*D) memory property holds in the backward too. On
+fully-masked rows the kernel's gradients are exactly zero (consistent with its zero
+forward output), unlike a dense softmax which would leak uniform-distribution
+gradients.
 
 Grid: (batch*heads, Sq tiles, Sk tiles), Sk innermost and "arbitrary" so the VMEM
 scratch (acc, row-max, row-sum) carries across k tiles; outputs are written on the
@@ -69,7 +72,7 @@ def _tile_accumulate(q_off_ref, k_off_ref, q_ref, k_ref, v_ref,
     return acc_new, m_new, l_new
 
 
-def _flash_kernel(q_off_ref, k_off_ref, q_ref, k_ref, v_ref, o_ref,
+def _flash_kernel(q_off_ref, k_off_ref, q_ref, k_ref, v_ref, o_ref, lse_ref,
                   acc_ref, m_ref, l_ref, *, causal: bool, k_tiles: int,
                   scale: float, tq: int, tk: int):
     ki = pl.program_id(2)
@@ -96,13 +99,18 @@ def _flash_kernel(q_off_ref, k_off_ref, q_ref, k_ref, v_ref, o_ref,
     def _finalize():
         denom = jnp.maximum(l_ref[:, 0], 1e-30)
         o_ref[0] = (acc_ref[:] / denom[:, None]).astype(o_ref.dtype)
+        lse_ref[0] = jnp.broadcast_to(
+            (m_ref[:, 0] + jnp.log(denom))[:, None], lse_ref[0].shape
+        )
 
 
 @functools.partial(
     jax.jit, static_argnames=("causal", "interpret")
 )
 def _flash_fwd(q, k, v, q_offset, k_offset, causal=False, interpret=False):
-    """q: (BH, Sq, D), k/v: (BH, Sk, D); shapes must satisfy supports()."""
+    """q: (BH, Sq, D), k/v: (BH, Sk, D); shapes must satisfy supports().
+    -> (out (BH, Sq, D), lse (BH, Sq, 128) f32 — per-row log-sum-exp of the
+    scaled scores, lane-broadcast; slice [:, :, 0] for the logical value)."""
     bh, sq, d = q.shape
     sk = k.shape[1]
     tq, tk = _pick_tiles(sq, sk)
@@ -122,14 +130,20 @@ def _flash_fwd(q, k, v, q_offset, k_offset, causal=False, interpret=False):
                 pl.BlockSpec((1, tk, d), lambda b, i, j, *_: (b, j, 0)),
                 pl.BlockSpec((1, tk, d), lambda b, i, j, *_: (b, j, 0)),
             ],
-            out_specs=pl.BlockSpec((1, tq, d), lambda b, i, j, *_: (b, i, 0)),
+            out_specs=[
+                pl.BlockSpec((1, tq, d), lambda b, i, j, *_: (b, i, 0)),
+                pl.BlockSpec((1, tq, 128), lambda b, i, j, *_: (b, i, 0)),
+            ],
             scratch_shapes=[
                 pltpu.VMEM((tq, d), jnp.float32),
                 pltpu.VMEM((tq, 128), jnp.float32),
                 pltpu.VMEM((tq, 128), jnp.float32),
             ],
         ),
-        out_shape=jax.ShapeDtypeStruct((bh, sq, d), q.dtype),
+        out_shape=[
+            jax.ShapeDtypeStruct((bh, sq, d), q.dtype),
+            jax.ShapeDtypeStruct((bh, sq, 128), jnp.float32),
+        ],
         compiler_params=pltpu.CompilerParams(
             dimension_semantics=("parallel", "parallel", "arbitrary"),
         ),
@@ -150,27 +164,180 @@ def _reference_attention(q, k, v, q_offset, k_offset, causal):
     return jnp.einsum("bqk,bkd->bqd", p, v.astype(jnp.float32)).astype(q.dtype)
 
 
+# ---------------------------------------------------------------------------
+# Flash backward: two fused passes (dq over k tiles; dk/dv over q tiles), the
+# score probabilities recomputed per tile from the saved log-sum-exp — the
+# (Sq, Sk) matrices never materialize in the backward either.
+# ---------------------------------------------------------------------------
+
+
+def _bwd_p_tile(q_off_ref, k_off_ref, q, kk, lse, qi, ki, tq, tk, scale, causal):
+    """Recompute P = exp(s*scale - lse) for one (tq, tk) tile, masked."""
+    s = jnp.dot(q, kk.T, preferred_element_type=jnp.float32) * scale
+    if causal:
+        q_pos = q_off_ref[0] + qi * tq + lax.broadcasted_iota(jnp.int32, (tq, tk), 0)
+        k_pos = k_off_ref[0] + ki * tk + lax.broadcasted_iota(jnp.int32, (tq, tk), 1)
+        s = jnp.where(k_pos <= q_pos, s, NEG)
+    p = jnp.exp(s - lse[:, None])
+    return jnp.where(s <= NEG / 2, 0.0, p)
+
+
+def _bwd_dq_kernel(q_off_ref, k_off_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
+                   dd_ref, dq_ref, dq_acc, *, causal, k_tiles, scale, tq, tk):
+    ki = pl.program_id(2)
+    qi = pl.program_id(1)
+
+    @pl.when(ki == 0)
+    def _init():
+        dq_acc[:] = jnp.zeros_like(dq_acc)
+
+    @pl.when(_tile_visible(q_off_ref, k_off_ref, qi, ki, tq, tk, causal))
+    def _accumulate():
+        q = q_ref[0].astype(jnp.float32)
+        kk = k_ref[0].astype(jnp.float32)
+        v = v_ref[0].astype(jnp.float32)
+        do = do_ref[0].astype(jnp.float32)
+        p = _bwd_p_tile(q_off_ref, k_off_ref, q, kk, lse_ref[0, :, 0],
+                        qi, ki, tq, tk, scale, causal)
+        dp = jnp.dot(do, v.T, preferred_element_type=jnp.float32)   # (tq, tk)
+        ds = p * (dp - dd_ref[0, :, 0][:, None])
+        dq_acc[:] = dq_acc[:] + scale * jnp.dot(
+            ds, kk, preferred_element_type=jnp.float32
+        )
+
+    @pl.when(ki == k_tiles - 1)
+    def _finalize():
+        dq_ref[0] = dq_acc[:].astype(dq_ref.dtype)
+
+
+def _bwd_dkv_kernel(q_off_ref, k_off_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
+                    dd_ref, dk_ref, dv_ref, dk_acc, dv_acc,
+                    *, causal, q_tiles, scale, tq, tk):
+    qi = pl.program_id(2)   # q innermost in this pass
+    ki = pl.program_id(1)
+
+    @pl.when(qi == 0)
+    def _init():
+        dk_acc[:] = jnp.zeros_like(dk_acc)
+        dv_acc[:] = jnp.zeros_like(dv_acc)
+
+    @pl.when(_tile_visible(q_off_ref, k_off_ref, qi, ki, tq, tk, causal))
+    def _accumulate():
+        q = q_ref[0].astype(jnp.float32)
+        kk = k_ref[0].astype(jnp.float32)
+        v = v_ref[0].astype(jnp.float32)
+        do = do_ref[0].astype(jnp.float32)
+        p = _bwd_p_tile(q_off_ref, k_off_ref, q, kk, lse_ref[0, :, 0],
+                        qi, ki, tq, tk, scale, causal)
+        dv_acc[:] = dv_acc[:] + jnp.dot(p.T, do, preferred_element_type=jnp.float32)
+        dp = jnp.dot(do, v.T, preferred_element_type=jnp.float32)
+        ds = p * (dp - dd_ref[0, :, 0][:, None])
+        dk_acc[:] = dk_acc[:] + scale * jnp.dot(
+            ds.T, q, preferred_element_type=jnp.float32
+        )
+
+    @pl.when(qi == q_tiles - 1)
+    def _finalize():
+        dk_ref[0] = dk_acc[:].astype(dk_ref.dtype)
+        dv_ref[0] = dv_acc[:].astype(dv_ref.dtype)
+
+
+@functools.partial(jax.jit, static_argnames=("causal", "interpret"))
+def _flash_bwd(q, k, v, do, out, lse, q_offset, k_offset, causal, interpret):
+    bh, sq, d = q.shape
+    sk = k.shape[1]
+    tq, tk = _pick_tiles(sq, sk)
+    k_tiles, q_tiles = sk // tk, sq // tq
+    scale = 1.0 / (d ** 0.5)
+    # lse arrives sliced to one lane (residual memory: see _fwd); rebroadcast for
+    # the kernels' (tq, 128) tiles, as is D_i = rowsum(dO * O)
+    lse = jnp.broadcast_to(lse, (bh, sq, 128))
+    dd = jnp.broadcast_to(
+        jnp.sum(do.astype(jnp.float32) * out.astype(jnp.float32), axis=-1)[..., None],
+        (bh, sq, 128),
+    )
+
+    dq = pl.pallas_call(
+        functools.partial(_bwd_dq_kernel, causal=causal, k_tiles=k_tiles,
+                          scale=scale, tq=tq, tk=tk),
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=2,
+            grid=(bh, q_tiles, k_tiles),
+            in_specs=[
+                pl.BlockSpec((1, tq, d), lambda b, i, j, *_: (b, i, 0)),
+                pl.BlockSpec((1, tk, d), lambda b, i, j, *_: (b, j, 0)),
+                pl.BlockSpec((1, tk, d), lambda b, i, j, *_: (b, j, 0)),
+                pl.BlockSpec((1, tq, d), lambda b, i, j, *_: (b, i, 0)),
+                pl.BlockSpec((1, tq, 128), lambda b, i, j, *_: (b, i, 0)),
+                pl.BlockSpec((1, tq, 128), lambda b, i, j, *_: (b, i, 0)),
+            ],
+            out_specs=pl.BlockSpec((1, tq, d), lambda b, i, j, *_: (b, i, 0)),
+            scratch_shapes=[pltpu.VMEM((tq, d), jnp.float32)],
+        ),
+        out_shape=jax.ShapeDtypeStruct((bh, sq, d), q.dtype),
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary"),
+        ),
+        interpret=interpret,
+    )(q_offset, k_offset, q, k, v, do, lse, dd)
+
+    dk, dv = pl.pallas_call(
+        functools.partial(_bwd_dkv_kernel, causal=causal, q_tiles=q_tiles,
+                          scale=scale, tq=tq, tk=tk),
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=2,
+            grid=(bh, k_tiles, q_tiles),
+            in_specs=[
+                pl.BlockSpec((1, tq, d), lambda b, i, j, *_: (b, j, 0)),
+                pl.BlockSpec((1, tk, d), lambda b, i, j, *_: (b, i, 0)),
+                pl.BlockSpec((1, tk, d), lambda b, i, j, *_: (b, i, 0)),
+                pl.BlockSpec((1, tq, d), lambda b, i, j, *_: (b, j, 0)),
+                pl.BlockSpec((1, tq, 128), lambda b, i, j, *_: (b, j, 0)),
+                pl.BlockSpec((1, tq, 128), lambda b, i, j, *_: (b, j, 0)),
+            ],
+            out_specs=[
+                pl.BlockSpec((1, tk, d), lambda b, i, j, *_: (b, i, 0)),
+                pl.BlockSpec((1, tk, d), lambda b, i, j, *_: (b, i, 0)),
+            ],
+            scratch_shapes=[
+                pltpu.VMEM((tk, d), jnp.float32),
+                pltpu.VMEM((tk, d), jnp.float32),
+            ],
+        ),
+        out_shape=[
+            jax.ShapeDtypeStruct((bh, sk, d), k.dtype),
+            jax.ShapeDtypeStruct((bh, sk, d), v.dtype),
+        ],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary"),
+        ),
+        interpret=interpret,
+    )(q_offset, k_offset, q, k, v, do, lse, dd)
+    return dq, dk, dv
+
+
 @functools.partial(jax.custom_vjp, nondiff_argnums=(5, 6))
 def flash_attention(q, k, v, q_offset, k_offset, causal=False, interpret=False):
     """Fused attention. q: (BH, Sq, D); k, v: (BH, Sk, D); offsets: (1,) int32
     global position bases (for causal masking across sequence shards)."""
-    return _flash_fwd(q, k, v, q_offset, k_offset, causal=causal, interpret=interpret)
+    out, _ = _flash_fwd(q, k, v, q_offset, k_offset, causal=causal, interpret=interpret)
+    return out
 
 
 def _fwd(q, k, v, q_offset, k_offset, causal, interpret):
-    out = _flash_fwd(q, k, v, q_offset, k_offset, causal=causal, interpret=interpret)
-    return out, (q, k, v, q_offset, k_offset)
+    out, lse = _flash_fwd(
+        q, k, v, q_offset, k_offset, causal=causal, interpret=interpret
+    )
+    # keep one lane of the lane-broadcast lse: the residual held from forward to
+    # backward shrinks 128x (it dominates at long sequence)
+    return out, (q, k, v, out, lse[:, :, :1], q_offset, k_offset)
 
 
 def _bwd(causal, interpret, res, g):
-    q, k, v, q_offset, k_offset = res
-    # Backward via the dense reference (recompute): exact gradients, no flash bwd
-    # kernel needed; forward memory savings are preserved.
-    _, vjp = jax.vjp(
-        lambda q_, k_, v_: _reference_attention(q_, k_, v_, q_offset, k_offset, causal),
-        q, k, v,
+    q, k, v, out, lse, q_offset, k_offset = res
+    dq, dk, dv = _flash_bwd(
+        q, k, v, g, out, lse, q_offset, k_offset, causal, interpret
     )
-    dq, dk, dv = vjp(g)
     return dq, dk, dv, None, None
 
 
