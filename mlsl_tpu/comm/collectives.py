@@ -355,7 +355,10 @@ def build_collective(kind: str, group: ProcessGroup, dtype, **kw) -> Callable:
         body = functools.partial(raw, axes=group.axes, sizes=sizes, **kw)
 
     def local_fn(x):  # x: (1, 1, 1, 1, n)
-        out = body(x.reshape(x.shape[NUM_GRID_AXES:]))
+        # named_scope puts the collective's identity on the DEVICE timeline (the
+        # host-side TraceAnnotation in CommRequest only covers the async enqueue)
+        with jax.named_scope(f"mlsl_{kind}_{group.axes or 'color'}"):
+            out = body(x.reshape(x.shape[NUM_GRID_AXES:]))
         return out[None, None, None, None]
 
     sm = _shard_map(local_fn, mesh=mesh, in_specs=_BUF_SPEC, out_specs=_BUF_SPEC)

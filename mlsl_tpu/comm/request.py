@@ -224,7 +224,18 @@ class CommRequest:
         return self
 
     def _dispatch(self, buf: jax.Array) -> None:
-        """Actually launch the XLA programs (called by the Dispatcher)."""
+        """Actually launch the XLA programs (called by the Dispatcher).
+
+        The TraceAnnotation marks the host-side enqueue (request identity and
+        dispatch ordering); the device-side span carries the collective's identity
+        via the jax.named_scope baked into the compiled program
+        (collectives.build_collective)."""
+        with jax.profiler.TraceAnnotation(
+            f"mlsl:{self.desc.kind}:{self.name or self.uid}"
+        ):
+            self._dispatch_inner(buf)
+
+    def _dispatch_inner(self, buf: jax.Array) -> None:
         if self._quant_fn is not None or self._quant_fns is not None:
             topo = self.desc.group.topology
             if self._quant_fns is not None:

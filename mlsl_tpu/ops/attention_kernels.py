@@ -72,9 +72,14 @@ def _tile_accumulate(q_off_ref, k_off_ref, q_ref, k_ref, v_ref,
     return acc_new, m_new, l_new
 
 
-def _flash_kernel(q_off_ref, k_off_ref, q_ref, k_ref, v_ref, o_ref, lse_ref,
-                  acc_ref, m_ref, l_ref, *, causal: bool, k_tiles: int,
-                  scale: float, tq: int, tk: int):
+def _flash_kernel(q_off_ref, k_off_ref, q_ref, k_ref, v_ref, *refs,
+                  causal: bool, k_tiles: int, scale: float, tq: int, tk: int,
+                  want_lse: bool):
+    if want_lse:
+        o_ref, lse_ref, acc_ref, m_ref, l_ref = refs
+    else:
+        o_ref, acc_ref, m_ref, l_ref = refs
+        lse_ref = None
     ki = pl.program_id(2)
     qi = pl.program_id(1)
 
@@ -99,28 +104,32 @@ def _flash_kernel(q_off_ref, k_off_ref, q_ref, k_ref, v_ref, o_ref, lse_ref,
     def _finalize():
         denom = jnp.maximum(l_ref[:, 0], 1e-30)
         o_ref[0] = (acc_ref[:] / denom[:, None]).astype(o_ref.dtype)
-        lse_ref[0] = jnp.broadcast_to(
-            (m_ref[:, 0] + jnp.log(denom))[:, None], lse_ref[0].shape
-        )
+        if lse_ref is not None:
+            lse_ref[0] = jnp.broadcast_to(
+                (m_ref[:, 0] + jnp.log(denom))[:, None], lse_ref[0].shape
+            )
 
 
 @functools.partial(
-    jax.jit, static_argnames=("causal", "interpret")
+    jax.jit, static_argnames=("causal", "interpret", "want_lse")
 )
-def _flash_fwd(q, k, v, q_offset, k_offset, causal=False, interpret=False):
+def _flash_fwd(q, k, v, q_offset, k_offset, causal=False, interpret=False,
+               want_lse=True):
     """q: (BH, Sq, D), k/v: (BH, Sk, D); shapes must satisfy supports().
-    -> (out (BH, Sq, D), lse (BH, Sq, 128) f32 — per-row log-sum-exp of the
-    scaled scores, lane-broadcast; slice [:, :, 0] for the logical value)."""
+    -> (out, lse (BH, Sq, 128) lane-broadcast f32) when want_lse, else (out, None)
+    — the inference path skips the lse allocation/write entirely."""
     bh, sq, d = q.shape
     sk = k.shape[1]
     tq, tk = _pick_tiles(sq, sk)
     k_tiles = sk // tk
     scale = 1.0 / (d ** 0.5)
     grid = (bh, sq // tq, k_tiles)
-    return pl.pallas_call(
+    o_spec = pl.BlockSpec((1, tq, d), lambda b, i, j, *_: (b, i, 0))
+    lse_spec = pl.BlockSpec((1, tq, 128), lambda b, i, j, *_: (b, i, 0))
+    out = pl.pallas_call(
         functools.partial(
             _flash_kernel, causal=causal, k_tiles=k_tiles, scale=scale,
-            tq=tq, tk=tk,
+            tq=tq, tk=tk, want_lse=want_lse,
         ),
         grid_spec=pltpu.PrefetchScalarGridSpec(
             num_scalar_prefetch=2,
@@ -130,25 +139,29 @@ def _flash_fwd(q, k, v, q_offset, k_offset, causal=False, interpret=False):
                 pl.BlockSpec((1, tk, d), lambda b, i, j, *_: (b, j, 0)),
                 pl.BlockSpec((1, tk, d), lambda b, i, j, *_: (b, j, 0)),
             ],
-            out_specs=[
-                pl.BlockSpec((1, tq, d), lambda b, i, j, *_: (b, i, 0)),
-                pl.BlockSpec((1, tq, 128), lambda b, i, j, *_: (b, i, 0)),
-            ],
+            out_specs=[o_spec, lse_spec] if want_lse else [o_spec],
             scratch_shapes=[
                 pltpu.VMEM((tq, d), jnp.float32),
                 pltpu.VMEM((tq, 128), jnp.float32),
                 pltpu.VMEM((tq, 128), jnp.float32),
             ],
         ),
-        out_shape=[
-            jax.ShapeDtypeStruct((bh, sq, d), q.dtype),
-            jax.ShapeDtypeStruct((bh, sq, 128), jnp.float32),
-        ],
+        out_shape=(
+            [
+                jax.ShapeDtypeStruct((bh, sq, d), q.dtype),
+                jax.ShapeDtypeStruct((bh, sq, 128), jnp.float32),
+            ]
+            if want_lse
+            else [jax.ShapeDtypeStruct((bh, sq, d), q.dtype)]
+        ),
         compiler_params=pltpu.CompilerParams(
             dimension_semantics=("parallel", "parallel", "arbitrary"),
         ),
         interpret=interpret,
     )(q_offset, k_offset, q, k, v)
+    if want_lse:
+        return out[0], out[1]
+    return out[0], None
 
 
 def _reference_attention(q, k, v, q_offset, k_offset, causal):
@@ -249,9 +262,9 @@ def _flash_bwd(q, k, v, do, out, lse, q_offset, k_offset, causal, interpret):
     tq, tk = _pick_tiles(sq, sk)
     k_tiles, q_tiles = sk // tk, sq // tq
     scale = 1.0 / (d ** 0.5)
-    # lse arrives sliced to one lane (residual memory: see _fwd); rebroadcast for
-    # the kernels' (tq, 128) tiles, as is D_i = rowsum(dO * O)
-    lse = jnp.broadcast_to(lse, (bh, sq, 128))
+    # lse arrives 2-D (residual memory: see _fwd); rebroadcast for the kernels'
+    # (tq, 128) tiles, as is D_i = rowsum(dO * O)
+    lse = jnp.broadcast_to(lse[..., None], (bh, sq, 128))
     dd = jnp.broadcast_to(
         jnp.sum(do.astype(jnp.float32) * out.astype(jnp.float32), axis=-1)[..., None],
         (bh, sq, 128),
@@ -320,7 +333,10 @@ def _flash_bwd(q, k, v, do, out, lse, q_offset, k_offset, causal, interpret):
 def flash_attention(q, k, v, q_offset, k_offset, causal=False, interpret=False):
     """Fused attention. q: (BH, Sq, D); k, v: (BH, Sk, D); offsets: (1,) int32
     global position bases (for causal masking across sequence shards)."""
-    out, _ = _flash_fwd(q, k, v, q_offset, k_offset, causal=causal, interpret=interpret)
+    out, _ = _flash_fwd(
+        q, k, v, q_offset, k_offset, causal=causal, interpret=interpret,
+        want_lse=False,
+    )
     return out
 
 
@@ -328,9 +344,10 @@ def _fwd(q, k, v, q_offset, k_offset, causal, interpret):
     out, lse = _flash_fwd(
         q, k, v, q_offset, k_offset, causal=causal, interpret=interpret
     )
-    # keep one lane of the lane-broadcast lse: the residual held from forward to
-    # backward shrinks 128x (it dominates at long sequence)
-    return out, (q, k, v, out, lse[:, :, :1], q_offset, k_offset)
+    # keep the lse as a 2-D (BH, Sq) array so Sq packs into the lane dimension —
+    # a (BH, Sq, 1) slice would still be lane-padded to 128, keeping the 128x
+    # residual bloat this is meant to remove
+    return out, (q, k, v, out, lse[:, :, 0], q_offset, k_offset)
 
 
 def _bwd(causal, interpret, res, g):

@@ -18,6 +18,7 @@ from __future__ import annotations
 
 from typing import Callable
 
+import numpy as np
 import jax
 import jax.numpy as jnp
 from jax import lax
@@ -48,8 +49,9 @@ def gpipe_forward(
     probe = jax.eval_shape(stage_fn, stage_params, x_micro[0])
     d_out = probe.shape[-1]
     assert d_out == x_micro.shape[-1], (
-        "pipeline stages must be homogeneous (d_in == d_out); got "
-        f"{x_micro.shape[-1]} -> {d_out}"
+        "pipeline boundary width mismatch: stage_fn maps wire width "
+        f"{x_micro.shape[-1]} -> {d_out}; pad heterogeneous stages to a common "
+        "wire width (see pad_stage_weights)"
     )
 
     outs = _pvary(jnp.zeros((m_count, mb, d_out), probe.dtype), axis)
@@ -83,6 +85,36 @@ def gpipe_forward(
 
     _, outs = lax.fori_loop(0, ticks, tick, (recv, outs))
     return outs
+
+
+def pad_stage_weights(weights, biases, boundary_dims):
+    """Make heterogeneous-width pipeline stages wire-uniform by zero-padding.
+
+    ppermute moves fixed-shape buffers, so differing boundary widths ride a wire
+    padded to d_wire = max(boundary_dims); padding a stage's (d_in, d_out) weight
+    matrix into (d_wire, d_wire) with zeros makes the padded lanes self-annihilating
+    — y_pad = [y, 0...] exactly, provided the stage activation maps 0 to 0 (tanh,
+    relu, gelu do; add biases only on real lanes, which the padded bias guarantees).
+
+    weights[s]: (d_in_s, d_out_s) with d_in_s = boundary_dims[s],
+    d_out_s = boundary_dims[s+1]; biases[s]: (d_out_s,).
+    -> (stacked (S, d_wire, d_wire), stacked (S, d_wire), d_wire), in the weights'
+    own dtype. The caller pads its input to d_wire and slices the output to
+    boundary_dims[-1].
+    """
+    d_wire = max(boundary_dims)
+    s_count = len(weights)
+    dtype = np.asarray(weights[0]).dtype
+    w_pad = np.zeros((s_count, d_wire, d_wire), dtype)
+    b_pad = np.zeros((s_count, d_wire), dtype)
+    for s in range(s_count):
+        d_in, d_out = boundary_dims[s], boundary_dims[s + 1]
+        assert weights[s].shape == (d_in, d_out), (
+            f"stage {s}: weight {weights[s].shape} != ({d_in}, {d_out})"
+        )
+        w_pad[s, :d_in, :d_out] = weights[s]
+        b_pad[s, :d_out] = biases[s]
+    return w_pad, b_pad, d_wire
 
 
 def pipeline_loss(

@@ -86,6 +86,45 @@ def test_gpipe_forward_matches_oracle(env, pipe_mesh):
     np.testing.assert_allclose(got, want, atol=1e-5, rtol=1e-5)
 
 
+def test_gpipe_heterogeneous_widths(env, pipe_mesh):
+    """Stages with differing widths via zero-padded wire-uniform weights."""
+    from mlsl_tpu.parallel.pipeline import gpipe_forward, pad_stage_weights
+
+    dims = [8, 16, 4, 12, 8]  # boundary widths entering each of the 4 stages + out
+    rng = np.random.default_rng(5)
+    weights = [rng.normal(size=(dims[s], dims[s + 1])).astype(np.float32) * 0.4
+               for s in range(N_STAGES)]
+    biases = [rng.normal(size=(dims[s + 1],)).astype(np.float32) * 0.1
+              for s in range(N_STAGES)]
+    w_pad, b_pad, d_wire = pad_stage_weights(weights, biases, dims)
+
+    x = rng.normal(size=(M_COUNT, MB, dims[0])).astype(np.float32)
+    x_pad = np.zeros((M_COUNT, MB, d_wire), np.float32)
+    x_pad[..., : dims[0]] = x
+
+    def body(p, xm):
+        my = {"w": p["w"].reshape(d_wire, d_wire), "b": p["b"].reshape(d_wire)}
+        return gpipe_forward(_stage_fn, my, xm, "model", N_STAGES)
+
+    spec_p = {"w": P("model", None, None), "b": P("model", None)}
+    fn = jax.jit(
+        smap(body, pipe_mesh, in_specs=(spec_p, P()), out_specs=P("model"), check=False)
+    )
+    out = np.asarray(fn({"w": w_pad, "b": b_pad}, jnp.asarray(x_pad)))
+    got = out.reshape(N_STAGES, M_COUNT, MB, d_wire)[-1][..., : dims[-1]]
+
+    # dense oracle at the true widths
+    ref = x.reshape(-1, dims[0])
+    for s in range(N_STAGES):
+        ref = np.tanh(ref @ weights[s] + biases[s])
+    np.testing.assert_allclose(
+        got, ref.reshape(M_COUNT, MB, dims[-1]), atol=1e-5, rtol=1e-5
+    )
+    # padded lanes stay exactly zero on the wire
+    pad_lanes = out.reshape(N_STAGES, M_COUNT, MB, d_wire)[-1][..., dims[-1]:]
+    np.testing.assert_array_equal(pad_lanes, 0.0)
+
+
 def test_gpipe_gradients_match_oracle(env, pipe_mesh):
     """jax.grad through the schedule = the pipelined backward; must equal dense."""
     from mlsl_tpu.parallel.pipeline import pipeline_loss
