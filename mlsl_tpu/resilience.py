@@ -1,0 +1,118 @@
+"""Fault-tolerant training: checkpoint-backed recovery and fault injection.
+
+The reference is fail-stop (SURVEY.md §5.3: MLSL_ASSERT -> Finalize + _exit(1),
+signal handlers that kill the endpoint servers; no elasticity, no fault injection).
+This module exceeds that: a supervisor loop that periodically checkpoints
+(async, via mlsl_tpu.checkpoint), catches recoverable failures (runtime errors from
+the accelerator, MLSLError from the buffer checker), tears the Environment down,
+rebuilds the trainer through a user factory, restores the latest checkpoint and
+resumes — plus a fault-injection hook so recovery paths are testable, which the
+reference never had.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Callable, Optional
+
+import jax
+
+from mlsl_tpu.checkpoint import CheckpointManager, restore_trainer, save_trainer
+from mlsl_tpu.log import MLSLError, log_info
+
+
+# MLSLError subclasses RuntimeError; ValueError is deliberately NOT recoverable
+# (caller bugs should surface, not trigger teardown/rebuild cycles)
+RECOVERABLE = (RuntimeError,)
+
+
+class FaultTolerantLoop:
+    """Supervised training loop with checkpoint/restore recovery.
+
+    make_trainer: factory returning a fresh trainer (called at start and after
+        every recovery — it must re-create the Environment/Session/Distribution).
+    batch_fn(trainer, step) -> batch: MUST be step-deterministic — recovery
+        replays every step since the last checkpoint (not just the failed one),
+        and exact resume depends on replaying the same data.
+    save_every: checkpoint cadence in steps (also the maximum replay window).
+    max_retries: failures tolerated AT THE SAME STEP before re-raising (guards
+        against deterministic poison even when the resume point is several steps
+        behind the failure).
+    on_step fires exactly once per step: replayed steps below the furthest
+        reported step are recomputed silently.
+    fault_hook(step, attempt): optional test hook, called before each step attempt;
+        raise from it to inject a fault.
+    """
+
+    def __init__(
+        self,
+        make_trainer: Callable,
+        ckpt_dir: str,
+        save_every: int = 10,
+        max_retries: int = 2,
+        fault_hook: Optional[Callable] = None,
+    ):
+        self.make_trainer = make_trainer
+        self.ckpt = CheckpointManager(ckpt_dir)
+        self.save_every = max(1, save_every)
+        self.max_retries = max_retries
+        self.fault_hook = fault_hook
+        self.recoveries = 0
+
+    def _recover(self, trainer, error) -> tuple:
+        """Tear down, rebuild, restore. -> (trainer, resume_step)."""
+        self.recoveries += 1
+        log_info("recovering from %s: %s", type(error).__name__, error)
+        # drain in-flight async saves first: restoring from a half-committed step
+        # (or re-saving a step whose original write is still in flight) corrupts
+        # the resume point
+        try:
+            self.ckpt.wait()
+        except Exception:
+            pass
+        from mlsl_tpu.core.environment import Environment
+
+        try:
+            Environment.get_env().finalize()
+        except Exception:
+            pass
+        trainer = self.make_trainer()
+        restored = restore_trainer(self.ckpt, trainer)
+        return trainer, (restored + 1 if restored is not None else 0)
+
+    def run(self, batch_fn: Callable, steps: int, on_step: Optional[Callable] = None):
+        """Train for ``steps`` steps; returns the final trainer."""
+        trainer = self.make_trainer()
+        restored = restore_trainer(self.ckpt, trainer)
+        step = restored + 1 if restored is not None else 0
+        # retry accounting is keyed to the step that failed: resuming several
+        # steps behind the failure must not reset the count (deterministic
+        # poison would otherwise livelock through recover/replay cycles)
+        failed_step = None
+        attempts = 0
+        reported = step - 1  # on_step fires once per step, replays stay silent
+        while step < steps:
+            try:
+                if self.fault_hook is not None:
+                    self.fault_hook(
+                        step, attempts if step == failed_step else 0
+                    )
+                loss = trainer.step(batch_fn(trainer, step))
+                jax.block_until_ready(trainer.params)
+            except RECOVERABLE as e:
+                if step == failed_step:
+                    attempts += 1
+                else:
+                    failed_step, attempts = step, 1
+                if attempts > self.max_retries:
+                    raise
+                trainer, step = self._recover(trainer, e)
+                continue
+            if on_step is not None and step > reported:
+                on_step(step, loss)
+                reported = step
+            if step % self.save_every == 0:
+                save_trainer(self.ckpt, trainer, step=step)
+            step += 1
+        self.ckpt.wait()
+        return trainer

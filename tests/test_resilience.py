@@ -1,0 +1,122 @@
+"""Fault-tolerant loop: injected faults, recovery from checkpoints, poison limits."""
+
+import numpy as np
+import pytest
+import jax
+
+from mlsl_tpu.core.environment import Environment
+from mlsl_tpu.log import MLSLError
+
+
+def _make_factory():
+    from mlsl_tpu.models.mlp import LAYERS, get_layer, init, loss_fn
+    from mlsl_tpu.models.train import DataParallelTrainer
+
+    def make_trainer():
+        env = Environment.get_env().init()
+        dist = env.create_distribution(8, 1)
+        sess = env.create_session()
+        sess.set_global_minibatch_size(16)
+        return DataParallelTrainer(
+            env, dist, sess, init(jax.random.PRNGKey(0)), loss_fn, LAYERS,
+            get_layer, lr=0.1,
+        )
+
+    return make_trainer
+
+
+def _batch_fn(trainer, step):
+    rng = np.random.default_rng(step)
+    x = rng.normal(size=(16, 8)).astype(np.float32)
+    y = rng.integers(0, 4, size=(16,)).astype(np.int32)
+    return trainer.shard_batch(x, y)
+
+
+def test_recovers_from_transient_fault(env, tmp_path):
+    from mlsl_tpu.resilience import FaultTolerantLoop
+
+    seen = []
+
+    def fault_once(step, attempt):
+        if step == 5 and attempt == 0:
+            raise RuntimeError("injected transient device loss")
+
+    loop = FaultTolerantLoop(
+        _make_factory(), str(tmp_path / "ft"), save_every=2, fault_hook=fault_once
+    )
+    trainer = loop.run(_batch_fn, steps=8, on_step=lambda s, l: seen.append(s))
+    assert loop.recoveries == 1
+    # recovery restored from the step-4 checkpoint and replayed step 5
+    assert seen.count(5) == 1 and seen[-1] == 7
+    assert all(np.isfinite(np.asarray(l)).all() for l in jax.tree.leaves(
+        jax.device_get(trainer.params)))
+
+
+def test_persistent_poison_reraises(env, tmp_path):
+    from mlsl_tpu.resilience import FaultTolerantLoop
+
+    def always_fault(step, attempt):
+        if step == 3:
+            raise MLSLError("deterministic poison")
+
+    loop = FaultTolerantLoop(
+        _make_factory(), str(tmp_path / "ft2"), save_every=1, max_retries=2,
+        fault_hook=always_fault,
+    )
+    with pytest.raises(MLSLError):
+        loop.run(_batch_fn, steps=6)
+    assert loop.recoveries == 2  # retried max_retries times before surfacing
+
+
+def test_poison_far_from_checkpoint_no_livelock(env, tmp_path):
+    """Deterministic poison several steps past the last checkpoint must still
+    re-raise after max_retries (retry accounting keyed to the failing step,
+    not reset by the successful replayed steps in between)."""
+    from mlsl_tpu.resilience import FaultTolerantLoop
+
+    def poison(step, attempt):
+        if step == 5:
+            raise RuntimeError("deterministic poison far from checkpoint")
+
+    loop = FaultTolerantLoop(
+        _make_factory(), str(tmp_path / "ft4"), save_every=10, max_retries=2,
+        fault_hook=poison,
+    )
+    with pytest.raises(RuntimeError, match="poison"):
+        loop.run(_batch_fn, steps=8)
+    assert loop.recoveries == 2
+
+
+def test_replayed_steps_not_rereported(env, tmp_path):
+    """Multi-step replay after recovery must not double-fire on_step."""
+    from mlsl_tpu.resilience import FaultTolerantLoop
+
+    def fault_once(step, attempt):
+        if step == 5 and attempt == 0:
+            raise RuntimeError("transient, far from checkpoint")
+
+    seen = []
+    loop = FaultTolerantLoop(
+        _make_factory(), str(tmp_path / "ft5"), save_every=4, fault_hook=fault_once
+    )
+    loop.run(_batch_fn, steps=8, on_step=lambda s, l: seen.append(s))
+    # checkpoint at 4, fault at 5 -> replay 5..; steps 0..7 each reported once
+    assert seen == list(range(8)), seen
+    assert loop.recoveries == 1
+
+
+def test_resume_across_loop_instances(env, tmp_path):
+    """A new loop over the same directory resumes where the old one stopped."""
+    from mlsl_tpu.resilience import FaultTolerantLoop
+
+    d = str(tmp_path / "ft3")
+    seen1 = []
+    FaultTolerantLoop(_make_factory(), d, save_every=1).run(
+        _batch_fn, steps=4, on_step=lambda s, l: seen1.append(s)
+    )
+    seen2 = []
+    FaultTolerantLoop(_make_factory(), d, save_every=1).run(
+        _batch_fn, steps=7, on_step=lambda s, l: seen2.append(s)
+    )
+    assert seen1 == [0, 1, 2, 3]
+    assert seen2 == [4, 5, 6]  # resumed after the last checkpoint
