@@ -12,6 +12,7 @@ Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
 
 import argparse
 import json
+import os
 import statistics
 import sys
 import time
@@ -22,11 +23,20 @@ import numpy as np
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--quick", action="store_true", help="tiny shapes (CI/CPU)")
-    ap.add_argument("--iters", type=int, default=20)
-    ap.add_argument("--warmup", type=int, default=5)
+    ap.add_argument("--iters", type=int, default=12)
+    ap.add_argument("--warmup", type=int, default=4)
     args = ap.parse_args()
 
     import jax
+
+    # persistent compilation cache: the ~3-minute ResNet-50 compiles happen once
+    # per machine, not once per bench invocation
+    cache_dir = os.path.join(os.path.dirname(os.path.abspath(__file__)), ".jax_cache")
+    try:
+        jax.config.update("jax_compilation_cache_dir", cache_dir)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 5)
+    except Exception:
+        pass
     import jax.numpy as jnp
 
     import mlsl_tpu as mlsl
@@ -56,16 +66,6 @@ def main():
     y = rng.integers(0, classes, size=(batch,)).astype(np.int32)
     fw_batch = trainer.shard_batch(x, y)
 
-    # --- framework: steady-state throughput (chained steps, one final block) ---
-    for _ in range(args.warmup):
-        trainer.step(fw_batch)
-    jax.block_until_ready(trainer.params)
-    t0 = time.perf_counter()
-    for _ in range(args.iters):
-        trainer.step(fw_batch)
-    jax.block_until_ready(trainer.params)
-    fw_ms = (time.perf_counter() - t0) / args.iters * 1e3
-
     # --- raw-JAX baseline: one fused jit, same math ---
     lr, data_size = 0.05, dist.get_process_count_data()
     mesh = dist.topology.mesh
@@ -84,14 +84,33 @@ def main():
         loss, grads = jax.value_and_grad(resnet.loss_fn)(p, (bx, by))
         return loss, jax.tree.map(lambda w, g: w - lr * g, p, grads)
 
-    for _ in range(args.warmup):
-        loss, raw_params = raw_step(raw_params, xb, yb)
-    jax.block_until_ready(raw_params)
-    t0 = time.perf_counter()
-    for _ in range(args.iters):
-        loss, raw_params = raw_step(raw_params, xb, yb)
-    jax.block_until_ready(raw_params)
-    raw_ms = (time.perf_counter() - t0) / args.iters * 1e3
+    def run_fw(n):
+        for _ in range(n):
+            trainer.step(fw_batch)
+        jax.block_until_ready(trainer.params)
+
+    def run_raw(n):
+        nonlocal raw_params
+        for _ in range(n):
+            loss, raw_params = raw_step(raw_params, xb, yb)
+        jax.block_until_ready(raw_params)
+
+    # warm up both compiled programs, then measure in ALTERNATING blocks so slow
+    # machine/tunnel drift hits both sides equally; medians of per-block means.
+    run_fw(args.warmup)
+    run_raw(args.warmup)
+    n_blocks = min(4, max(1, args.iters))
+    per_block = args.iters // n_blocks  # >= 1; at most n_blocks-1 iters truncated
+    fw_blocks, raw_blocks = [], []
+    for _ in range(n_blocks):
+        t0 = time.perf_counter()
+        run_fw(per_block)
+        fw_blocks.append((time.perf_counter() - t0) / per_block * 1e3)
+        t0 = time.perf_counter()
+        run_raw(per_block)
+        raw_blocks.append((time.perf_counter() - t0) / per_block * 1e3)
+    fw_ms = statistics.median(fw_blocks)
+    raw_ms = statistics.median(raw_blocks)
 
     print(
         json.dumps(

@@ -50,12 +50,17 @@ class FaultTolerantLoop:
         ckpt_dir: str,
         save_every: int = 10,
         max_retries: int = 2,
+        max_total_recoveries: int = 20,
         fault_hook: Optional[Callable] = None,
     ):
         self.make_trainer = make_trainer
         self.ckpt = CheckpointManager(ckpt_dir)
         self.save_every = max(1, save_every)
         self.max_retries = max_retries
+        # bound on recoveries across the whole run: a flaky fault that lands on a
+        # DIFFERENT step each cycle resets the per-step count, and without this
+        # cap the loop would recover/replay forever
+        self.max_total_recoveries = max_total_recoveries
         self.fault_hook = fault_hook
         self.recoveries = 0
 
@@ -99,20 +104,25 @@ class FaultTolerantLoop:
                     )
                 loss = trainer.step(batch_fn(trainer, step))
                 jax.block_until_ready(trainer.params)
+                if step % self.save_every == 0:
+                    # inside the try: a device fault surfacing during the save's
+                    # device read must take the recovery path too
+                    save_trainer(self.ckpt, trainer, step=step)
             except RECOVERABLE as e:
                 if step == failed_step:
                     attempts += 1
                 else:
                     failed_step, attempts = step, 1
-                if attempts > self.max_retries:
+                if (
+                    attempts > self.max_retries
+                    or self.recoveries >= self.max_total_recoveries
+                ):
                     raise
                 trainer, step = self._recover(trainer, e)
                 continue
             if on_step is not None and step > reported:
                 on_step(step, loss)
                 reported = step
-            if step % self.save_every == 0:
-                save_trainer(self.ckpt, trainer, step=step)
             step += 1
         self.ckpt.wait()
         return trainer
