@@ -1,0 +1,88 @@
+"""API flows not covered elsewhere: graph rebuild, color configuration, env cycles."""
+
+import numpy as np
+import pytest
+
+from mlsl_tpu.types import DataType, GroupType, OpType, ReductionType
+
+
+def test_remove_operations_and_rebuild(env):
+    """remove_operations + re-register + re-commit (reference RemoveOperations)."""
+    dist = env.create_distribution(8, 1)
+    s = env.create_session()
+    s.set_global_minibatch_size(8)
+    r = s.create_operation_reg_info(OpType.CC)
+    r.add_input(8, 4)
+    r.add_output(8, 4)
+    r.add_parameter_set(64, 1)
+    s.add_operation(r, dist)
+    s.commit()
+    assert s.get_operation_count() == 1
+
+    s.remove_operations()
+    assert s.get_operation_count() == 0
+
+    r2 = s.create_operation_reg_info(OpType.CC)
+    r2.add_input(4, 4)
+    r2.add_output(4, 4)
+    r2.add_parameter_set(32, 1)
+    op = s.get_operation(s.add_operation(r2, dist))
+    s.commit()
+    ps = op.get_parameter_set(0)
+    buf = dist.make_buffer(lambda p: np.full(32, float(p)), 32)
+    ps.start_gradient_comm(buf)
+    out = ps.wait_gradient_comm()
+    np.testing.assert_allclose(
+        dist.local_part(out, 0), np.full(32, sum(range(8)))
+    )
+
+
+def test_configure_color_list_restricts_devices(env):
+    """'color=c0,c1,...' keeps only devices matching the first color."""
+    env.configure("color=0,0,0,0,1,1,1,1")
+    assert len(env.devices) == 4
+    dist = env.create_distribution(4, 1)
+    assert dist.get_process_count(GroupType.GLOBAL) == 4
+    buf = dist.make_buffer(lambda p: np.full(4, float(p + 1)), 4)
+    out = env.wait(
+        dist.all_reduce(buf, 4, DataType.FLOAT, ReductionType.SUM, GroupType.DATA)
+    )
+    np.testing.assert_allclose(dist.local_part(out, 0), np.full(4, 10.0))
+
+
+def test_configure_uniform_color_is_full_world(env):
+    env.configure("color=3")
+    assert len(env.devices) == 8
+
+
+def test_environment_reinit_cycle(env):
+    """finalize + re-init yields a working environment (fixture exercises one
+    cycle; this drives several with collectives in between)."""
+    from mlsl_tpu.core.environment import Environment
+
+    for _ in range(3):
+        e = Environment.get_env().init()
+        d = e.create_distribution(8, 1)
+        buf = d.make_buffer(lambda p: np.ones(4, np.float32), 4)
+        out = e.wait(
+            d.all_reduce(buf, 4, DataType.FLOAT, ReductionType.SUM, GroupType.DATA)
+        )
+        np.testing.assert_allclose(d.local_part(out, 0), np.full(4, 8.0))
+        e.finalize()
+    Environment.get_env().init()  # leave initialized for the fixture teardown
+
+
+def test_colors_mode_global_collective(env):
+    data_colors = tuple(p % 4 for p in range(8))
+    model_colors = tuple(p // 2 for p in range(8))
+    dist = env.create_distribution_with_colors(data_colors, model_colors)
+    buf = dist.make_buffer(lambda p: np.full(4, float(p)), 4)
+    out = env.wait(
+        dist.all_reduce(buf, 4, DataType.FLOAT, ReductionType.SUM, GroupType.GLOBAL)
+    )
+    np.testing.assert_allclose(dist.local_part(out, 5), np.full(4, 28.0))
+    # model groups: pairs (0,1), (2,3), ...
+    out2 = env.wait(
+        dist.all_reduce(buf, 4, DataType.FLOAT, ReductionType.SUM, GroupType.MODEL)
+    )
+    np.testing.assert_allclose(dist.local_part(out2, 4), np.full(4, 9.0))
