@@ -32,15 +32,24 @@ def gpipe_forward(
     x_micro: jax.Array,
     axis: str,
     n_stages: int,
+    remat: bool = False,
 ):
     """SPMD body (call inside shard_map over ``axis`` of size n_stages).
 
     stage_params: this stage's weights (the caller shards them over ``axis``).
     x_micro: (M, mb, d_in) microbatches — the stage-0 input (replicated copies on
     other stages are ignored).
+    remat: wrap the stage in jax.checkpoint so the backward replay recomputes
+    stage internals instead of storing per-tick activations — bounds pipeline
+    activation memory by the stage boundary size rather than the stage interior
+    (the practical core of the 1F1B memory benefit).
     Returns (M, mb, d_out): the last stage's outputs (zeros elsewhere; reduce with
     a psum/select or read the last stage's shard).
     """
+    if remat:
+        # prevent_cse=False: XLA never CSEs across loop iterations, so inside the
+        # fori/scan body the default's optimization barriers would only block fusion
+        stage_fn = jax.checkpoint(stage_fn, prevent_cse=False)
     m_count, mb, _ = x_micro.shape
     me = lax.axis_index(axis)
     perm = [(i, (i + 1) % n_stages) for i in range(n_stages)]
@@ -125,10 +134,11 @@ def pipeline_loss(
     y_micro: jax.Array,
     axis: str,
     n_stages: int,
+    remat: bool = False,
 ):
     """Pipelined forward + loss on the last stage, psum'd so every stage holds the
     scalar (ready for jax.grad: the backward replays the schedule in reverse)."""
-    outs = gpipe_forward(stage_fn, stage_params, x_micro, axis, n_stages)
+    outs = gpipe_forward(stage_fn, stage_params, x_micro, axis, n_stages, remat=remat)
     me = lax.axis_index(axis)
     per_micro = jax.vmap(loss_head)(outs, y_micro)          # (M,)
     local = jnp.where(me == n_stages - 1, jnp.sum(per_micro), 0.0)

@@ -125,8 +125,10 @@ def test_gpipe_heterogeneous_widths(env, pipe_mesh):
     np.testing.assert_array_equal(pad_lanes, 0.0)
 
 
-def test_gpipe_gradients_match_oracle(env, pipe_mesh):
-    """jax.grad through the schedule = the pipelined backward; must equal dense."""
+@pytest.mark.parametrize("remat", [False, True])
+def test_gpipe_gradients_match_oracle(env, pipe_mesh, remat):
+    """jax.grad through the schedule = the pipelined backward; must equal dense
+    (with and without the remat policy — remat only changes memory/recompute)."""
     from mlsl_tpu.parallel.pipeline import pipeline_loss
 
     all_params = _stage_params(2)
@@ -143,7 +145,7 @@ def test_gpipe_gradients_match_oracle(env, pipe_mesh):
         def body(params, xm, ym):
             my = {"w": params["w"].reshape(D, D), "b": params["b"].reshape(D)}
             return pipeline_loss(
-                _stage_fn, loss_head, my, xm, ym, "model", N_STAGES
+                _stage_fn, loss_head, my, xm, ym, "model", N_STAGES, remat=remat
             )[None]
 
         fn = smap(
