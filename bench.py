@@ -27,7 +27,41 @@ def main():
     ap.add_argument("--warmup", type=int, default=4)
     args = ap.parse_args()
 
+    # Probe the backend in a subprocess first: a dead accelerator tunnel hangs
+    # uninterruptibly inside backend init, so fail fast and loud instead. The
+    # child may be stuck in uninterruptible sleep (unkillable), so never block
+    # on reaping it — poll with a deadline and walk away.
+    import subprocess
+
+    probe_src = (
+        "from mlsl_tpu.sysinfo import apply_platform_override\n"
+        "apply_platform_override()\n"
+        "import jax.numpy as jnp\n"
+        "jnp.ones((8, 8)).sum().block_until_ready(); print('ok', flush=True)"
+    )
+    child = subprocess.Popen(
+        [sys.executable, "-c", probe_src],
+        stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+        start_new_session=True,
+        cwd=os.path.dirname(os.path.abspath(__file__)),
+    )
+    deadline = time.time() + 180
+    while child.poll() is None and time.time() < deadline:
+        time.sleep(1)
+    if child.poll() is None:
+        child.kill()  # best effort; do NOT wait() — a D-state child never reaps
+        print("bench: accelerator backend unreachable (probe timed out after "
+              "180s) — not producing a number from a dead device", file=sys.stderr)
+        sys.exit(3)
+    if child.returncode != 0:
+        print(f"bench: backend probe failed:\n{child.stderr.read()[-500:]}",
+              file=sys.stderr)
+        sys.exit(3)
+
     import jax
+    from mlsl_tpu.sysinfo import apply_platform_override
+
+    apply_platform_override()
 
     # persistent compilation cache: the ~3-minute ResNet-50 compiles happen once
     # per machine, not once per bench invocation

@@ -28,10 +28,9 @@ def main():
     ap.add_argument("--quant", action="store_true", help="also run int8 ring")
     args = ap.parse_args()
 
-    import jax
+    from mlsl_tpu.sysinfo import apply_platform_override
 
-    if os.environ.get("MLSL_TPU_PLATFORM"):
-        jax.config.update("jax_platforms", os.environ["MLSL_TPU_PLATFORM"])
+    apply_platform_override()
 
     import numpy as np
 

@@ -22,9 +22,9 @@ from mlsl_tpu.types import DataType, GroupType, OpType, ReductionType
 
 
 def main():
-    platform = os.environ.get("MLSL_TPU_PLATFORM")
-    if platform:
-        jax.config.update("jax_platforms", platform)
+    from mlsl_tpu.sysinfo import apply_platform_override
+
+    apply_platform_override()
 
     # 1. Bootstrap (reference: Environment::GetEnv().Init(&argc, &argv))
     env = mlsl.Environment.get_env().init()

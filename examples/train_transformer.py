@@ -18,9 +18,9 @@ import mlsl_tpu as mlsl
 
 
 def main():
-    platform = os.environ.get("MLSL_TPU_PLATFORM")
-    if platform:
-        jax.config.update("jax_platforms", platform)
+    from mlsl_tpu.sysinfo import apply_platform_override
+
+    apply_platform_override()
 
     from mlsl_tpu.checkpoint import CheckpointManager, restore_trainer, save_trainer
     from mlsl_tpu.data import AsyncLoader

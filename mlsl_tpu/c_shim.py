@@ -44,14 +44,9 @@ def _release(hid: int) -> int:
 # ---- environment ----
 
 def env_init() -> int:
-    import os
+    from mlsl_tpu.sysinfo import apply_platform_override
 
-    platform = os.environ.get("MLSL_TPU_PLATFORM")
-    if platform:
-        # the axon site hook pins JAX_PLATFORMS; the config update wins post-import
-        import jax
-
-        jax.config.update("jax_platforms", platform)
+    apply_platform_override()
     Environment.get_env().init()
     return 0
 

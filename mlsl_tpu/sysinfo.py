@@ -23,6 +23,20 @@ class SysInfo:
     memory_per_device: int   # bytes, 0 if unknown
 
 
+def apply_platform_override() -> None:
+    """Honor MLSL_TPU_PLATFORM (e.g. 'cpu' for the virtual multi-device mesh).
+
+    The env var must be applied via jax.config AFTER importing jax — site hooks
+    (the axon plugin) pin JAX_PLATFORMS, so the env var alone is not enough. Every
+    entry point (bench, examples, C shim, curve harness) funnels through here.
+    """
+    import os
+
+    platform = os.environ.get("MLSL_TPU_PLATFORM")
+    if platform:
+        jax.config.update("jax_platforms", platform)
+
+
 @functools.lru_cache(maxsize=1)
 def probe() -> SysInfo:
     devices = jax.devices()
