@@ -7,15 +7,17 @@ un-sent residual is carried in an error-feedback buffer so every coordinate is
 eventually applied (same accumulator discipline as the int8 path / reference
 quant/quant.c's diff map).
 
-Wire format per member: (k fp32 values, k int32 indices) all-gathered over the group,
-scatter-added into the dense result on every rank. Bytes per member: 8k vs 4n dense —
-a win for k << n (the typical top-k regime is k/n ~ 1%). Exactness contract: the
-result equals the sum of every member's top-k-sparsified contribution.
+Wire format per member: (k fp32 values, k int32 indices); for groups below
+RING_THRESHOLD they are all-gathered ((G, k) peak state) and scatter-added; at or
+above it each member's pair circulates the ring with O(k) peak wire state per rank.
+Bytes per member: 8k vs 4n dense — a win for k << n (the typical top-k regime is
+k/n ~ 1%). Exactness contract: the result equals the sum of every member's
+top-k-sparsified contribution, identical across both formats.
 """
 
 from __future__ import annotations
 
-from typing import Callable, Tuple
+from typing import Callable, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -28,7 +30,13 @@ from mlsl_tpu.log import mlsl_assert
 _cache: dict = {}
 
 
-def _sparse_body(x, err, *, axes, sizes, k, n, recv_count):
+# at or above this group size the ring format replaces the all-gather: the
+# gathered (G, k) buffers stop being "small" and the ring keeps peak per-rank
+# wire memory at O(k)
+RING_THRESHOLD = 16
+
+
+def _sparse_body(x, err, *, axes, sizes, k, n, recv_count, use_ring):
     """Local body: (n,), (n,) -> (result, new_err).
 
     result is the dense sum of sparsified contributions (allreduce), or this
@@ -40,7 +48,9 @@ def _sparse_body(x, err, *, axes, sizes, k, n, recv_count):
     sparse_mine = jnp.zeros((n,), jnp.float32).at[idx].set(vals)
     new_err = xq - sparse_mine
 
-    if axes:
+    if axes and use_ring:
+        out = _ring_merge(sparse_mine, vals, idx, axes[0], sizes[axes[0]], n)
+    elif axes:
         all_vals = _gather_group(vals, axes)            # (G, k)
         all_idx = _gather_group(idx, axes)              # (G, k)
         out = jnp.zeros((n,), jnp.float32).at[all_idx.reshape(-1)].add(
@@ -56,24 +66,49 @@ def _sparse_body(x, err, *, axes, sizes, k, n, recv_count):
     return out, new_err
 
 
+def _ring_merge(own_dense, vals, idx, axis: str, g: int, n: int):
+    """Circulate each rank's (vals, idx) around the ring, scatter-adding arrivals:
+    peak per-rank wire state is one (k,) pair instead of the (G, k) gather."""
+    perm = [(i, (i + 1) % g) for i in range(g)]
+
+    def hop(_, carry):
+        out, v_cur, i_cur = carry
+        v_cur = lax.ppermute(v_cur, axis, perm)
+        i_cur = lax.ppermute(i_cur, axis, perm)
+        return out.at[i_cur].add(v_cur), v_cur, i_cur
+
+    out, _, _ = lax.fori_loop(0, g - 1, hop, (own_dense, vals, idx))
+    return out
+
+
 def build_sparse_collective(
-    kind: str, group: ProcessGroup, count: int, ratio: float
+    kind: str, group: ProcessGroup, count: int, ratio: float,
+    use_ring: Optional[bool] = None,
 ) -> Tuple[Callable, int]:
     """-> (compiled fn (buf, err) -> (result, new_err), err length).
 
     kind: 'allreduce' or 'reduce_scatter' (MPI slice placement). SUM only,
-    axis-aligned groups (like the quantized path)."""
+    axis-aligned groups (like the quantized path). use_ring: None = auto (ring
+    merge for single-axis groups of size >= RING_THRESHOLD)."""
     from mlsl_tpu.comm.collectives import _axis_sizes, _group_key
 
     mlsl_assert(group.colors is None, "sparse collectives require axis-aligned groups")
     mlsl_assert(0.0 < ratio <= 1.0, "topk ratio must be in (0, 1], got %s", ratio)
     g = 1 if group.is_self else group.size
+    if use_ring is None:
+        use_ring = g >= RING_THRESHOLD and len(group.axes) == 1
+    elif use_ring:
+        mlsl_assert(
+            len(group.axes) == 1 and g > 1,
+            "ring wire format requires a single-axis group of size > 1 "
+            "(got axes=%s, size=%d)", group.axes, g,
+        )
     recv_count = None
     if kind == "reduce_scatter":
         mlsl_assert(count % g == 0, "reduce_scatter count %d %% group %d", count, g)
         recv_count = count // g
     k = max(1, int(count * ratio))
-    key = (kind, _group_key(group), count, k)
+    key = (kind, _group_key(group), count, k, use_ring)
     fn = _cache.get(key)
     if fn is not None:
         return fn, count
@@ -87,7 +122,8 @@ def build_sparse_collective(
     from mlsl_tpu.comm.collectives import build_stateful_collective
 
     body = functools.partial(
-        _sparse_body, axes=axes, sizes=sizes, k=k, n=count, recv_count=recv_count
+        _sparse_body, axes=axes, sizes=sizes, k=k, n=count, recv_count=recv_count,
+        use_ring=use_ring,
     )
     fn = build_stateful_collective(body, topo.mesh)
     _cache[key] = fn

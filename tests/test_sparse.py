@@ -155,6 +155,31 @@ def test_sparse_reduce_scatter_placement(env):
         )
 
 
+def test_ring_merge_matches_allgather_format(env):
+    """The ring wire format must produce identical results to the all-gather one
+    (same math, O(k) peak wire state instead of O(G*k))."""
+    from mlsl_tpu.comm.sparse import build_sparse_collective
+
+    n = 800
+    dist = env.create_distribution(8, 1)
+    rng = np.random.default_rng(11)
+    vals = {p: rng.normal(size=n).astype(np.float32) for p in range(8)}
+    buf = dist.make_buffer(lambda p: vals[p], n)
+    topo = dist.topology
+    err0 = topo.shard_buffer(np.zeros((*topo.grid_shape, n), np.float32))
+
+    fn_gather, _ = build_sparse_collective(
+        "allreduce", dist.data_group, n, 0.1, use_ring=False
+    )
+    fn_ring, _ = build_sparse_collective(
+        "allreduce", dist.data_group, n, 0.1, use_ring=True
+    )
+    out_g, err_g = fn_gather(buf, err0)
+    out_r, err_r = fn_ring(buf, err0)
+    np.testing.assert_allclose(np.asarray(out_g), np.asarray(out_r), rtol=1e-6)
+    np.testing.assert_allclose(np.asarray(err_g), np.asarray(err_r), rtol=1e-6)
+
+
 def test_sparse_rejects_non_sum(env):
     from mlsl_tpu.comm.request import CommDesc, CommRequest
     from mlsl_tpu.log import MLSLError
@@ -169,3 +194,59 @@ def test_sparse_rejects_non_sum(env):
     )
     with pytest.raises(MLSLError):
         req.setup()
+
+
+def test_ring_reduce_scatter_and_auto_selection(env):
+    """Ring format composed with reduce_scatter placement, and the auto toggle."""
+    from mlsl_tpu.comm import sparse
+    from mlsl_tpu.comm.sparse import build_sparse_collective
+
+    n_owned, G = 100, 8
+    dist = env.create_distribution(G, 1)
+    rng = np.random.default_rng(12)
+    vals = {p: rng.normal(size=n_owned * G).astype(np.float32) for p in range(G)}
+    buf = dist.make_buffer(lambda p: vals[p], n_owned * G)
+    topo = dist.topology
+    err0 = topo.shard_buffer(np.zeros((*topo.grid_shape, n_owned * G), np.float32))
+
+    fn, _ = build_sparse_collective(
+        "reduce_scatter", dist.data_group, n_owned * G, 0.25, use_ring=True
+    )
+    out, _ = fn(buf, err0)
+    k = int(n_owned * G * 0.25)
+    exact_full = sum(_topk_sparsify(vals[p], k) for p in range(G))
+    for p in range(G):
+        np.testing.assert_allclose(
+            np.asarray(dist.local_part(out, p)),
+            exact_full[p * n_owned : (p + 1) * n_owned],
+            rtol=1e-5,
+        )
+
+    # auto toggle: below threshold -> gather; force threshold down -> ring
+    old = sparse.RING_THRESHOLD
+    try:
+        sparse._cache.clear()
+        sparse.RING_THRESHOLD = 4
+        fn_auto, _ = build_sparse_collective(
+            "allreduce", dist.data_group, 256, 0.1
+        )
+        buf2 = dist.make_buffer(lambda p: vals[p][:256], 256)
+        err2 = topo.shard_buffer(np.zeros((*topo.grid_shape, 256), np.float32))
+        out_auto, _ = fn_auto(buf2, err2)
+        k2 = int(256 * 0.1)
+        exact2 = sum(_topk_sparsify(vals[p][:256], k2) for p in range(G))
+        np.testing.assert_allclose(
+            np.asarray(dist.local_part(out_auto, 0)), exact2, rtol=1e-5
+        )
+    finally:
+        sparse.RING_THRESHOLD = old
+        sparse._cache.clear()
+
+
+def test_ring_on_multiaxis_group_rejected(env):
+    from mlsl_tpu.comm.sparse import build_sparse_collective
+    from mlsl_tpu.log import MLSLError
+
+    dist = env.create_distribution(2, 2)
+    with pytest.raises(MLSLError):
+        build_sparse_collective("allreduce", dist.global_group, 64, 0.1, use_ring=True)
