@@ -158,6 +158,85 @@ def test_redistribution_case4_and_5(env):
     assert op3.get_output(0).comm_req.desc.group is dist_b.model_group
 
 
+@pytest.mark.parametrize("model_parts", [2, 4])
+def test_full_reference_loop(env, model_parts):
+    """The canonical reference loop (mlsl_test.cpp:660-698) in one piece: per
+    iteration, Forward (wait input comm, compute, pack, start output comm),
+    Backward1 (wait output-grad comm, start input-grad comm), Backward2 (start
+    gradient comm), Update (wait gradient comm) — activation ReduceScatter/
+    AllGather AND parameter AllReduce interleaved, with closed-form checks."""
+    data_parts = 8 // model_parts
+    dist = env.create_distribution(data_parts, model_parts)
+    s, op1, op2 = _build_net(env, dist)
+    out_act, in_act = op1.get_output(0), op2.get_input(0)
+    ps1 = op1.get_parameter_set(0)
+    local_mb = op1.get_local_minibatch_size()
+    n_wire = local_mb * out_act.local_fm_count * FM_SIZE
+
+    for it in range(2):
+        # Forward: op1 computes its (partial-sum) output, packs, starts FPROP
+        acts = {p: (it + 1.0) * _rank_fill(p, n_wire) for p in range(8)}
+        wires = {
+            p: pack_local(
+                acts[p].reshape(local_mb, out_act.local_fm_count, FM_SIZE),
+                out_act.pack_blocks, local_mb, out_act.local_fm_count, FM_SIZE,
+            )
+            for p in range(8)
+        }
+        out_act.start_comm(dist.make_buffer(lambda p: np.asarray(wires[p]), n_wire))
+
+        # op2 Forward: wait the FPROP result (ReduceScatter over model group)
+        received = in_act.wait_comm()
+        g = dist.model_group
+        rc = n_wire // model_parts
+        for p in range(8):
+            members = sorted(
+                (q for q in range(8)
+                 if dist.topology.coords(q)[:3] == dist.topology.coords(p)[:3]),
+                key=g.group_idx_of,
+            )
+            summed = sum(np.asarray(wires[q], np.float32) for q in members)
+            my = g.group_idx_of(p)
+            np.testing.assert_allclose(
+                np.asarray(dist.local_part(received, p)),
+                summed[my * rc:(my + 1) * rc], rtol=1e-6,
+            )
+
+        # Backward1: op2 sends input-activation grads back (AllGather, BPROP)
+        n_bwd = local_mb * in_act.local_fm_count * in_act.fm_size
+        grads_a = {p: (it + 2.0) * _rank_fill(p, n_bwd) for p in range(8)}
+        in_act.start_comm(dist.make_buffer(lambda p: grads_a[p], n_bwd))
+        bwd = out_act.wait_comm()
+        for p in range(8):
+            members = sorted(
+                (q for q in range(8)
+                 if dist.topology.coords(q)[:3] == dist.topology.coords(p)[:3]),
+                key=g.group_idx_of,
+            )
+            want = np.concatenate([grads_a[q] for q in members])
+            np.testing.assert_allclose(
+                np.asarray(dist.local_part(bwd, p)), want, rtol=1e-6
+            )
+
+        # Backward2 + Update: parameter gradient sync over the data group
+        n_k = ps1.get_local_kernel_count() * ps1.get_kernel_size()
+        grads_w = {p: (it + 3.0) * _rank_fill(p, n_k) for p in range(8)}
+        ps1.start_gradient_comm(dist.make_buffer(lambda p: grads_w[p], n_k))
+        reduced = ps1.wait_gradient_comm()
+        gd = dist.grad_group
+        for p in range(8):
+            members = sorted(
+                (q for q in range(8)
+                 if dist.topology.coords(q)[0] == dist.topology.coords(p)[0]
+                 and dist.topology.coords(q)[3] == dist.topology.coords(p)[3]),
+                key=gd.group_idx_of,
+            )
+            want = sum(np.asarray(grads_w[q], np.float64) for q in members)
+            np.testing.assert_allclose(
+                np.asarray(dist.local_part(reduced, p), np.float64), want, rtol=1e-6
+            )
+
+
 @pytest.mark.parametrize("model_parts", [1, 2, 4])
 @pytest.mark.parametrize("dist_update", [False, True])
 @pytest.mark.parametrize("quant", [False, True])
