@@ -31,22 +31,43 @@ def init_moe_params(key, d_model: int, d_ff: int, n_experts: int, std=0.02) -> D
     }
 
 
-def _route(x, wg, n_experts: int, capacity: int):
-    """-> (dispatch (T, E, C) f32, combine (T, E, C) f32, aux_loss scalar)."""
-    t = x.shape[0]
+def _route(x, wg, n_experts: int, capacity: int, top_k: int = 1):
+    """-> (dispatch (T, E, C) f32, combine (T, E, C) f32, aux_loss scalar).
+
+    top_k=1 is switch routing; top_k=2 is GShard-style with the two gate
+    probabilities renormalized over the selected pair. Capacity positions are
+    assigned choice-major (all first choices queue before any second choice,
+    GShard's priority rule), so over-capacity drops hit second choices first."""
     logits = x.astype(jnp.float32) @ wg.astype(jnp.float32)      # (T, E)
     probs = jax.nn.softmax(logits, axis=-1)
-    expert = jnp.argmax(probs, axis=-1)                          # (T,)
-    gate = jnp.take_along_axis(probs, expert[:, None], axis=-1)[:, 0]
-    onehot = jax.nn.one_hot(expert, n_experts, dtype=jnp.float32)  # (T, E)
-    # position of each token within its expert's send queue (0-based)
-    pos = (jnp.cumsum(onehot, axis=0) - 1.0) * onehot
-    keep = (pos < capacity).astype(jnp.float32) * onehot
-    slot = jax.nn.one_hot(pos.astype(jnp.int32), capacity, dtype=jnp.float32)
-    dispatch = keep[:, :, None] * slot                            # (T, E, C)
-    combine = dispatch * gate[:, None, None]
-    # switch-transformer load-balancing auxiliary loss
-    frac_tokens = jnp.mean(onehot, axis=0)
+    topv, topi = lax.top_k(probs, top_k)                          # (T, K)
+    if top_k == 1:
+        # switch routing: the RAW probability gates the output — renormalizing
+        # would make the gate identically 1.0 and kill the router's task-loss
+        # gradient (d(v/v)/dv == 0)
+        gates = topv
+    else:
+        denom = jnp.sum(topv, axis=-1, keepdims=True)
+        gates = topv / jnp.maximum(denom, 1e-9)                   # GShard renorm
+
+    dispatch = jnp.zeros((x.shape[0], n_experts, capacity), jnp.float32)
+    combine = jnp.zeros_like(dispatch)
+    # running per-expert queue length, carried across choices (choice-major)
+    taken = jnp.zeros((n_experts,), jnp.float32)
+    onehot_first = None
+    for c in range(top_k):
+        onehot = jax.nn.one_hot(topi[:, c], n_experts, dtype=jnp.float32)  # (T, E)
+        if c == 0:
+            onehot_first = onehot
+        pos = (jnp.cumsum(onehot, axis=0) - 1.0) * onehot + taken[None, :] * onehot
+        keep = (pos < capacity).astype(jnp.float32) * onehot
+        slot = jax.nn.one_hot(pos.astype(jnp.int32), capacity, dtype=jnp.float32)
+        d_c = keep[:, :, None] * slot
+        dispatch = dispatch + d_c
+        combine = combine + d_c * gates[:, c][:, None, None]
+        taken = taken + jnp.sum(onehot, axis=0)
+    # load-balancing auxiliary loss on the FIRST choice (switch/GShard convention)
+    frac_tokens = jnp.mean(onehot_first, axis=0)
     frac_probs = jnp.mean(probs, axis=0)
     aux = n_experts * jnp.sum(frac_tokens * frac_probs)
     return dispatch, combine, aux
@@ -64,6 +85,7 @@ def moe_ffn(
     axis: str,
     ep: int,
     capacity_factor: float = 1.25,
+    top_k: int = 1,
 ) -> Tuple[jax.Array, jax.Array]:
     """SPMD MoE feed-forward (call inside shard_map over ``axis`` of size ep).
 
@@ -79,13 +101,13 @@ def moe_ffn(
     el = params["w1"].shape[0]
     n_experts = el * ep
     if ep == 1:
-        return _moe_slice(x, params, n_experts, capacity_factor)
+        return _moe_slice(x, params, n_experts, capacity_factor, top_k)
 
     me = lax.axis_index(axis)
     tl = t // ep
     xs = lax.dynamic_slice_in_dim(x, me * tl, tl, axis=0)         # (Tl, D) distinct
-    capacity = max(1, int(tl * capacity_factor / n_experts))
-    dispatch, combine, aux = _route(xs, params["wg"], n_experts, capacity)
+    capacity = max(1, int(tl * capacity_factor * top_k / n_experts))
+    dispatch, combine, aux = _route(xs, params["wg"], n_experts, capacity, top_k)
     buf = jnp.einsum("tec,td->ecd", dispatch, xs.astype(jnp.float32))
     buf = buf.reshape(ep, el, capacity, d)
     recv = lax.all_to_all(buf, axis, split_axis=0, concat_axis=0)  # (ep, El, C, D)
@@ -97,15 +119,16 @@ def moe_ffn(
     return out, aux
 
 
-def _moe_slice(xs, params, n_experts: int, capacity_factor: float):
-    capacity = max(1, int(xs.shape[0] * capacity_factor / n_experts))
-    dispatch, combine, aux = _route(xs, params["wg"], n_experts, capacity)
+def _moe_slice(xs, params, n_experts: int, capacity_factor: float, top_k: int = 1):
+    capacity = max(1, int(xs.shape[0] * capacity_factor * top_k / n_experts))
+    dispatch, combine, aux = _route(xs, params["wg"], n_experts, capacity, top_k)
     buf = jnp.einsum("tec,td->ecd", dispatch, xs.astype(jnp.float32))
     y = _expert_ffn(buf, params["w1"], params["w2"])
     return jnp.einsum("tec,ecd->td", combine, y), aux
 
 
-def moe_ffn_dense(x, wg, w1, w2, ep: int = 1, capacity_factor: float = 1.25):
+def moe_ffn_dense(x, wg, w1, w2, ep: int = 1, capacity_factor: float = 1.25,
+                  top_k: int = 1):
     """Single-device oracle reproducing the sharded semantics: tokens are routed in
     ep independent slices (capacity competition is per slice). w1: (E, D, F)."""
     t, d = x.shape
@@ -114,7 +137,7 @@ def moe_ffn_dense(x, wg, w1, w2, ep: int = 1, capacity_factor: float = 1.25):
     outs, auxes = [], []
     tl = t // ep
     for s in range(ep):
-        o, a = _moe_slice(x[s * tl : (s + 1) * tl], params, e, capacity_factor)
+        o, a = _moe_slice(x[s * tl : (s + 1) * tl], params, e, capacity_factor, top_k)
         outs.append(o)
         auxes.append(a)
     return jnp.concatenate(outs, axis=0), jnp.stack(auxes).mean()

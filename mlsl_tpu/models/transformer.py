@@ -47,6 +47,7 @@ class TransformerConfig:
     attention: str = "ring"  # 'ring' | 'ulysses'
     dtype: str = "bfloat16"  # MXU compute dtype; 'float32' for exactness tests
     n_experts: int = 0       # >0: MoE FFN with expert parallelism over 'model'
+    moe_top_k: int = 1       # 1 = switch routing; 2 = GShard-style top-2
     moe_aux_weight: float = 0.01
     capacity_factor: float = 2.0
     sharded_vocab: bool = False  # shard the LM head over 'model'; CE via collectives
@@ -186,7 +187,7 @@ def forward_local(params, tokens, cfg: TransformerConfig, sp: int, tp: int):
             bl, sl_, dm = a.shape
             o2d, aux = moe_ffn(
                 a.reshape(bl * sl_, dm).astype(jnp.float32),
-                mp, MODEL_AXIS, tp, cfg.capacity_factor,
+                mp, MODEL_AXIS, tp, cfg.capacity_factor, cfg.moe_top_k,
             )
             aux_total = aux_total + aux
             h = (h.astype(jnp.float32) + o2d.reshape(bl, sl_, dm)).astype(cdt)

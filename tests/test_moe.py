@@ -18,19 +18,20 @@ def _params(seed=0):
 
 
 @pytest.mark.parametrize("ep", [1, 2, 4])
-def test_moe_matches_oracle(env, ep):
+@pytest.mark.parametrize("top_k", [1, 2])
+def test_moe_matches_oracle(env, ep, top_k):
     params = _params()
     rng = np.random.default_rng(0)
     x = jnp.asarray(rng.normal(size=(T, D)).astype(np.float32))
     want, want_aux = moe.moe_ffn_dense(
-        x, params["wg"], params["w1"], params["w2"], ep=ep
+        x, params["wg"], params["w1"], params["w2"], ep=ep, top_k=top_k
     )
 
     dist = env.create_distribution(1, ep, devices=env.devices[:ep])
     spec_p = {"wg": P(), "w1": P("model", None, None), "w2": P("model", None, None)}
 
     def body(params, x):
-        out, aux = moe.moe_ffn(x, params, "model", ep)
+        out, aux = moe.moe_ffn(x, params, "model", ep, top_k=top_k)
         return out, lax.pmean(aux, "model")[None]
 
     fn = jax.jit(
@@ -81,6 +82,44 @@ def test_moe_gradients_match_oracle(env):
         np.testing.assert_allclose(
             np.asarray(gs[k]), np.asarray(gd[k]), atol=2e-4, rtol=2e-4
         )
+
+
+def test_top1_router_receives_task_gradient(env):
+    """Switch (top-1) gates with the RAW probability: the router weight wg must
+    get nonzero gradient from the task loss (renormalization would zero it)."""
+    params = _params(5)
+    rng = np.random.default_rng(6)
+    x = jnp.asarray(rng.normal(size=(32, D)).astype(np.float32))
+
+    def loss(wg):
+        out, _ = moe.moe_ffn_dense(x, wg, params["w1"], params["w2"], ep=1,
+                                   capacity_factor=8.0, top_k=1)
+        return jnp.sum(out ** 2)
+
+    g = jax.grad(loss)(params["wg"])
+    assert float(jnp.abs(g).max()) > 0.0
+
+
+def test_top2_combines_two_experts(env):
+    """Top-2 routing: with ample capacity, every token's output is the
+    gate-weighted sum of its two best experts' FFN outputs."""
+    params = _params(3)
+    rng = np.random.default_rng(4)
+    x = jnp.asarray(rng.normal(size=(32, D)).astype(np.float32))
+    out, _ = moe.moe_ffn_dense(
+        x, params["wg"], params["w1"], params["w2"], ep=1,
+        capacity_factor=8.0, top_k=2,
+    )
+    # manual per-token oracle
+    probs = np.asarray(jax.nn.softmax(x @ params["wg"], axis=-1))
+    for t in range(32):
+        top2 = np.argsort(-probs[t])[:2]
+        g = probs[t][top2] / probs[t][top2].sum()
+        want = np.zeros(D, np.float32)
+        for gi, e in zip(g, top2):
+            h = np.asarray(jax.nn.gelu(x[t] @ params["w1"][e]))
+            want += gi * np.asarray(h @ params["w2"][e])
+        np.testing.assert_allclose(np.asarray(out[t]), want, atol=1e-4, rtol=1e-4)
 
 
 def test_moe_capacity_drops_tokens(env):
