@@ -30,7 +30,9 @@ def main():
     # Probe the backend in a subprocess first: a dead accelerator tunnel hangs
     # uninterruptibly inside backend init, so fail fast and loud instead. The
     # child may be stuck in uninterruptible sleep (unkillable), so never block
-    # on reaping it — poll with a deadline and walk away.
+    # on reaping it — poll with a deadline and walk away. A transient tunnel
+    # outage shouldn't zero the whole round, so retry with backoff before
+    # giving up.
     import subprocess
 
     probe_src = (
@@ -39,22 +41,34 @@ def main():
         "import jax.numpy as jnp\n"
         "jnp.ones((8, 8)).sum().block_until_ready(); print('ok', flush=True)"
     )
-    child = subprocess.Popen(
-        [sys.executable, "-c", probe_src],
-        stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
-        start_new_session=True,
-        cwd=os.path.dirname(os.path.abspath(__file__)),
-    )
-    deadline = time.time() + 180
-    while child.poll() is None and time.time() < deadline:
-        time.sleep(1)
-    if child.poll() is None:
-        child.kill()  # best effort; do NOT wait() — a D-state child never reaps
-        print("bench: accelerator backend unreachable (probe timed out after "
-              "180s) — not producing a number from a dead device", file=sys.stderr)
-        sys.exit(3)
-    if child.returncode != 0:
-        print(f"bench: backend probe failed:\n{child.stderr.read()[-500:]}",
+    attempts = int(os.environ.get("MLSL_BENCH_PROBE_ATTEMPTS", "4"))
+    probe_timeout = float(os.environ.get("MLSL_BENCH_PROBE_TIMEOUT", "180"))
+    last_err = ""
+    for attempt in range(attempts):
+        child = subprocess.Popen(
+            [sys.executable, "-c", probe_src],
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+            start_new_session=True,
+            cwd=os.path.dirname(os.path.abspath(__file__)),
+        )
+        deadline = time.time() + probe_timeout
+        while child.poll() is None and time.time() < deadline:
+            time.sleep(1)
+        if child.poll() is None:
+            child.kill()  # best effort; do NOT wait() — a D-state child never reaps
+            last_err = f"probe timed out after {probe_timeout:.0f}s"
+        elif child.returncode != 0:
+            last_err = f"probe exited {child.returncode}:\n{child.stderr.read()[-500:]}"
+        else:
+            break
+        if attempt + 1 < attempts:
+            backoff = 30 * (2 ** attempt)
+            print(f"bench: backend unreachable ({last_err.splitlines()[0]}); "
+                  f"retry {attempt + 2}/{attempts} in {backoff}s", file=sys.stderr)
+            time.sleep(backoff)
+    else:
+        print(f"bench: accelerator backend unreachable after {attempts} attempts "
+              f"({last_err}) — not producing a number from a dead device",
               file=sys.stderr)
         sys.exit(3)
 

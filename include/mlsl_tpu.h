@@ -126,6 +126,52 @@ int64_t mlsl_operation_get_local_minibatch_size(mlsl_handle_t op);
 int64_t mlsl_operation_get_parameter_local_count(mlsl_handle_t op, int64_t idx);
 int64_t mlsl_operation_get_parameter_owned_count(mlsl_handle_t op, int64_t idx);
 
+/* ---- v-collectives (reference mlsl.hpp:418-471) ----
+ * Count/displacement arrays are int64[group_size], identical on every rank
+ * (the MPI "same counts everywhere" mode). Pass NULL displacements for the
+ * packed default. */
+mlsl_handle_t mlsl_distribution_all_gatherv(mlsl_handle_t dist,
+                                            const void* send,
+                                            int64_t send_count,
+                                            const int64_t* recv_counts,
+                                            mlsl_data_type_t dt,
+                                            mlsl_group_type_t group);
+mlsl_handle_t mlsl_distribution_all_to_allv(mlsl_handle_t dist,
+                                            const void* send, int64_t send_len,
+                                            const int64_t* send_counts,
+                                            const int64_t* send_offsets,
+                                            const int64_t* recv_offsets,
+                                            mlsl_data_type_t dt,
+                                            mlsl_group_type_t group);
+
+/* ---- activations (reference mlsl.hpp:210-268, c_bind activation calls) ---- */
+int64_t mlsl_operation_get_input_count(mlsl_handle_t op);
+int64_t mlsl_operation_get_output_count(mlsl_handle_t op);
+mlsl_handle_t mlsl_operation_get_input(mlsl_handle_t op, int64_t idx);
+mlsl_handle_t mlsl_operation_get_output(mlsl_handle_t op, int64_t idx);
+
+int64_t mlsl_activation_get_global_fm_count(mlsl_handle_t act);
+int64_t mlsl_activation_get_local_fm_count(mlsl_handle_t act);
+int64_t mlsl_activation_get_fm_size(mlsl_handle_t act);
+int mlsl_activation_needs_comm(mlsl_handle_t act);
+/* Per-rank wire-buffer element count for start_comm/wait_comm (0 = no comm). */
+int64_t mlsl_activation_get_wire_count(mlsl_handle_t act);
+int64_t mlsl_activation_get_pack_block_count(mlsl_handle_t act);
+int64_t mlsl_activation_get_unpack_block_count(mlsl_handle_t act);
+/* field: 0=mb_offset 1=mb_count 2=fm_offset 3=fm_count 4=fm_size 5=buf_offset
+ * (reference CommBlockInfo mlsl.hpp:177-204). */
+int64_t mlsl_activation_get_pack_block(mlsl_handle_t act, int64_t idx,
+                                       int field);
+int64_t mlsl_activation_get_unpack_block(mlsl_handle_t act, int64_t idx,
+                                         int field);
+/* buf: (world, wire_count), packed per the pack blocks. */
+int mlsl_activation_start_comm(mlsl_handle_t act, const void* buf,
+                               mlsl_data_type_t dt);
+/* Waits the PEER's transfer; writes (world, n); returns n (0 = no comm on
+ * this edge; negative = error). */
+int64_t mlsl_activation_wait_comm(mlsl_handle_t act, void* recv,
+                                  mlsl_data_type_t dt);
+
 /* ---- parameter-set gradient sync ---- */
 int mlsl_parameter_set_start_gradient_comm(mlsl_handle_t op, int64_t ps_idx,
                                            const void* grads,
@@ -134,6 +180,41 @@ int mlsl_parameter_set_start_gradient_comm(mlsl_handle_t op, int64_t ps_idx,
  * was needed; negative = error). */
 int64_t mlsl_parameter_set_wait_gradient_comm(mlsl_handle_t op, int64_t ps_idx,
                                               void* recv, mlsl_data_type_t dt);
+/* 1 = complete, 0 = in flight, negative = error. */
+int mlsl_parameter_set_test_gradient_comm(mlsl_handle_t op, int64_t ps_idx);
+/* Distributed-update increment AllGather (reference mlsl.hpp:318-331). */
+int mlsl_parameter_set_start_increment_comm(mlsl_handle_t op, int64_t ps_idx,
+                                            const void* incs,
+                                            mlsl_data_type_t dt);
+int64_t mlsl_parameter_set_wait_increment_comm(mlsl_handle_t op, int64_t ps_idx,
+                                               void* recv, mlsl_data_type_t dt);
+int64_t mlsl_parameter_set_get_global_kernel_count(mlsl_handle_t op,
+                                                   int64_t ps_idx);
+int64_t mlsl_parameter_set_get_local_kernel_count(mlsl_handle_t op,
+                                                  int64_t ps_idx);
+int64_t mlsl_parameter_set_get_owned_kernel_count(mlsl_handle_t op,
+                                                  int64_t ps_idx);
+int64_t mlsl_parameter_set_get_kernel_size(mlsl_handle_t op, int64_t ps_idx);
+int mlsl_parameter_set_is_distributed_update(mlsl_handle_t op, int64_t ps_idx);
+
+/* ---- statistics (reference mlsl.hpp:651-726) ----
+ * "Cycles" are nanoseconds (the TPU analog of the reference's rdtsc). */
+mlsl_handle_t mlsl_session_get_stats(mlsl_handle_t sess);
+int mlsl_statistics_start(mlsl_handle_t stats);
+int mlsl_statistics_stop(mlsl_handle_t stats);
+int mlsl_statistics_reset(mlsl_handle_t stats);
+int mlsl_statistics_is_enabled(mlsl_handle_t stats);
+int mlsl_statistics_is_started(mlsl_handle_t stats);
+int64_t mlsl_statistics_get_comm_size(mlsl_handle_t stats, int64_t op_idx);
+int64_t mlsl_statistics_get_comm_cycles(mlsl_handle_t stats, int64_t op_idx);
+int64_t mlsl_statistics_get_compute_cycles(mlsl_handle_t stats, int64_t op_idx);
+int64_t mlsl_statistics_get_isolation_comm_cycles(mlsl_handle_t stats,
+                                                  int64_t op_idx);
+int64_t mlsl_statistics_get_total_comm_size(mlsl_handle_t stats);
+int64_t mlsl_statistics_get_total_comm_cycles(mlsl_handle_t stats);
+int64_t mlsl_statistics_get_total_compute_cycles(mlsl_handle_t stats);
+int64_t mlsl_statistics_get_total_isolation_comm_cycles(mlsl_handle_t stats);
+int mlsl_statistics_print(mlsl_handle_t stats);
 
 int mlsl_handle_release(mlsl_handle_t h);
 

@@ -71,11 +71,130 @@ class Distribution {
   CommReq AlltoAll(const void* send, int64_t count, DataType dt, GroupType g) {
     return CommReq(mlsl_distribution_all_to_all(h_, send, count, dt, g));
   }
+  CommReq Reduce(const void* send, int64_t count, DataType dt, ReductionType op,
+                 int64_t root, GroupType g) {
+    return CommReq(mlsl_distribution_reduce(h_, send, count, dt, op, root, g));
+  }
+  CommReq Gather(const void* send, int64_t count, DataType dt, int64_t root,
+                 GroupType g) {
+    return CommReq(mlsl_distribution_gather(h_, send, count, dt, root, g));
+  }
+  CommReq Scatter(const void* send, int64_t count, DataType dt, int64_t root,
+                  GroupType g) {
+    return CommReq(mlsl_distribution_scatter(h_, send, count, dt, root, g));
+  }
+  CommReq AllGatherv(const void* send, int64_t send_count,
+                     const int64_t* recv_counts, DataType dt, GroupType g) {
+    return CommReq(
+        mlsl_distribution_all_gatherv(h_, send, send_count, recv_counts, dt, g));
+  }
+  CommReq AlltoAllv(const void* send, int64_t send_len,
+                    const int64_t* send_counts, const int64_t* send_offsets,
+                    const int64_t* recv_offsets, DataType dt, GroupType g) {
+    return CommReq(mlsl_distribution_all_to_allv(
+        h_, send, send_len, send_counts, send_offsets, recv_offsets, dt, g));
+  }
   void Barrier(GroupType g) { Check(mlsl_distribution_barrier(h_, g), "barrier"); }
   mlsl_handle_t handle() const { return h_; }
 
  private:
   mlsl_handle_t h_;
+};
+
+/* One pack/unpack block (reference CommBlockInfo include/mlsl.hpp:177-204). */
+struct CommBlockInfo {
+  int64_t mb_offset, mb_count, fm_offset, fm_count, fm_size, buf_offset;
+};
+
+/* Activation handle (reference include/mlsl.hpp:210-268). */
+class Activation {
+ public:
+  explicit Activation(mlsl_handle_t h) : h_(h) {
+    if (h_ == 0) throw std::runtime_error("null activation");
+  }
+  int64_t GetGlobalFmCount() const { return mlsl_activation_get_global_fm_count(h_); }
+  int64_t GetLocalFmCount() const { return mlsl_activation_get_local_fm_count(h_); }
+  int64_t GetFmSize() const { return mlsl_activation_get_fm_size(h_); }
+  bool NeedsComm() const { return mlsl_activation_needs_comm(h_) == 1; }
+  int64_t GetWireCount() const { return mlsl_activation_get_wire_count(h_); }
+  int64_t GetPackBlockCount() const {
+    return mlsl_activation_get_pack_block_count(h_);
+  }
+  int64_t GetUnpackBlockCount() const {
+    return mlsl_activation_get_unpack_block_count(h_);
+  }
+  CommBlockInfo GetPackBlock(int64_t idx) const { return Block_(idx, false); }
+  CommBlockInfo GetUnpackBlock(int64_t idx) const { return Block_(idx, true); }
+  /* buf: (world, wire_count), packed per the pack blocks */
+  void StartComm(const void* buf, DataType dt) {
+    Check(mlsl_activation_start_comm(h_, buf, dt), "activation start comm");
+  }
+  /* waits the PEER's transfer; returns per-rank count written (0 = no comm) */
+  int64_t WaitComm(void* recv, DataType dt) {
+    int64_t n = mlsl_activation_wait_comm(h_, recv, dt);
+    if (n < 0) throw std::runtime_error("activation wait comm");
+    return n;
+  }
+  mlsl_handle_t handle() const { return h_; }
+
+ private:
+  CommBlockInfo Block_(int64_t idx, bool unpack) const {
+    CommBlockInfo b;
+    int64_t* f[6] = {&b.mb_offset, &b.mb_count, &b.fm_offset,
+                     &b.fm_count, &b.fm_size, &b.buf_offset};
+    for (int i = 0; i < 6; ++i)
+      *f[i] = unpack ? mlsl_activation_get_unpack_block(h_, idx, i)
+                     : mlsl_activation_get_pack_block(h_, idx, i);
+    return b;
+  }
+  mlsl_handle_t h_;
+};
+
+/* ParameterSet handle (reference include/mlsl.hpp:276-341); identified by
+ * (operation, index) as in the flat C layer. */
+class ParameterSet {
+ public:
+  ParameterSet(mlsl_handle_t op, int64_t idx) : op_(op), idx_(idx) {}
+  int64_t GetGlobalKernelCount() const {
+    return mlsl_parameter_set_get_global_kernel_count(op_, idx_);
+  }
+  int64_t GetLocalKernelCount() const {
+    return mlsl_parameter_set_get_local_kernel_count(op_, idx_);
+  }
+  int64_t GetOwnedKernelCount() const {
+    return mlsl_parameter_set_get_owned_kernel_count(op_, idx_);
+  }
+  int64_t GetKernelSize() const {
+    return mlsl_parameter_set_get_kernel_size(op_, idx_);
+  }
+  bool IsDistributedUpdate() const {
+    return mlsl_parameter_set_is_distributed_update(op_, idx_) == 1;
+  }
+  void StartGradientComm(const void* grads, DataType dt) {
+    Check(mlsl_parameter_set_start_gradient_comm(op_, idx_, grads, dt),
+          "start gradient comm");
+  }
+  int64_t WaitGradientComm(void* recv, DataType dt) {
+    int64_t n = mlsl_parameter_set_wait_gradient_comm(op_, idx_, recv, dt);
+    if (n < 0) throw std::runtime_error("wait gradient comm");
+    return n;
+  }
+  bool TestGradientComm() {
+    return mlsl_parameter_set_test_gradient_comm(op_, idx_) == 1;
+  }
+  void StartIncrementComm(const void* incs, DataType dt) {
+    Check(mlsl_parameter_set_start_increment_comm(op_, idx_, incs, dt),
+          "start increment comm");
+  }
+  int64_t WaitIncrementComm(void* recv, DataType dt) {
+    int64_t n = mlsl_parameter_set_wait_increment_comm(op_, idx_, recv, dt);
+    if (n < 0) throw std::runtime_error("wait increment comm");
+    return n;
+  }
+
+ private:
+  mlsl_handle_t op_;
+  int64_t idx_;
 };
 
 class Operation {
@@ -86,6 +205,17 @@ class Operation {
   }
   int64_t GetLocalMinibatchSize() const {
     return mlsl_operation_get_local_minibatch_size(h_);
+  }
+  int64_t GetInputCount() const { return mlsl_operation_get_input_count(h_); }
+  int64_t GetOutputCount() const { return mlsl_operation_get_output_count(h_); }
+  Activation GetInput(int64_t idx) const {
+    return Activation(mlsl_operation_get_input(h_, idx));
+  }
+  Activation GetOutput(int64_t idx) const {
+    return Activation(mlsl_operation_get_output(h_, idx));
+  }
+  ParameterSet GetParameterSet(int64_t idx) const {
+    return ParameterSet(h_, idx);
   }
   int64_t GetParameterLocalCount(int64_t idx) const {
     return mlsl_operation_get_parameter_local_count(h_, idx);
@@ -103,6 +233,49 @@ class Operation {
     if (n < 0) throw std::runtime_error("wait gradient comm");
     return n;
   }
+  mlsl_handle_t handle() const { return h_; }
+
+ private:
+  mlsl_handle_t h_;
+};
+
+/* Statistics handle (reference include/mlsl.hpp:651-726); "cycles" are
+ * nanoseconds (TPU analog of rdtsc cycles). */
+class Statistics {
+ public:
+  explicit Statistics(mlsl_handle_t h) : h_(h) {
+    if (h_ == 0) throw std::runtime_error("null statistics");
+  }
+  void Start() { Check(mlsl_statistics_start(h_), "stats start"); }
+  void Stop() { Check(mlsl_statistics_stop(h_), "stats stop"); }
+  void Reset() { Check(mlsl_statistics_reset(h_), "stats reset"); }
+  bool IsEnabled() const { return mlsl_statistics_is_enabled(h_) == 1; }
+  bool IsStarted() const { return mlsl_statistics_is_started(h_) == 1; }
+  int64_t GetCommSize(int64_t op_idx) const {
+    return mlsl_statistics_get_comm_size(h_, op_idx);
+  }
+  int64_t GetCommCycles(int64_t op_idx) const {
+    return mlsl_statistics_get_comm_cycles(h_, op_idx);
+  }
+  int64_t GetComputeCycles(int64_t op_idx) const {
+    return mlsl_statistics_get_compute_cycles(h_, op_idx);
+  }
+  int64_t GetIsolationCommCycles(int64_t op_idx) const {
+    return mlsl_statistics_get_isolation_comm_cycles(h_, op_idx);
+  }
+  int64_t GetTotalCommSize() const {
+    return mlsl_statistics_get_total_comm_size(h_);
+  }
+  int64_t GetTotalCommCycles() const {
+    return mlsl_statistics_get_total_comm_cycles(h_);
+  }
+  int64_t GetTotalComputeCycles() const {
+    return mlsl_statistics_get_total_compute_cycles(h_);
+  }
+  int64_t GetTotalIsolationCommCycles() const {
+    return mlsl_statistics_get_total_isolation_comm_cycles(h_);
+  }
+  void Print() { Check(mlsl_statistics_print(h_), "stats print"); }
   mlsl_handle_t handle() const { return h_; }
 
  private:
@@ -147,6 +320,7 @@ class Session {
     return Operation(op);
   }
   void Commit() { Check(mlsl_session_commit(h_), "commit"); }
+  Statistics GetStats() { return Statistics(mlsl_session_get_stats(h_)); }
   mlsl_handle_t handle() const { return h_; }
 
  private:

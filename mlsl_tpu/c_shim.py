@@ -235,6 +235,215 @@ def operation_param_owned_count(op_h: int, ps_idx: int) -> int:
     return ps.get_owned_kernel_count() * ps.get_kernel_size()
 
 
+# ---- activations (reference c_bind.cpp activation wrappers over
+# include/mlsl.hpp:210-268) ----
+
+def operation_get_input(op_h: int, idx: int) -> int:
+    return _put(_get(op_h).get_input(idx))
+
+
+def operation_get_output(op_h: int, idx: int) -> int:
+    return _put(_get(op_h).get_output(idx))
+
+
+def operation_input_count(op_h: int) -> int:
+    return _get(op_h).get_input_count()
+
+
+def operation_output_count(op_h: int) -> int:
+    return _get(op_h).get_output_count()
+
+
+def activation_query(act_h: int, what: int) -> int:
+    """what: 0=global_fm_count 1=local_fm_count 2=fm_size 3=pack_block_count
+    4=unpack_block_count 5=comm_buf_size 6=need_comm 7=send_count."""
+    act = _get(act_h)
+    if what == 0:
+        return act.get_global_fm_count()
+    if what == 1:
+        return act.get_local_fm_count()
+    if what == 2:
+        return act.get_fm_size()
+    if what == 3:
+        return act.get_pack_block_count()
+    if what == 4:
+        return act.get_unpack_block_count()
+    if what == 5:
+        return act.get_comm_buf_size()
+    if what == 6:
+        return int(act.need_comm)
+    if what == 7:
+        return _act_wire_count(act)
+    raise ValueError(f"unknown activation query {what}")
+
+
+def _act_wire_count(act) -> int:
+    """Per-rank wire-buffer element count for this activation's request (an
+    AlltoAll request's desc.count is the per-member block; the buffer holds one
+    block per group member)."""
+    req = act.comm_req
+    if req is None:
+        return 0
+    if req.desc.kind == "alltoall":
+        g = req.desc.group
+        return req.desc.count * (1 if g.is_self else g.size)
+    return req.desc.count
+
+
+def activation_block_query(act_h: int, is_unpack: int, idx: int, field: int) -> int:
+    """field: 0=mb_offset 1=mb_count 2=fm_offset 3=fm_count 4=fm_size
+    5=buf_offset (reference CommBlockInfo include/mlsl.hpp:177-204)."""
+    act = _get(act_h)
+    b = (act.unpack_blocks if is_unpack else act.pack_blocks)[idx]
+    return (b.mb_offset, b.mb_count, b.fm_offset, b.fm_count,
+            b.fm_size, b.buf_offset)[field]
+
+
+def activation_start_comm(act_h: int, addr: int, data_type: int) -> int:
+    act = _get(act_h)
+    n = _act_wire_count(act)
+    if n == 0:
+        return 0  # no comm on this edge (reference: no-op start)
+    buf = _read_world_buffer(act.dist, addr, n, data_type)
+    act.start_comm(buf)
+    return 0
+
+
+def activation_wait_comm(act_h: int, out_addr: int, data_type: int) -> int:
+    """Waits the PEER's transfer (reference invariant) and writes (world, n);
+    returns per-rank n (0 = no comm on this edge)."""
+    act = _get(act_h)
+    out = act.wait_comm()
+    if out is None:
+        return 0
+    n = int(np.asarray(out).shape[-1])
+    peer = act.peer_act
+    dist = peer.dist if peer is not None else act.dist
+    _write_world_buffer(dist, out, out_addr, n, data_type)
+    return n
+
+
+# ---- v-collectives (reference mlsl.hpp:418-471 AllGatherv/AlltoAllv) ----
+
+def _read_i64_array(addr: int, n: int):
+    return np.ctypeslib.as_array(
+        ctypes.cast(int(addr), ctypes.POINTER(ctypes.c_int64)), shape=(n,)
+    ).copy()
+
+
+def dist_all_gatherv(dist_h: int, addr: int, send_count: int,
+                     recv_counts_addr: int, data_type: int, group: int) -> int:
+    """recv_counts: int64[group_size], identical on every rank (MPI semantics);
+    the send buffer is (world, max(recv_counts)) with rank p's first
+    recv_counts[member_idx(p)] elements valid."""
+    dist = _get(dist_h)
+    gt = GroupType(group)
+    g = dist._group(gt)
+    gsize = 1 if g.is_self else g.size
+    counts = tuple(int(c) for c in _read_i64_array(recv_counts_addr, gsize))
+    buf = _read_world_buffer(dist, addr, send_count, data_type)
+    req = dist.all_gatherv(buf, send_count, counts, data_type, gt)
+    return _put((dist, req))
+
+
+def dist_all_to_allv(dist_h: int, addr: int, send_len: int,
+                     send_counts_addr: int, send_offsets_addr: int,
+                     recv_offsets_addr: int, data_type: int, group: int) -> int:
+    """MPI AlltoAllv with rank-uniform int64[group_size] count/displacement
+    arrays (the 1-D 'same on every rank' mode; see comm.request._normalize_alltoallv).
+    Pass 0 for an offsets addr to use the packed default."""
+    dist = _get(dist_h)
+    gt = GroupType(group)
+    g = dist._group(gt)
+    gsize = 1 if g.is_self else g.size
+    counts = _read_i64_array(send_counts_addr, gsize)
+    soff = _read_i64_array(send_offsets_addr, gsize) if send_offsets_addr else None
+    roff = _read_i64_array(recv_offsets_addr, gsize) if recv_offsets_addr else None
+    buf = _read_world_buffer(dist, addr, send_len, data_type)
+    req = dist.all_to_allv(buf, counts, soff, None, roff, data_type, gt)
+    return _put((dist, req))
+
+
+# ---- statistics (reference mlsl.hpp:651-726, c_bind stats wrappers) ----
+
+def session_get_stats(sess_h: int) -> int:
+    return _put(_get(sess_h).get_stats())
+
+
+def stats_control(stats_h: int, what: int) -> int:
+    """what: 0=start 1=stop 2=reset 3=is_enabled 4=is_started."""
+    st = _get(stats_h)
+    if what == 0:
+        st.start()
+    elif what == 1:
+        st.stop()
+    elif what == 2:
+        st.reset()
+    elif what == 3:
+        return int(st.is_enabled())
+    elif what == 4:
+        return int(st.is_started())
+    else:
+        raise ValueError(f"unknown stats control {what}")
+    return 0
+
+
+def stats_query(stats_h: int, what: int, op_idx: int) -> int:
+    """what: 0=comm_size 1=comm_cycles 2=compute_cycles 3=isolation_comm_cycles
+    (per-op with op_idx >= 0, totals with op_idx < 0). Cycles are nanoseconds
+    (the TPU analog of the reference's rdtsc cycles)."""
+    st = _get(stats_h)
+    if op_idx < 0:
+        return (st.get_total_comm_size(), st.get_total_comm_cycles(),
+                st.get_total_compute_cycles(),
+                st.get_total_isolation_comm_cycles())[what]
+    return (st.get_comm_size(op_idx), st.get_comm_cycles(op_idx),
+            st.get_compute_cycles(op_idx),
+            st.get_isolation_comm_cycles(op_idx))[what]
+
+
+def stats_print(stats_h: int) -> int:
+    _get(stats_h).print_()
+    return 0
+
+
+# ---- parameter sets (cont.) ----
+
+def param_query(op_h: int, ps_idx: int, what: int) -> int:
+    """what: 0=global_kernel_count 1=local_kernel_count 2=owned_kernel_count
+    3=kernel_size 4=is_distributed_update."""
+    ps = _get(op_h).get_parameter_set(ps_idx)
+    return (ps.get_global_kernel_count(), ps.get_local_kernel_count(),
+            ps.get_owned_kernel_count(), ps.get_kernel_size(),
+            int(ps.is_distributed_update()))[what]
+
+
+def param_test_gradient_comm(op_h: int, ps_idx: int) -> int:
+    done, _ = _get(op_h).get_parameter_set(ps_idx).test_gradient_comm()
+    return 1 if done else 0
+
+
+def param_start_increment_comm(op_h: int, ps_idx: int, addr: int, data_type: int) -> int:
+    op = _get(op_h)
+    ps = op.get_parameter_set(ps_idx)
+    count = ps.get_owned_kernel_count() * ps.get_kernel_size()
+    buf = _read_world_buffer(op.distribution, addr, count, data_type)
+    ps.start_increment_comm(buf)
+    return 0
+
+
+def param_wait_increment_comm(op_h: int, ps_idx: int, out_addr: int, data_type: int) -> int:
+    """Returns the per-rank element count written (0 if no comm was needed)."""
+    op = _get(op_h)
+    ps = op.get_parameter_set(ps_idx)
+    out = ps.wait_increment_comm()
+    if out is None:
+        return 0
+    n = int(np.asarray(out).shape[-1])
+    _write_world_buffer(op.distribution, out, out_addr, n, data_type)
+    return n
+
+
 def param_start_gradient_comm(op_h: int, ps_idx: int, addr: int, data_type: int) -> int:
     op = _get(op_h)
     ps = op.get_parameter_set(ps_idx)

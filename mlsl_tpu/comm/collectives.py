@@ -119,8 +119,13 @@ def _body_reduce(x, *, axes, sizes, op, root, **_):
 
 
 def _body_bcast(x, *, axes, sizes, root, **_):
-    members = _gather_group(x, axes)
-    return members[root]
+    # One-to-all in O(n) wire: only the root contributes to a group psum (lowered
+    # by XLA as reduce-scatter + all-gather over the ICI ring), instead of every
+    # member materializing the full (G, n) gather just to index the root's row.
+    # The reference uses true MPI_Ibcast (src/comm_ep.cpp:773-807).
+    me = _group_rank(axes, sizes)
+    contrib = jnp.where(me == root, x, jnp.zeros_like(x))
+    return lax.psum(contrib, tuple(axes))
 
 
 def _body_allgather(x, *, axes, sizes, **_):
@@ -140,9 +145,16 @@ def _body_gather(x, *, axes, sizes, root, **_):
 
 
 def _body_scatter(x, *, axes, sizes, root, recv_count, **_):
-    members = _gather_group(x, axes)     # (G, G*recv_count)
+    # Masked reduce-scatter: only root's buffer survives the sum, and the scatter
+    # hands member i root's segment i — O(n) total wire (vs the (G, G*recv_count)
+    # gather a naive emulation needs). Reference uses true MPI_Iscatter
+    # (src/comm_ep.cpp:1011-1120).
     me = _group_rank(axes, sizes)
-    return lax.dynamic_slice_in_dim(members[root], me * recv_count, recv_count, axis=0)
+    contrib = jnp.where(me == root, x, jnp.zeros_like(x))
+    if len(axes) == 1:
+        return lax.psum_scatter(contrib, axes[0], scatter_dimension=0, tiled=True)
+    red = lax.psum(contrib, tuple(axes))
+    return lax.dynamic_slice_in_dim(red, me * recv_count, recv_count, axis=0)
 
 
 def _body_reduce_scatter(x, *, axes, sizes, op, recv_count, **_):
@@ -221,64 +233,247 @@ def sizes_prod(axes, sizes) -> int:
 
 
 # ---------------------------------------------------------------------------
-# Color-group (arbitrary subgroup) bodies: world-gather + static member tables.
+# Subgroup bodies: XLA-native arbitrary subgroups via axis_index_groups.
+#
+# Color groups (MPI_Comm_split partitions, reference src/comm_ep.cpp:1821-1827)
+# and multi-axis alltoall/sendrecv compile against the flattened single-axis
+# "world" mesh (Topology.flat_mesh): lax collectives take axis_index_groups
+# there, which lowers to HLO replica_groups — true subgroup collectives on the
+# wire, not a world-gather emulation. Equal-size groups only (XLA's replica
+# groups are rectangular); ragged color groups use _make_ragged_body below.
 # ---------------------------------------------------------------------------
 
 
-def _color_tables(group: ProcessGroup):
-    """(member_matrix (W,G): row p = world ranks of p's group in order;
-    my_pos (W,): p's index within its group)."""
-    w = group.topology.world_size
-    g = group.size
-    member = np.zeros((w, g), dtype=np.int32)
+def _subgroup_tables(groups: Tuple[Tuple[int, ...], ...]):
+    """pos[p] = p's member index within its group row."""
+    w = sum(len(g) for g in groups)
     pos = np.zeros((w,), dtype=np.int32)
+    for row in groups:
+        for i, p in enumerate(row):
+            pos[p] = i
+    return pos
+
+
+def _color_groups_tbl(group: ProcessGroup) -> Tuple[Tuple[int, ...], ...]:
+    """Member rows (world ranks, in world-rank order — MPI_Comm_split member
+    ordering) per color, colors ascending."""
+    return tuple(
+        group.member_world_ranks(c) for c in sorted(set(group.colors))
+    )
+
+
+def _axis_groups_tbl(group: ProcessGroup) -> Tuple[Tuple[int, ...], ...]:
+    """Member rows for an axis-aligned group: one row per instance (product of the
+    complementary axes), members in group-rank order (group.axes major->minor)."""
+    import itertools
+
+    topo = group.topology
+    shape = dict(zip(GRID_AXES, topo.grid_shape))
+    comp = [a for a in GRID_AXES if a not in group.axes]
+    rows = []
+    for comp_coords in itertools.product(*(range(shape[a]) for a in comp)):
+        fixed = dict(zip(comp, comp_coords))
+        row = []
+        for g_coords in itertools.product(*(range(shape[a]) for a in group.axes)):
+            c = {**fixed, **dict(zip(group.axes, g_coords))}
+            row.append(topo.global_idx(c[GRID_AXES[0]], c[GRID_AXES[1]],
+                                       c[GRID_AXES[2]], c[GRID_AXES[3]]))
+        rows.append(tuple(row))
+    return tuple(rows)
+
+
+def _alltoallv_core(g_members, me_pos, x_dtype, S, Soff, Roff, recv_len):
+    """Shared AlltoAllv scatter/merge math over an already-gathered (G, send_len)
+    member block; see _body_alltoallv for the semantics."""
+    g = len(S)
+    s_m = jnp.asarray(S, dtype=jnp.int32)
+    soff_m = jnp.asarray(Soff, dtype=jnp.int32)
+    roff_m = jnp.asarray(Roff, dtype=jnp.int32)
+    lmax = int(np.max(S)) if np.max(S) > 0 else 1
+    pos = jnp.arange(lmax)
+    pad = jnp.zeros((lmax,), dtype=x_dtype)
+    out = jnp.zeros((recv_len + lmax,), dtype=x_dtype)
+    for j in range(g):
+        cnt = s_m[j, me_pos]
+        src = lax.dynamic_slice_in_dim(
+            jnp.concatenate([g_members[j], pad]), soff_m[j, me_pos], lmax, axis=0
+        )
+        roff = roff_m[me_pos, j]
+        window = lax.dynamic_slice_in_dim(out, roff, lmax, axis=0)
+        merged = jnp.where(pos < cnt, src, window)
+        out = lax.dynamic_update_slice_in_dim(out, merged, roff, axis=0)
+    return out[:recv_len]
+
+
+def _make_subgroup_body(kind: str, groups: Tuple[Tuple[int, ...], ...], *,
+                        op=None, root=None, recv_count=None, recv_counts=None,
+                        pairs=None, S=None, Soff=None, Roff=None, recv_len=None,
+                        **_):
+    """(n,) -> (out_n,) body over the single 'world' axis, using axis_index_groups."""
+    gsize = len(groups[0])
+    gl = [list(row) for row in groups]
+    pos_t = jnp.asarray(_subgroup_tables(groups))
+
+    def mypos():
+        return jnp.take(pos_t, lax.axis_index("world"))
+
+    def gather_group(v):                           # (n,) -> (G, n)
+        return lax.all_gather(
+            v[None], "world", axis=0, tiled=True, axis_index_groups=gl
+        )
+
+    def rs_ag_sum(v):
+        # subgroup allreduce(SUM) = reduce-scatter + all-gather, O(n) wire;
+        # pad so the scatter dimension divides the group size
+        n = v.shape[0]
+        r = (-n) % gsize
+        if r:
+            v = jnp.concatenate([v, jnp.zeros((r,), v.dtype)])
+        piece = lax.psum_scatter(
+            v, "world", scatter_dimension=0, tiled=True, axis_index_groups=gl
+        )
+        out = lax.all_gather(
+            piece, "world", axis=0, tiled=True, axis_index_groups=gl
+        )
+        return out[:n]
+
+    if kind in ("allreduce", "reduce"):
+        if op == ReductionType.SUM:
+            return rs_ag_sum
+        return lambda v: _reduce_local(gather_group(v), op)
+    if kind == "bcast":
+        # masked reduce-scatter + all-gather: only the root contributes, so the
+        # group reassembles exactly the root's buffer in O(n) wire
+        return lambda v: rs_ag_sum(jnp.where(mypos() == root, v, jnp.zeros_like(v)))
+    if kind in ("allgather", "gather"):
+        return lambda v: gather_group(v).reshape(-1)
+    if kind == "allgatherv":
+        def body_agv(v):
+            g = gather_group(v)
+            return jnp.concatenate(
+                [g[i, : recv_counts[i]] for i in range(gsize)], axis=0
+            )
+        return body_agv
+    if kind == "scatter":
+        # masked reduce-scatter: member i receives root's segment i directly
+        return lambda v: lax.psum_scatter(
+            jnp.where(mypos() == root, v, jnp.zeros_like(v)),
+            "world", scatter_dimension=0, tiled=True, axis_index_groups=gl,
+        )
+    if kind == "reduce_scatter":
+        if op == ReductionType.SUM:
+            return lambda v: lax.psum_scatter(
+                v, "world", scatter_dimension=0, tiled=True, axis_index_groups=gl
+            )
+        def body_rs(v):
+            red = _reduce_local(gather_group(v), op)
+            return lax.dynamic_slice_in_dim(
+                red, mypos() * recv_count, recv_count, axis=0
+            )
+        return body_rs
+    if kind == "alltoall":
+        return lambda v: lax.all_to_all(
+            v, "world", split_axis=0, concat_axis=0, tiled=True,
+            axis_index_groups=gl,
+        )
+    if kind == "sendrecv":
+        # group-relative (src, dst) member pairs -> one world ppermute across all
+        # group instances; non-receivers get zeros (ppermute semantics), matching
+        # the axis-aligned body
+        world_pairs = [(row[int(s)], row[int(d)]) for row in groups for s, d in pairs]
+        return lambda v: lax.ppermute(v, "world", world_pairs)
+    if kind == "alltoallv":
+        return lambda v: _alltoallv_core(
+            gather_group(v), mypos(), v.dtype, S, Soff, Roff, recv_len
+        )
+    raise NotImplementedError(kind)  # pragma: no cover - kinds are closed above
+
+
+# ---------------------------------------------------------------------------
+# Ragged color groups: world-gather + padded member tables. XLA replica groups
+# must be rectangular, so unequal MPI_Comm_split partitions fall back to the
+# gather+mask emulation. Outputs whose length depends on the group size
+# (allgather/gather) are padded to the max group size with zeros; kinds whose
+# per-rank buffer sizes would themselves be ragged (scatter/reduce_scatter/
+# alltoall(v)) are rejected — SPMD buffers are rank-uniform.
+# ---------------------------------------------------------------------------
+
+
+def _ragged_tables(group: ProcessGroup):
+    """(member (W, Gmax) padded with 0, valid (W, Gmax) mask, pos (W,), gsize (W,))."""
+    w = group.topology.world_size
+    gmax = group.size
+    member = np.zeros((w, gmax), dtype=np.int32)
+    valid = np.zeros((w, gmax), dtype=bool)
+    pos = np.zeros((w,), dtype=np.int32)
+    gsz = np.zeros((w,), dtype=np.int32)
     for p in range(w):
         ranks = group.member_world_ranks(group.colors[p])
-        member[p] = ranks
+        member[p, : len(ranks)] = ranks
+        valid[p, : len(ranks)] = True
         pos[p] = ranks.index(p)
-    return member, pos
+        gsz[p] = len(ranks)
+    return member, valid, pos, gsz
 
 
-def _make_color_body(kind: str, group: ProcessGroup, *, op=None, root=None,
-                     recv_count=None, pairs=None):
-    member_np, pos_np = _color_tables(group)
+def _make_ragged_body(kind: str, group: ProcessGroup, *, op=None, root=None,
+                      pairs=None, **_):
+    mlsl_assert(
+        kind in ("allreduce", "reduce", "bcast", "allgather", "gather", "sendrecv"),
+        "%s is not supported on unequal-sized color groups (per-rank buffer sizes "
+        "would be ragged, but SPMD buffers are rank-uniform)", kind,
+    )
+    member_np, valid_np, pos_np, gsz_np = _ragged_tables(group)
     sizes = _axis_sizes(group.topology.mesh)
+    if root is not None:
+        mlsl_assert(
+            root < int(gsz_np.min()),
+            "root member index %d out of range for the smallest group (size %d)",
+            root, int(gsz_np.min()),
+        )
+    if pairs:
+        mlsl_assert(
+            max(max(int(s), int(d)) for s, d in pairs) < int(gsz_np.min()),
+            "sendrecv pair member index out of range for the smallest group",
+        )
 
     def body(x):
-        full = _gather_group(x, ALL_AXES)                      # (W, n)
-        me = _group_rank(ALL_AXES, sizes)                      # world rank
-        members = jnp.take(jnp.asarray(member_np), me, axis=0)  # (G,)
-        vals = jnp.take(full, members, axis=0)                  # (G, n)
+        full = _gather_group(x, ALL_AXES)                       # (W, n)
+        me = _group_rank(ALL_AXES, sizes)                       # world rank
+        members = jnp.take(jnp.asarray(member_np), me, axis=0)  # (Gmax,)
+        valid = jnp.take(jnp.asarray(valid_np), me, axis=0)     # (Gmax,)
+        vals = jnp.take(full, members, axis=0)                  # (Gmax, n)
+        vmask = valid[:, None]
         if kind in ("allreduce", "reduce"):
-            return _reduce_local(vals, op)
+            if op == ReductionType.MIN:
+                neutral = jnp.full_like(vals, _dtype_max(vals.dtype))
+            elif op == ReductionType.MAX:
+                neutral = jnp.full_like(vals, _dtype_min(vals.dtype))
+            else:
+                neutral = jnp.zeros_like(vals)
+            return _reduce_local(jnp.where(vmask, vals, neutral), op)
         if kind == "bcast":
             return vals[root]
         if kind in ("allgather", "gather"):
-            return vals.reshape(-1)
-        if kind == "scatter":
-            mypos = jnp.take(jnp.asarray(pos_np), me)
-            return lax.dynamic_slice_in_dim(
-                vals[root], mypos * recv_count, recv_count, axis=0
-            )
-        if kind == "reduce_scatter":
-            red = _reduce_local(vals, op)                      # (G*recv_count,)
-            mypos = jnp.take(jnp.asarray(pos_np), me)
-            return lax.dynamic_slice_in_dim(red, mypos * recv_count, recv_count, axis=0)
-        if kind == "alltoall":
-            g = member_np.shape[1]
-            mypos = jnp.take(jnp.asarray(pos_np), me)
-            blocks = vals.reshape(g, g, -1)                    # (G, G, count)
-            mine = lax.dynamic_index_in_dim(blocks, mypos, axis=1, keepdims=False)
-            return mine.reshape(-1)
+            # padded semantics: members beyond this rank's group size are zeros
+            return jnp.where(vmask, vals, jnp.zeros_like(vals)).reshape(-1)
         if kind == "sendrecv":
             mypos = jnp.take(jnp.asarray(pos_np), me)
             out = jnp.zeros_like(x)
             for s, d in pairs:
                 out = jnp.where(mypos == d, vals[int(s)], out)
             return out
-        raise NotImplementedError(kind)
+        raise NotImplementedError(kind)  # pragma: no cover - guarded above
 
     return body
+
+
+def _dtype_max(dt):
+    return jnp.inf if jnp.issubdtype(dt, jnp.floating) else jnp.iinfo(dt).max
+
+
+def _dtype_min(dt):
+    return -jnp.inf if jnp.issubdtype(dt, jnp.floating) else jnp.iinfo(dt).min
 
 
 _AXIS_BODIES = {
@@ -342,14 +537,26 @@ def build_collective(kind: str, group: ProcessGroup, dtype, **kw) -> Callable:
             return x
 
     elif group.colors is not None:
-        body = _make_color_body(
-            kind,
-            group,
-            op=kw.get("op"),
-            root=kw.get("root"),
-            recv_count=kw.get("recv_count"),
-            pairs=kw.get("pairs"),
+        if group.is_uniform:
+            fn = _build_flat(
+                _make_subgroup_body(kind, _color_groups_tbl(group), **kw),
+                topo, kind, "color",
+            )
+            _cache[key] = fn
+            return fn
+        body = _make_ragged_body(
+            kind, group, op=kw.get("op"), root=kw.get("root"), pairs=kw.get("pairs")
         )
+    elif kind in ("alltoall", "sendrecv") and len(group.axes) > 1:
+        # multi-axis groups have no single named axis for the native op; compile
+        # against the flat world mesh with explicit subgroup rows instead of the
+        # O(G*n) gather+select emulation
+        fn = _build_flat(
+            _make_subgroup_body(kind, _axis_groups_tbl(group), **kw),
+            topo, kind, group.axes,
+        )
+        _cache[key] = fn
+        return fn
     else:
         raw = _AXIS_BODIES[kind]
         body = functools.partial(raw, axes=group.axes, sizes=sizes, **kw)
@@ -365,6 +572,30 @@ def build_collective(kind: str, group: ProcessGroup, dtype, **kw) -> Callable:
     fn = jax.jit(sm)
     _cache[key] = fn
     return fn
+
+
+def _build_flat(body, topo, kind: str, tag) -> Callable:
+    """Compile a (n,) -> (out_n,) body over the flattened single-axis world mesh,
+    accepting/returning the standard (R, D, S, M, n) distributed buffer (the
+    reshape is layout-compatible: device p holds rank p's row in both)."""
+    w = topo.world_size
+    grid = topo.grid_shape
+
+    def local_fn(x):  # x: (1, n)
+        with jax.named_scope(f"mlsl_{kind}_{tag}"):
+            out = body(x.reshape(x.shape[1:]))
+        return out[None]
+
+    sm = _shard_map(
+        local_fn, mesh=topo.flat_mesh,
+        in_specs=P("world", None), out_specs=P("world", None),
+    )
+
+    def fn(buf):
+        out = sm(buf.reshape(w, buf.shape[-1]))
+        return out.reshape(*grid, out.shape[-1])
+
+    return jax.jit(fn)
 
 
 def build_stateful_collective(body, mesh) -> Callable:

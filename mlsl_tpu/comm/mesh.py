@@ -95,6 +95,20 @@ class Topology:
     def grid_shape(self) -> Tuple[int, int, int, int]:
         return (self.replica_count, self.data_parts, self.seq_parts, self.model_parts)
 
+    @property
+    def flat_mesh(self) -> Mesh:
+        """The same devices as a single-axis ("world",) mesh, in global-rank order.
+
+        Subgroup collectives (MPI_Comm_split-style color groups) compile against this
+        mesh so they can use XLA's native subgroup support (``axis_index_groups`` =
+        replica_groups in the lowered HLO) — a single named axis is required for
+        axis_index_groups. Sharding is compatible with the 4-axis mesh (device p holds
+        rank p's row either way), so the reshape between the two is layout-only.
+        """
+        if getattr(self, "_flat_mesh", None) is None:
+            self._flat_mesh = Mesh(self.mesh.devices.reshape(-1), ("world",))
+        return self._flat_mesh
+
     def buffer_sharding(self, extra_dims: int = 1) -> NamedSharding:
         """Sharding for a 'distributed buffer': global shape
         (replica, data, seq, model, *local_shape), one local payload per rank."""
@@ -112,6 +126,34 @@ class Topology:
             array.shape,
         )
         return jax.device_put(array, self.buffer_sharding(array.ndim - NUM_GRID_AXES))
+
+    def adopt_buffer(self, buf: jax.Array) -> jax.Array:
+        """Re-view a distributed buffer laid out for ANOTHER topology over the same
+        devices as this topology's (R, D, S, M, n) layout.
+
+        Cross-distribution graph edges hand one distribution's buffer to a
+        collective compiled for the other's mesh (redistribution cases 3-5,
+        reference src/mlsl_impl.cpp:187-226). Rank p's row lives on device p under
+        both layouts (global-rank-major flattening), so this is a device-local
+        relabeling: the jitted reshape with an explicit out_sharding compiles to a
+        no-transfer layout change.
+        """
+        grid = self.grid_shape
+        if buf.ndim == NUM_GRID_AXES + 1 and tuple(buf.shape[:NUM_GRID_AXES]) == grid:
+            return buf
+        mlsl_assert(
+            int(np.prod(buf.shape[:-1])) == self.world_size,
+            "buffer rank-rows %s do not match this topology's world size %d",
+            buf.shape[:-1], self.world_size,
+        )
+        if getattr(self, "_adopt_jit", None) is None:
+            import jax.numpy as jnp
+
+            self._adopt_jit = jax.jit(
+                lambda x: jnp.reshape(x, (*grid, x.shape[-1])),
+                out_shardings=self.buffer_sharding(1),
+            )
+        return self._adopt_jit(buf)
 
 
 @dataclasses.dataclass(frozen=True)
@@ -146,19 +188,31 @@ class ProcessGroup:
         return self.colors is None and len(self.axes) == 0
 
     @property
-    def size(self) -> int:
-        if self.colors is not None:
-            # All color groups must be the same size for SPMD collectives.
-            from collections import Counter
+    def group_sizes(self) -> Tuple[int, ...]:
+        """Per-color group sizes, ordered by ascending color (colors mode only)."""
+        mlsl_assert(self.colors is not None, "group_sizes requires colors mode")
+        from collections import Counter
 
-            counts = Counter(self.colors)
-            sizes = set(counts.values())
-            mlsl_assert(
-                len(sizes) == 1,
-                "color groups must be equal-sized for SPMD execution, got %s",
-                dict(counts),
-            )
-            return sizes.pop()
+        counts = Counter(self.colors)
+        return tuple(counts[c] for c in sorted(counts))
+
+    @property
+    def is_uniform(self) -> bool:
+        """True when every group has the same member count (axis-aligned groups
+        always are; color groups may be ragged, like MPI_Comm_split's)."""
+        if self.colors is None:
+            return True
+        return len(set(self.group_sizes)) == 1
+
+    @property
+    def size(self) -> int:
+        """Member count of the group — the max across groups when colors are
+        ragged (reference MPI_Comm_split permits unequal partitions,
+        src/comm_ep.cpp:1821-1827). SPMD buffers are uniform across ranks, so
+        size-dependent results (allgather/gather) on ragged groups are padded to
+        the max size; see collectives._make_ragged_body."""
+        if self.colors is not None:
+            return max(self.group_sizes)
         size = 1
         shape = dict(
             zip(self.topology.mesh.axis_names, self.topology.mesh.devices.shape)

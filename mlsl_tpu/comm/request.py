@@ -27,9 +27,9 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
-from mlsl_tpu.comm.mesh import ProcessGroup
+from mlsl_tpu.comm.mesh import NUM_GRID_AXES, ProcessGroup
 from mlsl_tpu.comm import collectives
-from mlsl_tpu.log import mlsl_assert, log_debug
+from mlsl_tpu.log import mlsl_assert, log_debug, log_error
 from mlsl_tpu.types import (
     CompressionType,
     DataType,
@@ -98,6 +98,8 @@ class CommRequest:
         self.is_started = False
         self.is_setup = False
         self._epoch = 0
+        self._dlock = threading.Lock()  # serializes dispatch vs restart
+        self._dispatch_error: Optional[BaseException] = None
         with CommRequest._seq_lock:
             CommRequest._seq += 1
             self.uid = CommRequest._seq
@@ -215,27 +217,50 @@ class CommRequest:
         chkp = checker.level()
         if chkp:
             checker.check_buffer(buf, self.desc, chkp)
-        self._epoch += 1
-        self._results = []
-        self._result = None
-        self._completed_via_test = False
-        self.is_started = True
+        # Bump the epoch under the dispatch lock: a stale dispatch of the
+        # PREVIOUS start's buffer racing on the progress thread either sees the
+        # new epoch and skips, or finishes writing _results before the reset
+        # below — never after it (the clobber the supersede logic exists for).
+        with self._dlock:
+            self._epoch += 1
+            self._results = []
+            self._result = None
+            self._completed_via_test = False
+            self._dispatch_error = None
+            self.is_started = True
         self.dispatcher.submit(self, buf)
         return self
 
-    def _dispatch(self, buf: jax.Array) -> None:
+    def _dispatch(self, buf: jax.Array, epoch: Optional[int] = None) -> None:
         """Actually launch the XLA programs (called by the Dispatcher).
+
+        ``epoch`` is the request epoch captured when the dispatch was queued; a
+        mismatch means a later start() superseded this entry while it sat in the
+        queue (or mid-flight on the progress thread) — drop it.
 
         The TraceAnnotation marks the host-side enqueue (request identity and
         dispatch ordering); the device-side span carries the collective's identity
         via the jax.named_scope baked into the compiled program
         (collectives.build_collective)."""
-        with jax.profiler.TraceAnnotation(
-            f"mlsl:{self.desc.kind}:{self.name or self.uid}"
-        ):
-            self._dispatch_inner(buf)
+        with self._dlock:
+            if epoch is not None and epoch != self._epoch:
+                log_debug("dropping superseded dispatch of %s", self.name or self.uid)
+                return
+            with jax.profiler.TraceAnnotation(
+                f"mlsl:{self.desc.kind}:{self.name or self.uid}"
+            ):
+                self._dispatch_inner(buf)
 
     def _dispatch_inner(self, buf: jax.Array) -> None:
+        # Cross-distribution edges (redistribution cases 3-5) hand a buffer laid
+        # out for the OTHER distribution's grid; re-view it onto this request's
+        # group topology (device-local, no transfer — see Topology.adopt_buffer).
+        topo0 = self.desc.group.topology
+        if hasattr(buf, "ndim") and (
+            buf.ndim != NUM_GRID_AXES + 1
+            or tuple(buf.shape[:NUM_GRID_AXES]) != topo0.grid_shape
+        ):
+            buf = topo0.adopt_buffer(buf)
         if self._quant_fn is not None or self._quant_fns is not None:
             topo = self.desc.group.topology
             if self._quant_fns is not None:
@@ -274,13 +299,17 @@ class CommRequest:
         return self._result
 
     def wait(self) -> jax.Array:
-        # A request completed by test() can still be wait()ed (MPI semantics:
-        # MPI_Wait on a completed request returns immediately).
-        if not self.is_started and self._completed_via_test:
-            self._completed_via_test = False
+        # A completed request can be wait()ed any number of times, whether it
+        # completed via wait() or test() (MPI semantics: MPI_Wait on a completed
+        # request returns immediately).
+        if not self.is_started and self._result is not None:
             return self._result
         mlsl_assert(self.is_started, "request was not started")
-        self.dispatcher.flush()
+        self.dispatcher.wait_dispatched(self)
+        if self._dispatch_error is not None:
+            err, self._dispatch_error = self._dispatch_error, None
+            self.is_started = False
+            raise err
         out = self._assemble()
         jax.block_until_ready(out)
         self.is_started = False
@@ -291,6 +320,14 @@ class CommRequest:
         if not self.is_started:
             return True, self._result
         self.dispatcher.flush()
+        if self._dispatch_error is not None:
+            err, self._dispatch_error = self._dispatch_error, None
+            self.is_started = False
+            raise err
+        # A dispatch racing on the progress thread builds _results incrementally;
+        # check in-flight FIRST — once it clears, _results is fully built.
+        if self.dispatcher.is_in_flight(self.uid) or not self._results:
+            return False, None
         ready = all(_array_is_ready(r) for r in self._results)
         if ready:
             out = self._assemble()
@@ -358,6 +395,14 @@ class Dispatcher:
     the newest large allreduce first (eplib/cqueue.c:1999-2012 routing to
     allreduce_pr.c LIFO). Here the queue is a host-side stack of not-yet-launched
     requests; flush() launches them LIFO. Small messages bypass the stack entirely.
+
+    Progress is autonomous, as in the reference (eplib's servers drive the network
+    without the app thread, eplib/allreduce_pr.c:69-278): a daemon thread flushes
+    deferred requests after a short coalescing window
+    (config.msg_priority_flush_ms), so a large deferred allreduce makes progress
+    even if the app never calls wait()/test(). The window is what preserves
+    newest-first ordering for back-to-back starts: requests deferred within it are
+    launched together, LIFO.
     """
 
     def __init__(self, config):
@@ -365,6 +410,11 @@ class Dispatcher:
         self._pending: List[tuple] = []  # stack of (request, buf)
         self._by_id: dict = {}           # req uid -> (request, buf), native path
         self._lock = threading.Lock()
+        self._cv = threading.Condition(self._lock)
+        self._in_flight: set = set()     # uids popped from the queue, dispatch running
+        self._thread: Optional[threading.Thread] = None
+        self._deadline = 0.0
+        self._stopped = False
         self._native = None
         self._native_tried = False
 
@@ -391,6 +441,10 @@ class Dispatcher:
     def submit(self, req: CommRequest, buf: jax.Array) -> None:
         cfg = self.config
         if not cfg.msg_priority or req.desc.kind == "barrier":
+            if req.desc.kind == "barrier":
+                # A barrier orders everything before it: launch any deferred
+                # requests first so they are on the wire when the barrier lands.
+                self.flush()
             req._dispatch(buf)
             return
         native = None
@@ -400,7 +454,8 @@ class Dispatcher:
             if native is not None:
                 immediate = native.submit(req.uid, req.desc.payload_bytes())
                 if not immediate:
-                    self._by_id[req.uid] = (req, buf)
+                    self._by_id[req.uid] = (req, buf, req._epoch)
+                    self._note_deferred_locked()
         if native is not None:
             if immediate:
                 req._dispatch(buf)  # outside the lock: may trigger compilation
@@ -413,28 +468,103 @@ class Dispatcher:
             with self._lock:
                 # A restart of an already-deferred request supersedes the stale entry
                 # (otherwise flush would re-dispatch the old buffer last and clobber
-                # the fresh results).
-                self._pending = [(r, b) for r, b in self._pending if r is not req]
-                self._pending.append((req, buf))
+                # the fresh results). An entry already popped mid-flight is dropped
+                # by the epoch check in _dispatch.
+                self._pending = [e for e in self._pending if e[0] is not req]
+                self._pending.append((req, buf, req._epoch))
+                self._note_deferred_locked()
             log_debug("deferred request %s (%d B)", req.name, req.desc.payload_bytes())
         else:
             req._dispatch(buf)
+
+    def _note_deferred_locked(self) -> None:
+        """Arm the progress thread: dispatch happens msg_priority_flush_ms from the
+        LAST deferral (coalescing window), with no app poll required."""
+        import time
+
+        self._deadline = time.monotonic() + self.config.msg_priority_flush_ms / 1e3
+        if self._thread is None:
+            self._thread = threading.Thread(
+                target=self._progress_loop, daemon=True, name="mlsl-dispatch"
+            )
+            self._thread.start()
+        self._cv.notify_all()
+
+    def _progress_loop(self) -> None:
+        import time
+
+        while True:
+            with self._cv:
+                while not self._stopped and not (self._pending or self._by_id):
+                    self._cv.wait()
+                if self._stopped:
+                    return
+                deadline = self._deadline
+            delay = deadline - time.monotonic()
+            if delay > 0:
+                time.sleep(min(delay, 0.05))
+                continue
+            try:
+                self.flush()
+            except Exception as e:  # pragma: no cover - defensive: keep daemon alive
+                log_error("background flush failed: %r", e)
+
+    def shutdown(self) -> None:
+        """Launch anything still deferred and stop the progress thread."""
+        self.flush()
+        with self._cv:
+            self._stopped = True
+            self._cv.notify_all()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+            self._thread = None
 
     def flush(self) -> None:
         if self._native is not None:
             with self._lock:
                 order = self._native.drain()
                 items = [self._by_id.pop(rid) for rid in order if rid in self._by_id]
-            for req, buf in items:
-                req._dispatch(buf)
+                self._in_flight.update(e[0].uid for e in items)
+            self._dispatch_items(items)
             return
         with self._lock:
             pending, self._pending = self._pending, []
-        if not pending:
+            items = list(reversed(pending)) if self.config.msg_priority_mode else pending
+            self._in_flight.update(e[0].uid for e in items)
+        self._dispatch_items(items)
+
+    def _dispatch_items(self, items) -> None:
+        """Launch outside the lock (may compile); then release waiters.
+
+        A dispatch failure is recorded on ITS request (re-raised by that
+        request's wait()/test()) and must not strand the remaining items of the
+        batch or, on the progress thread, kill the daemon."""
+        if not items:
             return
-        order = reversed(pending) if self.config.msg_priority_mode else iter(pending)
-        for req, buf in order:
-            req._dispatch(buf)
+        try:
+            for req, buf, epoch in items:
+                try:
+                    req._dispatch(buf, epoch)
+                except Exception as e:
+                    req._dispatch_error = e
+        finally:
+            with self._cv:
+                for req, _, _ in items:
+                    self._in_flight.discard(req.uid)
+                self._cv.notify_all()
+
+    def is_in_flight(self, uid: int) -> bool:
+        with self._lock:
+            return uid in self._in_flight
+
+    def wait_dispatched(self, req: CommRequest) -> None:
+        """Ensure req's programs have been launched: flush the queue, then wait out
+        a dispatch racing on the progress thread (its _results would otherwise be
+        read half-built)."""
+        self.flush()
+        with self._cv:
+            while req.uid in self._in_flight:
+                self._cv.wait()
 
     @property
     def pending_count(self) -> int:

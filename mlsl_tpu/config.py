@@ -56,6 +56,11 @@ class Config:
     msg_priority: bool = False        # MLSL_MSG_PRIORITY: newest-first dispatch
     msg_priority_threshold: int = 10000  # MLSL_MSG_PRIORITY_THRESHOLD (bytes)
     msg_priority_mode: bool = True    # MLSL_MSG_PRIORITY_MODE: 1 = LIFO
+    # Coalescing window before the progress thread launches deferred requests on
+    # its own (reference: endpoint servers progress without app polls,
+    # eplib/allreduce_pr.c:69-278). Requests deferred within the window are
+    # launched together, newest first.
+    msg_priority_flush_ms: float = 2.0  # MLSL_MSG_PRIORITY_FLUSH_MS
 
     # --- compression ---
     quant_block_elems: int = 256
@@ -83,6 +88,9 @@ class Config:
             "MLSL_MSG_PRIORITY_THRESHOLD", c.msg_priority_threshold
         )
         c.msg_priority_mode = _env_bool("MLSL_MSG_PRIORITY_MODE", c.msg_priority_mode)
+        c.msg_priority_flush_ms = _env_float(
+            "MLSL_MSG_PRIORITY_FLUSH_MS", c.msg_priority_flush_ms
+        )
         c.quant_block_elems = _env_int("MLSL_QUANT_BLOCK_ELEMS", c.quant_block_elems)
         c.topk_ratio = _env_float("MLSL_TOPK_RATIO", c.topk_ratio)
         c.server_affinity = os.environ.get("MLSL_SERVER_AFFINITY", c.server_affinity)

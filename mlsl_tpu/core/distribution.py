@@ -63,14 +63,19 @@ class Distribution:
             )
             from collections import Counter
 
-            d_sizes = set(Counter(data_colors).values())
-            m_sizes = set(Counter(model_colors).values())
-            mlsl_assert(
-                len(d_sizes) == 1 and len(m_sizes) == 1,
-                "color groups must be equal-sized",
+            # Unequal partitions are allowed, as with MPI_Comm_split (reference
+            # src/comm_ep.cpp:1821-1827): parts are the MAX group size, and
+            # size-dependent results on smaller groups are zero-padded to it
+            # (see comm/collectives._make_ragged_body for which kinds support it).
+            # Ragged distributions carry collectives only — the operation graph's
+            # minibatch partitioning needs uniform group sizes (see
+            # Session.add_operation).
+            self.data_parts = max(Counter(data_colors).values())
+            self.model_parts = max(Counter(model_colors).values())
+            self.is_ragged = (
+                len(set(Counter(data_colors).values())) > 1
+                or len(set(Counter(model_colors).values())) > 1
             )
-            self.data_parts = d_sizes.pop()
-            self.model_parts = m_sizes.pop()
             self.seq_parts = 1
             # The mesh is flat (N, 1, 1, 1); groups are pure color partitions.
             self.topology = Topology(1, 1, devices=devices)

@@ -110,6 +110,8 @@ class Environment:
         # (reference initPid guard, src/mlsl.cpp:720-724).
         if not self._initialized or os.getpid() != self._init_pid:
             return
+        if self.dispatcher is not None:
+            self.dispatcher.shutdown()
         for s in self._sessions:
             s._invalidate()
         self._sessions.clear()

@@ -113,6 +113,12 @@ class Operation:
         mlsl_assert(
             self.distribution is None, "distribution can be set only once"
         )
+        mlsl_assert(
+            not getattr(distribution, "is_ragged", False),
+            "operations require equal-sized color groups: the minibatch/kernel "
+            "partitioning assumes a uniform group size (ragged partitions "
+            "support Distribution collectives only)",
+        )
         self.distribution = distribution
         reg = self._reg
 
@@ -252,6 +258,12 @@ class Session:
         AddOperation(regInfo, NULL)) and bound later with
         Operation.set_distribution — it must be bound before Commit."""
         mlsl_assert(self.global_minibatch_size > 0, "set global minibatch size first")
+        mlsl_assert(
+            distribution is None or not getattr(distribution, "is_ragged", False),
+            "operations require equal-sized color groups: the minibatch/kernel "
+            "partitioning assumes a uniform group size (ragged partitions "
+            "support Distribution collectives only)",
+        )
         op = Operation(reg, self, distribution, len(self.operations))
         self.operations.append(op)
         return len(self.operations) - 1

@@ -21,6 +21,8 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
+from mlsl_tpu.log import mlsl_assert
+
 
 def init_moe_params(key, d_model: int, d_ff: int, n_experts: int, std=0.02) -> Dict:
     k1, k2, k3 = jax.random.split(key, 3)
@@ -103,6 +105,11 @@ def moe_ffn(
     if ep == 1:
         return _moe_slice(x, params, n_experts, capacity_factor, top_k)
 
+    mlsl_assert(
+        t % ep == 0,
+        "moe_ffn: token count %d not divisible by ep=%d (trailing tokens would be "
+        "silently dropped)", t, ep,
+    )
     me = lax.axis_index(axis)
     tl = t // ep
     xs = lax.dynamic_slice_in_dim(x, me * tl, tl, axis=0)         # (Tl, D) distinct

@@ -76,9 +76,22 @@ int64_t call_i(const char* name, std::initializer_list<int64_t> args,
   PyGILState_STATE gil = PyGILState_Ensure();
   int64_t result = fail;
   PyObject* tuple = PyTuple_New(static_cast<Py_ssize_t>(args.size()));
+  if (tuple == nullptr) {
+    record_error_locked_gil();
+    PyGILState_Release(gil);
+    return fail;
+  }
   Py_ssize_t i = 0;
-  for (int64_t a : args)
-    PyTuple_SET_ITEM(tuple, i++, PyLong_FromLongLong(a));
+  for (int64_t a : args) {
+    PyObject* item = PyLong_FromLongLong(a);
+    if (item == nullptr) {
+      record_error_locked_gil();
+      Py_DECREF(tuple);
+      PyGILState_Release(gil);
+      return fail;
+    }
+    PyTuple_SET_ITEM(tuple, i++, item);
+  }
   PyObject* fn = PyObject_GetAttrString(g_shim, name);
   if (fn != nullptr) {
     PyObject* res = PyObject_CallObject(fn, tuple);
@@ -294,6 +307,204 @@ int64_t mlsl_operation_get_parameter_local_count(mlsl_handle_t op,
 int64_t mlsl_operation_get_parameter_owned_count(mlsl_handle_t op,
                                                  int64_t idx) {
   return call_i("operation_param_owned_count", {(int64_t)op, idx});
+}
+
+mlsl_handle_t mlsl_distribution_all_gatherv(mlsl_handle_t dist,
+                                            const void* send,
+                                            int64_t send_count,
+                                            const int64_t* recv_counts,
+                                            mlsl_data_type_t dt,
+                                            mlsl_group_type_t group) {
+  return (mlsl_handle_t)call_i(
+      "dist_all_gatherv",
+      {(int64_t)dist, (int64_t)(intptr_t)send, send_count,
+       (int64_t)(intptr_t)recv_counts, (int64_t)dt, (int64_t)group},
+      0);
+}
+
+mlsl_handle_t mlsl_distribution_all_to_allv(mlsl_handle_t dist,
+                                            const void* send, int64_t send_len,
+                                            const int64_t* send_counts,
+                                            const int64_t* send_offsets,
+                                            const int64_t* recv_offsets,
+                                            mlsl_data_type_t dt,
+                                            mlsl_group_type_t group) {
+  return (mlsl_handle_t)call_i(
+      "dist_all_to_allv",
+      {(int64_t)dist, (int64_t)(intptr_t)send, send_len,
+       (int64_t)(intptr_t)send_counts, (int64_t)(intptr_t)send_offsets,
+       (int64_t)(intptr_t)recv_offsets, (int64_t)dt, (int64_t)group},
+      0);
+}
+
+int64_t mlsl_operation_get_input_count(mlsl_handle_t op) {
+  return call_i("operation_input_count", {(int64_t)op});
+}
+
+int64_t mlsl_operation_get_output_count(mlsl_handle_t op) {
+  return call_i("operation_output_count", {(int64_t)op});
+}
+
+mlsl_handle_t mlsl_operation_get_input(mlsl_handle_t op, int64_t idx) {
+  return (mlsl_handle_t)call_i("operation_get_input", {(int64_t)op, idx}, 0);
+}
+
+mlsl_handle_t mlsl_operation_get_output(mlsl_handle_t op, int64_t idx) {
+  return (mlsl_handle_t)call_i("operation_get_output", {(int64_t)op, idx}, 0);
+}
+
+int64_t mlsl_activation_get_global_fm_count(mlsl_handle_t act) {
+  return call_i("activation_query", {(int64_t)act, 0});
+}
+
+int64_t mlsl_activation_get_local_fm_count(mlsl_handle_t act) {
+  return call_i("activation_query", {(int64_t)act, 1});
+}
+
+int64_t mlsl_activation_get_fm_size(mlsl_handle_t act) {
+  return call_i("activation_query", {(int64_t)act, 2});
+}
+
+int mlsl_activation_needs_comm(mlsl_handle_t act) {
+  return (int)call_i("activation_query", {(int64_t)act, 6});
+}
+
+int64_t mlsl_activation_get_wire_count(mlsl_handle_t act) {
+  return call_i("activation_query", {(int64_t)act, 7});
+}
+
+int64_t mlsl_activation_get_pack_block_count(mlsl_handle_t act) {
+  return call_i("activation_query", {(int64_t)act, 3});
+}
+
+int64_t mlsl_activation_get_unpack_block_count(mlsl_handle_t act) {
+  return call_i("activation_query", {(int64_t)act, 4});
+}
+
+int64_t mlsl_activation_get_pack_block(mlsl_handle_t act, int64_t idx,
+                                       int field) {
+  return call_i("activation_block_query", {(int64_t)act, 0, idx, (int64_t)field});
+}
+
+int64_t mlsl_activation_get_unpack_block(mlsl_handle_t act, int64_t idx,
+                                         int field) {
+  return call_i("activation_block_query", {(int64_t)act, 1, idx, (int64_t)field});
+}
+
+int mlsl_activation_start_comm(mlsl_handle_t act, const void* buf,
+                               mlsl_data_type_t dt) {
+  return (int)call_i("activation_start_comm",
+                     {(int64_t)act, (int64_t)(intptr_t)buf, (int64_t)dt});
+}
+
+int64_t mlsl_activation_wait_comm(mlsl_handle_t act, void* recv,
+                                  mlsl_data_type_t dt) {
+  return call_i("activation_wait_comm",
+                {(int64_t)act, (int64_t)(intptr_t)recv, (int64_t)dt});
+}
+
+int mlsl_parameter_set_test_gradient_comm(mlsl_handle_t op, int64_t ps_idx) {
+  return (int)call_i("param_test_gradient_comm", {(int64_t)op, ps_idx});
+}
+
+int mlsl_parameter_set_start_increment_comm(mlsl_handle_t op, int64_t ps_idx,
+                                            const void* incs,
+                                            mlsl_data_type_t dt) {
+  return (int)call_i(
+      "param_start_increment_comm",
+      {(int64_t)op, ps_idx, (int64_t)(intptr_t)incs, (int64_t)dt});
+}
+
+int64_t mlsl_parameter_set_wait_increment_comm(mlsl_handle_t op, int64_t ps_idx,
+                                               void* recv,
+                                               mlsl_data_type_t dt) {
+  return call_i("param_wait_increment_comm",
+                {(int64_t)op, ps_idx, (int64_t)(intptr_t)recv, (int64_t)dt});
+}
+
+int64_t mlsl_parameter_set_get_global_kernel_count(mlsl_handle_t op,
+                                                   int64_t ps_idx) {
+  return call_i("param_query", {(int64_t)op, ps_idx, 0});
+}
+
+int64_t mlsl_parameter_set_get_local_kernel_count(mlsl_handle_t op,
+                                                  int64_t ps_idx) {
+  return call_i("param_query", {(int64_t)op, ps_idx, 1});
+}
+
+int64_t mlsl_parameter_set_get_owned_kernel_count(mlsl_handle_t op,
+                                                  int64_t ps_idx) {
+  return call_i("param_query", {(int64_t)op, ps_idx, 2});
+}
+
+int64_t mlsl_parameter_set_get_kernel_size(mlsl_handle_t op, int64_t ps_idx) {
+  return call_i("param_query", {(int64_t)op, ps_idx, 3});
+}
+
+int mlsl_parameter_set_is_distributed_update(mlsl_handle_t op, int64_t ps_idx) {
+  return (int)call_i("param_query", {(int64_t)op, ps_idx, 4});
+}
+
+mlsl_handle_t mlsl_session_get_stats(mlsl_handle_t sess) {
+  return (mlsl_handle_t)call_i("session_get_stats", {(int64_t)sess}, 0);
+}
+
+int mlsl_statistics_start(mlsl_handle_t stats) {
+  return (int)call_i("stats_control", {(int64_t)stats, 0});
+}
+
+int mlsl_statistics_stop(mlsl_handle_t stats) {
+  return (int)call_i("stats_control", {(int64_t)stats, 1});
+}
+
+int mlsl_statistics_reset(mlsl_handle_t stats) {
+  return (int)call_i("stats_control", {(int64_t)stats, 2});
+}
+
+int mlsl_statistics_is_enabled(mlsl_handle_t stats) {
+  return (int)call_i("stats_control", {(int64_t)stats, 3});
+}
+
+int mlsl_statistics_is_started(mlsl_handle_t stats) {
+  return (int)call_i("stats_control", {(int64_t)stats, 4});
+}
+
+int64_t mlsl_statistics_get_comm_size(mlsl_handle_t stats, int64_t op_idx) {
+  return call_i("stats_query", {(int64_t)stats, 0, op_idx});
+}
+
+int64_t mlsl_statistics_get_comm_cycles(mlsl_handle_t stats, int64_t op_idx) {
+  return call_i("stats_query", {(int64_t)stats, 1, op_idx});
+}
+
+int64_t mlsl_statistics_get_compute_cycles(mlsl_handle_t stats,
+                                           int64_t op_idx) {
+  return call_i("stats_query", {(int64_t)stats, 2, op_idx});
+}
+
+int64_t mlsl_statistics_get_isolation_comm_cycles(mlsl_handle_t stats,
+                                                  int64_t op_idx) {
+  return call_i("stats_query", {(int64_t)stats, 3, op_idx});
+}
+
+int64_t mlsl_statistics_get_total_comm_size(mlsl_handle_t stats) {
+  return call_i("stats_query", {(int64_t)stats, 0, -1});
+}
+
+int64_t mlsl_statistics_get_total_comm_cycles(mlsl_handle_t stats) {
+  return call_i("stats_query", {(int64_t)stats, 1, -1});
+}
+
+int64_t mlsl_statistics_get_total_compute_cycles(mlsl_handle_t stats) {
+  return call_i("stats_query", {(int64_t)stats, 2, -1});
+}
+
+int64_t mlsl_statistics_get_total_isolation_comm_cycles(mlsl_handle_t stats) {
+  return call_i("stats_query", {(int64_t)stats, 3, -1});
+}
+
+int mlsl_statistics_print(mlsl_handle_t stats) {
+  return (int)call_i("stats_print", {(int64_t)stats});
 }
 
 int mlsl_parameter_set_start_gradient_comm(mlsl_handle_t op, int64_t ps_idx,

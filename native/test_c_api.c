@@ -135,6 +135,170 @@ int main(void) {
     free(pairs);
   }
 
+  /* v-collectives: AllGatherv + AlltoAllv (reference mlsl.hpp:418-471) */
+  if (world > 1) {
+    int64_t* vcounts = malloc(sizeof(int64_t) * world);
+    int64_t vtotal = 0;
+    for (int64_t i = 0; i < world; ++i) { vcounts[i] = i % 3 + 1; vtotal += vcounts[i]; }
+    mlsl_handle_t agv = mlsl_distribution_all_gatherv(
+        dist, send, n, vcounts, MLSL_DT_FLOAT, MLSL_GT_DATA);
+    float* vout = malloc(sizeof(float) * world * vtotal);
+    CHECK(agv != 0, "allgatherv start");
+    CHECK(mlsl_request_wait(agv, vout, vtotal, MLSL_DT_FLOAT) == 0,
+          "allgatherv wait");
+    /* rank0's view: first vcounts[0] elems are rank0's (=1.0), next vcounts[1]
+     * are rank1's (=2.0) */
+    CHECK(vout[0] == 1.0f && vout[vcounts[0]] == 2.0f, "allgatherv layout");
+
+    int64_t* a2acnt = malloc(sizeof(int64_t) * world);
+    for (int64_t i = 0; i < world; ++i) a2acnt[i] = 2;  /* 2 elems to each */
+    mlsl_handle_t a2av = mlsl_distribution_all_to_allv(
+        dist, send, 2 * world, a2acnt, NULL, NULL, MLSL_DT_FLOAT, MLSL_GT_DATA);
+    float* a2aout = malloc(sizeof(float) * world * 2 * world);
+    CHECK(a2av != 0 &&
+              mlsl_request_wait(a2av, a2aout, 2 * world, MLSL_DT_FLOAT) == 0,
+          "alltoallv");
+    /* rank0 receives 2 elems from each rank q, value q+1 */
+    for (int64_t q = 0; q < world; ++q)
+      CHECK(a2aout[2 * q] == (float)(q + 1), "alltoallv value");
+    printf("allgatherv/alltoallv OK\n");
+    free(vcounts); free(vout); free(a2acnt); free(a2aout);
+  }
+
+  /* ---- model-parallel training through the activation API: the reference
+   * cmlsl_test flow (pack via queried blocks -> StartComm -> peer WaitComm ->
+   * unpack; case-1 ReduceScatter fwd / AllGather bwd) ---- */
+  if (world >= 4 && world % 2 == 0) {
+    const int64_t MP = 2, DP = world / 2, FM = 8, FMS = 4;
+    mlsl_handle_t dmp = mlsl_environment_create_distribution(DP, MP, 1);
+    CHECK(dmp != 0, "create mp distribution");
+    mlsl_handle_t s2 = mlsl_environment_create_session();
+    CHECK(mlsl_session_set_global_minibatch_size(s2, 4 * DP) == 0, "mp mb");
+    mlsl_handle_t rga = mlsl_session_create_operation_reg_info(s2, MLSL_OT_CC);
+    mlsl_operation_reg_info_add_input(rga, FM, FMS, MLSL_DT_FLOAT);
+    mlsl_operation_reg_info_add_output(rga, FM, FMS, MLSL_DT_FLOAT);
+    mlsl_operation_reg_info_add_parameter_set(rga, FM * FM, 1, MLSL_DT_FLOAT, 0,
+                                              MLSL_CT_NONE);
+    mlsl_handle_t opa = mlsl_session_add_operation(s2, rga, dmp);
+    mlsl_handle_t rgb = mlsl_session_create_operation_reg_info(s2, MLSL_OT_CC);
+    mlsl_operation_reg_info_add_input(rgb, FM, FMS, MLSL_DT_FLOAT);
+    mlsl_operation_reg_info_add_output(rgb, FM, FMS, MLSL_DT_FLOAT);
+    mlsl_operation_reg_info_add_parameter_set(rgb, FM * FM, 1, MLSL_DT_FLOAT, 1,
+                                              MLSL_CT_NONE);
+    mlsl_handle_t opb = mlsl_session_add_operation(s2, rgb, dmp);
+    CHECK(mlsl_operation_set_next(opa, opb, 0, 0) == 0, "mp wire");
+    CHECK(mlsl_session_commit(s2) == 0, "mp commit");
+
+    mlsl_handle_t oact = mlsl_operation_get_output(opa, 0);
+    mlsl_handle_t iact = mlsl_operation_get_input(opb, 0);
+    CHECK(oact != 0 && iact != 0, "activation handles");
+    CHECK(mlsl_activation_needs_comm(oact) == 1, "out needs comm");
+    CHECK(mlsl_activation_get_global_fm_count(oact) == FM, "fm count");
+    CHECK(mlsl_activation_get_local_fm_count(iact) == FM / MP, "in local fm");
+    int64_t lmb = mlsl_operation_get_local_minibatch_size(opa);
+    CHECK(lmb == 4, "mp local minibatch");
+    int64_t wire = mlsl_activation_get_wire_count(oact);
+    CHECK(wire == lmb * FM * FMS, "wire count");
+
+    /* forward: every rank's activation act[mb][fm][sp] = rank*1000 + linear;
+     * pack through the QUERIED CommBlockInfo blocks, exactly like the
+     * reference's PackBuffer (mlsl_test.cpp:214-233) */
+    int64_t nblk = mlsl_activation_get_pack_block_count(oact);
+    CHECK(nblk == MP, "pack block count");
+    float* wires = malloc(sizeof(float) * world * wire);
+    for (int64_t p = 0; p < world; ++p) {
+      for (int64_t b = 0; b < nblk; ++b) {
+        int64_t mbo = mlsl_activation_get_pack_block(oact, b, 0);
+        int64_t mbc = mlsl_activation_get_pack_block(oact, b, 1);
+        int64_t fmo = mlsl_activation_get_pack_block(oact, b, 2);
+        int64_t fmc = mlsl_activation_get_pack_block(oact, b, 3);
+        int64_t fms = mlsl_activation_get_pack_block(oact, b, 4);
+        int64_t off = mlsl_activation_get_pack_block(oact, b, 5);
+        int64_t k = 0;
+        for (int64_t mb = mbo; mb < mbo + mbc; ++mb)
+          for (int64_t fm = fmo; fm < fmo + fmc; ++fm)
+            for (int64_t sp = 0; sp < fms; ++sp, ++k)
+              wires[p * wire + off + k] =
+                  (float)(p * 1000 + (mb * FM + fm) * FMS + sp);
+      }
+    }
+    CHECK(mlsl_activation_start_comm(oact, wires, MLSL_DT_FLOAT) == 0,
+          "activation start comm");
+    float* arecv = malloc(sizeof(float) * world * wire);
+    int64_t rc = mlsl_activation_wait_comm(iact, arecv, MLSL_DT_FLOAT);
+    CHECK(rc == wire / MP, "fwd recv count");
+    /* oracle: model group of p = {g0, g0+1}, g0 = (p/MP)*MP (model minor);
+     * ReduceScatter hands member m slice m of the group sum */
+    for (int64_t p = 0; p < world; ++p) {
+      int64_t g0 = (p / MP) * MP, m = p % MP;
+      for (int64_t i = 0; i < rc; ++i) {
+        float want = 0;
+        for (int64_t j = 0; j < MP; ++j)
+          want += wires[(g0 + j) * wire + m * rc + i];
+        CHECK(arecv[p * rc + i] == want, "fwd activation value");
+      }
+    }
+    printf("activation fwd ReduceScatter OK\n");
+
+    /* backward: input-activation grads AllGather back to the output side */
+    float* bsend = malloc(sizeof(float) * world * rc);
+    for (int64_t p = 0; p < world; ++p)
+      for (int64_t i = 0; i < rc; ++i)
+        bsend[p * rc + i] = (float)(p * 100 + i);
+    CHECK(mlsl_activation_start_comm(iact, bsend, MLSL_DT_FLOAT) == 0,
+          "bwd start");
+    float* brecv = malloc(sizeof(float) * world * wire);
+    int64_t brc = mlsl_activation_wait_comm(oact, brecv, MLSL_DT_FLOAT);
+    CHECK(brc == wire, "bwd recv count");
+    for (int64_t p = 0; p < world; ++p) {
+      int64_t g0 = (p / MP) * MP;
+      for (int64_t j = 0; j < MP; ++j)
+        for (int64_t i = 0; i < rc; ++i)
+          CHECK(brecv[p * wire + j * rc + i] == bsend[(g0 + j) * rc + i],
+                "bwd activation value");
+    }
+    printf("activation bwd AllGather OK\n");
+
+    /* distributed-update increments: ReduceScatter'd grads were checked above;
+     * here the owned-shard AllGather (reference mlsl.hpp:318-331) */
+    int64_t owned = mlsl_parameter_set_get_owned_kernel_count(opb, 0) *
+                    mlsl_parameter_set_get_kernel_size(opb, 0);
+    int64_t local = mlsl_parameter_set_get_local_kernel_count(opb, 0) *
+                    mlsl_parameter_set_get_kernel_size(opb, 0);
+    CHECK(mlsl_parameter_set_is_distributed_update(opb, 0) == 1, "du flag");
+    float* incs = malloc(sizeof(float) * world * owned);
+    for (int64_t p = 0; p < world; ++p)
+      for (int64_t i = 0; i < owned; ++i) incs[p * owned + i] = (float)(p + 1);
+    CHECK(mlsl_parameter_set_start_increment_comm(opb, 0, incs, MLSL_DT_FLOAT)
+              == 0, "inc start");
+    float* irecv = malloc(sizeof(float) * world * local);
+    int64_t inc_n = mlsl_parameter_set_wait_increment_comm(opb, 0, irecv,
+                                                           MLSL_DT_FLOAT);
+    CHECK(inc_n == local, "inc recv count");
+    /* grad group = data axis (model minor layout): member j of p's data group
+     * is world rank j*MP + (p%MP) */
+    for (int64_t p = 0; p < world; ++p)
+      for (int64_t j = 0; j < DP; ++j)
+        CHECK(irecv[p * local + j * owned] == (float)(j * MP + p % MP + 1),
+              "inc value");
+    printf("distributed-update increment AllGather OK\n");
+
+    /* statistics queries (reference mlsl.hpp:651-726) */
+    mlsl_handle_t st = mlsl_session_get_stats(s2);
+    CHECK(st != 0, "stats handle");
+    if (mlsl_statistics_is_enabled(st) == 1) {
+      CHECK(mlsl_statistics_get_total_comm_size(st) > 0, "stats bytes");
+      CHECK(mlsl_statistics_get_total_comm_cycles(st) >= 0, "stats cycles");
+      CHECK(mlsl_statistics_get_comm_size(st, 0) +
+                mlsl_statistics_get_comm_size(st, 1) ==
+            mlsl_statistics_get_total_comm_size(st), "stats per-op sum");
+      CHECK(mlsl_statistics_print(st) == 0, "stats print");
+      printf("statistics queries OK (bytes=%lld)\n",
+             (long long)mlsl_statistics_get_total_comm_size(st));
+    }
+    free(wires); free(arecv); free(bsend); free(brecv); free(incs); free(irecv);
+  }
+
   CHECK(mlsl_distribution_barrier(dist, MLSL_GT_GLOBAL) == 0, "barrier");
   CHECK(mlsl_environment_finalize() == 0, "finalize");
   printf("C API TEST PASSED\n");

@@ -46,6 +46,82 @@ int main() {
       std::fprintf(stderr, "FAILED: grad sync\n");
       return 1;
     }
+    /* model-parallel edge through the Activation/ParameterSet/Statistics
+     * classes (reference mlsl.hpp:210-341,651-726) */
+    if (world >= 4 && world % 2 == 0) {
+      const int64_t MP = 2, DP = world / 2, FM = 8, FMS = 4;
+      Distribution dmp(DP, MP);
+      Session s2;
+      s2.SetGlobalMinibatchSize(4 * DP);
+      OperationRegInfo ra = s2.CreateOperationRegInfo(MLSL_OT_CC);
+      ra.AddInput(FM, FMS, MLSL_DT_FLOAT);
+      ra.AddOutput(FM, FMS, MLSL_DT_FLOAT);
+      ra.AddParameterSet(FM * FM, 1, MLSL_DT_FLOAT, /*dist_update=*/true);
+      Operation oa = s2.AddOperation(ra, dmp);
+      OperationRegInfo rb = s2.CreateOperationRegInfo(MLSL_OT_CC);
+      rb.AddInput(FM, FMS, MLSL_DT_FLOAT);
+      rb.AddOutput(FM, FMS, MLSL_DT_FLOAT);
+      rb.AddParameterSet(FM * FM, 1, MLSL_DT_FLOAT);
+      Operation ob = s2.AddOperation(rb, dmp);
+      oa.SetNext(ob, 0, 0);
+      s2.Commit();
+
+      Activation out = oa.GetOutput(0);
+      Activation in = ob.GetInput(0);
+      if (!out.NeedsComm() || out.GetPackBlockCount() != MP) {
+        std::fprintf(stderr, "FAILED: activation metadata\n");
+        return 1;
+      }
+      const int64_t wire = out.GetWireCount();
+      std::vector<float> wires(world * wire);
+      for (int64_t p = 0; p < world; ++p)
+        for (int64_t b = 0; b < out.GetPackBlockCount(); ++b) {
+          CommBlockInfo bi = out.GetPackBlock(b);
+          int64_t k = 0;
+          for (int64_t mb = bi.mb_offset; mb < bi.mb_offset + bi.mb_count; ++mb)
+            for (int64_t fm = bi.fm_offset; fm < bi.fm_offset + bi.fm_count; ++fm)
+              for (int64_t sp = 0; sp < bi.fm_size; ++sp, ++k)
+                wires[p * wire + bi.buf_offset + k] =
+                    (float)(p * 1000 + (mb * FM + fm) * FMS + sp);
+        }
+      out.StartComm(wires.data(), MLSL_DT_FLOAT);
+      std::vector<float> arecv(world * wire);
+      const int64_t rc = in.WaitComm(arecv.data(), MLSL_DT_FLOAT);
+      if (rc != wire / MP) {
+        std::fprintf(stderr, "FAILED: fwd recv count\n");
+        return 1;
+      }
+      for (int64_t p = 0; p < world; ++p) {
+        const int64_t g0 = (p / MP) * MP, m = p % MP;
+        for (int64_t i = 0; i < rc; ++i) {
+          float want = 0;
+          for (int64_t j = 0; j < MP; ++j)
+            want += wires[(g0 + j) * wire + m * rc + i];
+          if (arecv[p * rc + i] != want) {
+            std::fprintf(stderr, "FAILED: fwd activation value\n");
+            return 1;
+          }
+        }
+      }
+      std::printf("activation exchange OK\n");
+
+      ParameterSet ps = oa.GetParameterSet(0);
+      if (!ps.IsDistributedUpdate() ||
+          ps.GetOwnedKernelCount() * DP != ps.GetLocalKernelCount()) {
+        std::fprintf(stderr, "FAILED: parameter-set metadata\n");
+        return 1;
+      }
+      Statistics st = s2.GetStats();
+      if (st.IsEnabled()) {
+        if (st.GetTotalCommSize() <= 0) {
+          std::fprintf(stderr, "FAILED: stats bytes\n");
+          return 1;
+        }
+        std::printf("stats OK (bytes=%lld)\n",
+                    (long long)st.GetTotalCommSize());
+      }
+    }
+
     dist.Barrier(MLSL_GT_GLOBAL);
     Environment::GetEnv().Finalize();
     std::printf("CPP API TEST PASSED\n");

@@ -23,6 +23,7 @@ def test_c_api_end_to_end():
     env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
     env["MLSL_TPU_PLATFORM"] = "cpu"
     env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=8"
+    env["MLSL_STATS"] = "1"  # exercise the statistics queries section
     run = subprocess.run(
         [os.path.join(NATIVE, "test_c_api")], capture_output=True, text=True,
         timeout=300, env=env,
@@ -31,6 +32,11 @@ def test_c_api_end_to_end():
     assert "C API TEST PASSED" in run.stdout
     assert "world = 8" in run.stdout
     assert "allreduce OK (36)" in run.stdout
+    assert "allgatherv/alltoallv OK" in run.stdout
+    assert "activation fwd ReduceScatter OK" in run.stdout
+    assert "activation bwd AllGather OK" in run.stdout
+    assert "distributed-update increment AllGather OK" in run.stdout
+    assert "statistics queries OK" in run.stdout
 
 
 @pytest.mark.skipif(shutil.which("g++") is None, reason="no C++ toolchain")

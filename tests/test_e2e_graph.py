@@ -158,6 +158,259 @@ def test_redistribution_case4_and_5(env):
     assert op3.get_output(0).comm_req.desc.group is dist_b.model_group
 
 
+def _build_edge(env, dist_a, dist_b, fm_out, op_type_a=OpType.CC):
+    """op1(dist_a) --edge--> op2(dist_b); returns (out_act, in_act, op1, op2)."""
+    s = env.create_session()
+    s.set_global_minibatch_size(MB)
+    r1 = s.create_operation_reg_info(op_type_a)
+    r1.add_input(FM1, FM_SIZE)
+    r1.add_output(fm_out, FM_SIZE)
+    op1 = s.get_operation(s.add_operation(r1, dist_a))
+    r2 = s.create_operation_reg_info(OpType.ACT)
+    r2.add_input(fm_out, FM_SIZE)
+    r2.add_output(fm_out, FM_SIZE)
+    op2 = s.get_operation(s.add_operation(r2, dist_b))
+    op1.set_next(op2, 0, 0)
+    s.commit()
+    return op1.get_output(0), op2.get_input(0), op1, op2
+
+
+@pytest.mark.parametrize("model_parts", [2, 4])
+def test_case2_allreduce_executes(env, model_parts):
+    """Case 2 (reference src/mlsl_impl.cpp:176-186): model-parallel CC output into
+    a pure-data distribution with the same data grid — AllReduce over the OUT
+    model group forward, NO backward comm. Executed with per-rank closed-form
+    oracles, both directions."""
+    data_parts = 8 // model_parts
+    dist_a = env.create_distribution(data_parts, model_parts)
+    dist_b = env.create_distribution(data_parts, 1)
+    out_act, in_act, op1, op2 = _build_edge(env, dist_a, dist_b, FM2)
+
+    assert out_act.comm_req is not None and out_act.comm_req.desc.kind == "allreduce"
+    assert out_act.comm_req.desc.group is dist_a.model_group
+    assert in_act.comm_req is None  # reference: empty request, no bwd comm
+
+    # forward: every rank holds a full-FM partial sum; AllReduce completes it
+    local_mb = op1.get_local_minibatch_size()
+    n = local_mb * FM2 * FM_SIZE
+    wires = {
+        p: pack_local(
+            _rank_fill(p, n).reshape(local_mb, FM2, FM_SIZE),
+            out_act.pack_blocks, local_mb, FM2, FM_SIZE,
+        )
+        for p in range(8)
+    }
+    out_act.start_comm(dist_a.make_buffer(lambda p: np.asarray(wires[p]), n))
+    received = in_act.wait_comm()
+    assert received is not None
+    g = dist_a.model_group
+    for p in range(8):
+        members = sorted(
+            (q for q in range(8)
+             if dist_a.topology.coords(q)[:3] == dist_a.topology.coords(p)[:3]),
+            key=g.group_idx_of,
+        )
+        want = sum(np.asarray(wires[q], np.float32) for q in members)
+        np.testing.assert_allclose(
+            np.asarray(dist_a.local_part(received, p)), want, rtol=1e-6
+        )
+        # unpack is the identity block on the full reduced activation
+        got_act = unpack_local(
+            np.asarray(dist_a.local_part(received, p)),
+            in_act.unpack_blocks, local_mb, FM2, FM_SIZE,
+        )
+        np.testing.assert_allclose(
+            got_act.reshape(-1), want, rtol=1e-6
+        )
+
+    # backward: the input grads are already what each out-rank needs (every
+    # model rank consumed the same reduced activation) — no comm, by design
+    assert out_act.wait_comm() is None
+
+
+@pytest.mark.parametrize("model_parts", [2, 4])
+def test_case3_mixed_grid_executes(env, model_parts):
+    """Case 3 (reference src/mlsl_impl.cpp:187-202): redistribution from a hybrid
+    (data x model) grid into a pure-data grid covering model*data ranks —
+    ReduceScatter over the OUT model group forward (minibatch-split blocks),
+    AllGather backward. Executed with per-rank oracles, fwd + bwd."""
+    data_parts = 8 // model_parts
+    dist_a = env.create_distribution(data_parts, model_parts)
+    dist_b = env.create_distribution(8, 1)  # in_data = out_model * out_data
+    out_act, in_act, op1, op2 = _build_edge(env, dist_a, dist_b, FM2)
+
+    assert out_act.comm_req.desc.kind == "reduce_scatter"
+    assert out_act.comm_req.desc.group is dist_a.model_group
+    assert in_act.comm_req.desc.kind == "allgather"
+
+    out_mb = op1.get_local_minibatch_size()       # MB / data_parts
+    in_mb = op2.get_local_minibatch_size()        # MB / 8
+    assert in_mb * model_parts == out_mb
+    n_out = out_mb * FM2 * FM_SIZE                # full FM partial sums
+    n_in = in_mb * FM2 * FM_SIZE
+
+    # forward: pack splits the local minibatch into model_parts chunks
+    # (_bi_pack_reduce_scatter2); ReduceScatter hands model-rank m chunk m
+    wires = {
+        p: pack_local(
+            _rank_fill(p, n_out).reshape(out_mb, FM2, FM_SIZE),
+            out_act.pack_blocks, out_mb, FM2, FM_SIZE,
+        )
+        for p in range(8)
+    }
+    out_act.start_comm(dist_a.make_buffer(lambda p: np.asarray(wires[p]), n_out))
+    received = in_act.wait_comm()
+    g = dist_a.model_group
+    for p in range(8):
+        members = sorted(
+            (q for q in range(8)
+             if dist_a.topology.coords(q)[:3] == dist_a.topology.coords(p)[:3]),
+            key=g.group_idx_of,
+        )
+        summed = sum(np.asarray(wires[q], np.float32) for q in members)
+        my = g.group_idx_of(p)
+        want = summed[my * n_in : (my + 1) * n_in]
+        np.testing.assert_allclose(
+            np.asarray(dist_a.local_part(received, p)), want, rtol=1e-6
+        )
+        # rank p's chunk is exactly global minibatch range [p*in_mb, (p+1)*in_mb):
+        # the same thing dist_b rank p computes with (reference rank layout,
+        # model minor) — verified against the unpacked activation
+        got_act = unpack_local(
+            np.asarray(dist_a.local_part(received, p)),
+            in_act.unpack_blocks, in_mb, FM2, FM_SIZE,
+        )
+        np.testing.assert_allclose(got_act.reshape(-1), want, rtol=1e-6)
+
+    # backward: input grads AllGather over the out model group reassembles each
+    # out-rank's full local minibatch
+    grads = {p: _rank_fill(p, n_in) for p in range(8)}
+    in_act.start_comm(dist_b.make_buffer(lambda p: grads[p], n_in))
+    bwd = out_act.wait_comm()
+    for p in range(8):
+        members = sorted(
+            (q for q in range(8)
+             if dist_a.topology.coords(q)[:3] == dist_a.topology.coords(p)[:3]),
+            key=g.group_idx_of,
+        )
+        want = np.concatenate([grads[q] for q in members])
+        got = np.asarray(dist_a.local_part(bwd, p))
+        np.testing.assert_allclose(got, want, rtol=1e-6)
+        # unpack reassembles the (out_mb, FM2, FM_SIZE) grad via allgather2 blocks
+        got_act = unpack_local(got, out_act.unpack_blocks, out_mb, FM2, FM_SIZE)
+        want_act = np.concatenate(
+            [grads[q].reshape(in_mb, FM2, FM_SIZE) for q in members], axis=0
+        )
+        np.testing.assert_allclose(got_act, want_act, rtol=1e-6)
+
+
+def test_case4_and_5_alltoall_executes(env):
+    """Cases 4/5 (reference src/mlsl_impl.cpp:203-226): no-reduce edges between
+    differently-shaped distributions EXECUTE the AlltoAll across the two meshes
+    (the out-op's buffer is laid out on dist_a's grid, the request runs on
+    dist_b's) with per-rank data checks on forward AND backward legs."""
+    dist_a = env.create_distribution(8, 1)   # pure data-parallel
+    dist_b = env.create_distribution(2, 4)   # hybrid
+    out_act, in_act, op1, op2 = _build_edge(
+        env, dist_a, dist_b, FM1, op_type_a=OpType.ACT
+    )
+    assert out_act.comm_req.desc.kind == "alltoall"          # case 4
+    assert out_act.comm_req.desc.group is dist_b.model_group
+
+    G = 4                                     # dist_b model group size
+    out_mb = op1.get_local_minibatch_size()   # 1
+    in_mb = op2.get_local_minibatch_size()    # 4
+    blk = out_act.comm_req.desc.count         # elements per member block
+    assert blk == in_act.local_fm_count * out_mb * FM_SIZE
+    n_wire = G * blk
+
+    # forward: out rank p packs its (1, FM1, FM_SIZE) activation into 4 fm-slice
+    # blocks, one per model rank of its dist_b group {4d..4d+3}
+    acts = {p: _rank_fill(p, out_mb * FM1 * FM_SIZE) for p in range(8)}
+    wires = {
+        p: pack_local(
+            acts[p].reshape(out_mb, FM1, FM_SIZE),
+            out_act.pack_blocks, out_mb, FM1, FM_SIZE,
+        )
+        for p in range(8)
+    }
+    assert wires[0].shape[0] == n_wire
+    out_act.start_comm(dist_a.make_buffer(lambda p: np.asarray(wires[p]), n_wire))
+    received = in_act.wait_comm()
+    for p in range(8):
+        d, m = p // 4, p % 4
+        members = [4 * d + j for j in range(G)]
+        want = np.concatenate(
+            [np.asarray(wires[q], np.float32)[m * blk : (m + 1) * blk]
+             for q in members]
+        )
+        np.testing.assert_allclose(
+            np.asarray(dist_b.local_part(received, p)), want, rtol=1e-6
+        )
+        # unpacked: in-rank (d, m) holds minibatch rows {4d..4d+3} of its fm
+        # slice [4m, 4m+4) — check against the global activation directly
+        got_act = unpack_local(
+            np.asarray(dist_b.local_part(received, p)),
+            in_act.unpack_blocks, in_mb, in_act.local_fm_count, FM_SIZE,
+        )
+        want_act = np.stack(
+            [acts[q].reshape(FM1, FM_SIZE)[4 * m : 4 * m + 4] for q in members]
+        )
+        np.testing.assert_allclose(got_act, want_act, rtol=1e-6)
+
+    # backward: in rank (d, m) sends grads for its fm slice of minibatch rows
+    # {4d..4d+3}; out rank p reassembles its full-FM grad for minibatch row p
+    grads = {p: _rank_fill(p, n_wire) for p in range(8)}
+    gwires = {
+        p: pack_local(
+            grads[p].reshape(in_mb, in_act.local_fm_count, FM_SIZE),
+            in_act.unpack_blocks, in_mb, in_act.local_fm_count, FM_SIZE,
+        )
+        for p in range(8)
+    }
+    in_act.start_comm(dist_b.make_buffer(lambda p: np.asarray(gwires[p]), n_wire))
+    bwd = out_act.wait_comm()
+    for p in range(8):
+        d, m = p // 4, p % 4
+        members = [4 * d + j for j in range(G)]
+        want = np.concatenate(
+            [np.asarray(gwires[q], np.float32)[m * blk : (m + 1) * blk]
+             for q in members]
+        )
+        np.testing.assert_allclose(
+            np.asarray(dist_b.local_part(bwd, p)), want, rtol=1e-6
+        )
+
+    # case 5 (reverse direction, hybrid -> pure-data) forward execution
+    out5, in5, op3, op4 = _build_edge(
+        env, dist_b, dist_a, FM1, op_type_a=OpType.ACT
+    )
+    assert out5.comm_req.desc.kind == "alltoall"
+    assert out5.comm_req.desc.group is dist_b.model_group
+    blk5 = out5.comm_req.desc.count
+    n5 = G * blk5
+    acts5 = {p: _rank_fill(p, n5) for p in range(8)}
+    wires5 = {
+        p: pack_local(
+            acts5[p].reshape(in_mb, out5.local_fm_count, FM_SIZE),
+            out5.pack_blocks, in_mb, out5.local_fm_count, FM_SIZE,
+        )
+        for p in range(8)
+    }
+    out5.start_comm(dist_b.make_buffer(lambda p: np.asarray(wires5[p]), n5))
+    recv5 = in5.wait_comm()
+    for p in range(8):
+        d, m = p // 4, p % 4
+        members = [4 * d + j for j in range(G)]
+        want = np.concatenate(
+            [np.asarray(wires5[q], np.float32)[m * blk5 : (m + 1) * blk5]
+             for q in members]
+        )
+        np.testing.assert_allclose(
+            np.asarray(dist_b.local_part(recv5, p)), want, rtol=1e-6
+        )
+
+
 @pytest.mark.parametrize("model_parts", [2, 4])
 def test_full_reference_loop(env, model_parts):
     """The canonical reference loop (mlsl_test.cpp:660-698) in one piece: per

@@ -26,6 +26,7 @@ def test_restart_reuses_request(env):
 def test_priority_deferral_and_restart_supersede(env):
     env.config.msg_priority = True
     env.config.msg_priority_threshold = 0
+    env.config.msg_priority_flush_ms = 60_000  # keep the progress thread out
     try:
         dist = env.create_distribution(8, 1)
         from mlsl_tpu.comm.request import CommDesc, CommRequest
@@ -52,6 +53,7 @@ def test_priority_deferral_and_restart_supersede(env):
 def test_priority_lifo_order(env):
     env.config.msg_priority = True
     env.config.msg_priority_threshold = 0
+    env.config.msg_priority_flush_ms = 60_000  # keep the progress thread out
     try:
         dist = env.create_distribution(8, 1)
         buf = dist.make_buffer(lambda p: np.full(4, float(p)), 4)
@@ -62,6 +64,33 @@ def test_priority_lifo_order(env):
         out2 = env.wait(r2)
         np.testing.assert_allclose(dist.local_part(out1, 0), np.full(4, 28.0))
         np.testing.assert_allclose(dist.local_part(out2, 0), np.full(4, 28.0))
+    finally:
+        env.config.msg_priority = False
+
+
+def test_background_progress_without_polls(env):
+    """A deferred priority request is launched by the progress thread with NO
+    wait()/test() from the app — the reference's endpoint servers progress
+    autonomously (eplib/allreduce_pr.c:69-278); round-1 deferred launches only
+    at the next app poll."""
+    import time
+
+    env.config.msg_priority = True
+    env.config.msg_priority_threshold = 0
+    env.config.msg_priority_flush_ms = 1.0
+    try:
+        dist = env.create_distribution(8, 1)
+        buf = dist.make_buffer(lambda p: np.full(4, float(p + 1)), 4)
+        req = dist.all_reduce(buf, 4, DataType.FLOAT, ReductionType.SUM, GroupType.DATA)
+        deadline = time.time() + 10
+        while (
+            env.dispatcher.pending_count or env.dispatcher.is_in_flight(req.uid)
+        ) and time.time() < deadline:
+            time.sleep(0.005)
+        assert env.dispatcher.pending_count == 0, "progress thread never flushed"
+        assert req._results, "request was not dispatched autonomously"
+        out = req.wait()  # returns the already-launched result
+        np.testing.assert_allclose(dist.local_part(out, 0), np.full(4, 36.0))
     finally:
         env.config.msg_priority = False
 
