@@ -203,26 +203,10 @@ def _body_alltoallv(x, *, axes, sizes, S, Soff, Roff, recv_len, **_):
     Segment lengths vary per (j, me) pair, so slices use a static max length with a
     validity mask.
     """
-    g = len(S)
-    g_members = _gather_group(x, axes)   # (G, send_len)
-    me = _group_rank(axes, sizes)
-    s_m = jnp.asarray(S, dtype=jnp.int32)
-    soff_m = jnp.asarray(Soff, dtype=jnp.int32)
-    roff_m = jnp.asarray(Roff, dtype=jnp.int32)
-    lmax = int(np.max(S)) if np.max(S) > 0 else 1
-    pos = jnp.arange(lmax)
-    pad = jnp.zeros((lmax,), dtype=x.dtype)
-    out = jnp.zeros((recv_len + lmax,), dtype=x.dtype)
-    for j in range(g):
-        cnt = s_m[j, me]
-        src = lax.dynamic_slice_in_dim(
-            jnp.concatenate([g_members[j], pad]), soff_m[j, me], lmax, axis=0
-        )
-        roff = roff_m[me, j]
-        window = lax.dynamic_slice_in_dim(out, roff, lmax, axis=0)
-        merged = jnp.where(pos < cnt, src, window)
-        out = lax.dynamic_update_slice_in_dim(out, merged, roff, axis=0)
-    return out[:recv_len]
+    return _alltoallv_core(
+        _gather_group(x, axes), _group_rank(axes, sizes), x.dtype,
+        S, Soff, Roff, recv_len,
+    )
 
 
 def sizes_prod(axes, sizes) -> int:

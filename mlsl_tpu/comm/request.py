@@ -172,14 +172,15 @@ class CommRequest:
             kw["root"] = int(d.root)
         if d.recv_count is not None:
             kw["recv_count"] = int(d.recv_count)
-        if d.recv_counts is not None:
+        if d.recv_counts is not None and d.kind != "alltoallv":
+            # alltoallv's recv_counts may be a full (G, G) matrix and is
+            # consumed by _normalize_alltoallv below, not flattened here.
             kw["recv_counts"] = tuple(int(c) for c in d.recv_counts)
         if d.kind == "alltoall":
             kw["send_count"] = int(d.count)
         if d.kind == "sendrecv":
             kw["pairs"] = tuple((int(s), int(t)) for s, t in d.pairs)
         if d.kind == "alltoallv":
-            kw.pop("recv_counts", None)
             kw.update(_normalize_alltoallv(d))
 
         dtype = jnp_dtype(d.data_type)
@@ -246,10 +247,20 @@ class CommRequest:
             if epoch is not None and epoch != self._epoch:
                 log_debug("dropping superseded dispatch of %s", self.name or self.uid)
                 return
-            with jax.profiler.TraceAnnotation(
-                f"mlsl:{self.desc.kind}:{self.name or self.uid}"
-            ):
-                self._dispatch_inner(buf)
+            try:
+                with jax.profiler.TraceAnnotation(
+                    f"mlsl:{self.desc.kind}:{self.name or self.uid}"
+                ):
+                    self._dispatch_inner(buf)
+            except Exception as e:
+                if epoch is None:
+                    raise  # direct dispatch: fail the caller's start()
+                # Queued dispatch: record the failure on the request while the
+                # epoch is still known-current. Recording it after releasing
+                # _dlock would race a fresh start() (which resets
+                # _dispatch_error and bumps the epoch) and attach this stale
+                # failure to the new start.
+                self._dispatch_error = e
 
     def _dispatch_inner(self, buf: jax.Array) -> None:
         # Cross-distribution edges (redistribution cases 3-5) hand a buffer laid
@@ -374,6 +385,13 @@ def _normalize_alltoallv(d: CommDesc) -> dict:
     s = expand(d.send_counts)
     soff = packed(s) if d.send_offsets is None else expand(d.send_offsets)
     r = s.T
+    if d.recv_counts is not None:
+        # MPI requires recvcounts[i][j] == sendcounts[j][i]; a mismatch is a
+        # usage error the reference would deadlock/corrupt on — raise instead.
+        mlsl_assert(
+            np.array_equal(expand(d.recv_counts), r),
+            "alltoallv recv_counts do not match transposed send_counts",
+        )
     roff = packed(r) if d.recv_offsets is None else expand(d.recv_offsets)
     recv_len = int(np.max(roff + r)) if g > 0 else 1
     to_t = lambda m: tuple(tuple(int(v) for v in row) for row in m)
@@ -536,17 +554,15 @@ class Dispatcher:
     def _dispatch_items(self, items) -> None:
         """Launch outside the lock (may compile); then release waiters.
 
-        A dispatch failure is recorded on ITS request (re-raised by that
-        request's wait()/test()) and must not strand the remaining items of the
-        batch or, on the progress thread, kill the daemon."""
+        A dispatch failure is recorded on ITS request by _dispatch itself
+        (under the request's dispatch lock, re-raised by that request's
+        wait()/test()), so it neither strands the remaining items of the batch
+        nor, on the progress thread, kills the daemon."""
         if not items:
             return
         try:
             for req, buf, epoch in items:
-                try:
-                    req._dispatch(buf, epoch)
-                except Exception as e:
-                    req._dispatch_error = e
+                req._dispatch(buf, epoch)
         finally:
             with self._cv:
                 for req, _, _ in items:

@@ -99,6 +99,7 @@ class DataParallelTrainer:
         lr: float = 0.05,
         donate_params: bool = True,
         overlap_updates: bool = False,
+        force_graph_path: bool = False,
     ):
         self.env = env
         self.dist = dist
@@ -156,8 +157,12 @@ class DataParallelTrainer:
         needs_comm = any(
             self.ops[n].get_parameter_set(0).need_comm for n in layers
         )
+        # force_graph_path bypasses the fused shortcut so the per-layer
+        # Start/Wait machinery can be measured even when no comm is needed
+        # (bench.py times it against the fused program on one chip).
+        use_fused = not needs_comm and not force_graph_path
         sharding = NamedSharding(self.mesh, P())
-        if needs_comm or not donate_params:
+        if not use_fused or not donate_params:
             self.params = jax.device_put(params, sharding)
         else:
             # Owning copy: the fused step donates self.params, so the trainer must
@@ -172,7 +177,7 @@ class DataParallelTrainer:
         self._du_apply_fn = self._build_du_apply_fn() if distributed_update else None
         self.distributed_update = distributed_update
         self._fused_fn = (
-            None if needs_comm else self._build_fused_fn(donate=donate_params)
+            self._build_fused_fn(donate=donate_params) if use_fused else None
         )
         # Test-driven overlap (the reference's canonical loop polls
         # TestGradientComm and updates each layer as its collective lands,

@@ -8,6 +8,7 @@ closed-form expected results computed per group.
 import numpy as np
 import pytest
 
+from mlsl_tpu.log import MLSLError
 from mlsl_tpu.types import DataType, GroupType, ReductionType
 
 N = 12  # elements per rank
@@ -201,6 +202,37 @@ def test_alltoallv_matrix(env):
             seg = src[soff[j, p] : soff[j, p] + S[j, p]]
             expected[roff[p, j] : roff[p, j] + len(seg)] = seg
         np.testing.assert_allclose(dist.local_part(out, p), expected)
+
+
+def test_alltoallv_explicit_recv_counts(env):
+    """Explicit recv_counts (the form cmlsl_test passes) are accepted when they
+    match transposed send counts, rejected when they don't."""
+    G = 4
+    dist = env.create_distribution(1, G, devices=env.devices[:G])
+    S = np.array([[(i + j) % 3 + 1 for j in range(G)] for i in range(G)])
+    send_len = int(S.sum(axis=1).max())
+    soff = np.hstack([np.zeros((G, 1), int), np.cumsum(S, axis=1)[:, :-1]])
+    R = S.T
+    roff = np.hstack([np.zeros((G, 1), int), np.cumsum(R, axis=1)[:, :-1]])
+    buf = dist.make_buffer(
+        lambda p: p * 100.0 + np.arange(send_len, dtype=np.float64), send_len
+    )
+    out = env.wait(
+        dist.all_to_allv(buf, S, soff, R, roff, DataType.FLOAT, GroupType.MODEL)
+    )
+    for p in range(G):
+        recv_len = np.asarray(out).shape[-1]
+        expected = np.zeros(recv_len, dtype=np.float32)
+        for j in range(G):
+            src = np.asarray(j * 100.0 + np.arange(send_len), dtype=np.float32)
+            seg = src[soff[j, p] : soff[j, p] + S[j, p]]
+            expected[roff[p, j] : roff[p, j] + len(seg)] = seg
+        np.testing.assert_allclose(dist.local_part(out, p), expected)
+
+    with pytest.raises(MLSLError):
+        dist.all_to_allv(
+            buf, S, soff, np.ones((G, G), int), roff, DataType.FLOAT, GroupType.MODEL
+        )
 
 
 def test_barrier(env):
