@@ -55,32 +55,44 @@ def dequantize_blocks_ref(q2d: jax.Array, scales: jax.Array) -> jax.Array:
 
 def _quant_kernel(x_ref, q_ref, s_ref):
     x = x_ref[:]
-    amax = jnp.max(jnp.abs(x), axis=1, keepdims=True)          # (ROW_TILE, 1)
+    amax = jnp.max(jnp.abs(x), axis=1, keepdims=True)          # (rows, 1)
     scale = jnp.where(amax == 0.0, 1.0, amax / 127.0)
     q = jnp.clip(jnp.round(x / scale), -127, 127).astype(jnp.int8)
     q_ref[:] = q
-    s_ref[:] = jnp.broadcast_to(scale, s_ref.shape)
+    s_ref[:] = scale
 
 
 def _dequant_kernel(q_ref, s_ref, x_ref):
-    x_ref[:] = q_ref[:].astype(jnp.float32) * s_ref[:, :1]
+    x_ref[:] = q_ref[:].astype(jnp.float32) * s_ref[:]
+
+
+def _step_rows(n: int) -> int:
+    """Rows per grid step: big steps amortize grid overhead; tiles stay int8-legal
+    (multiples of ROW_TILE = 32 sublanes)."""
+    for r in (512, 256, 128, 64, ROW_TILE):
+        if n % r == 0:
+            return r
+    return ROW_TILE
 
 
 @functools.partial(jax.jit, static_argnames=("interpret",))
 def _quantize_pallas(x2d, interpret=False):
     n, block = x2d.shape
-    grid = (n // ROW_TILE,)
+    r = _step_rows(n)
+    # Scales ride as (n, 1): lane-padded inside VMEM but only n floats of HBM
+    # traffic (the old (n, 128) broadcast moved 128x the bytes and capped the
+    # roundtrip below the XLA reference's throughput).
     q, s = pl.pallas_call(
         _quant_kernel,
-        grid=grid,
-        in_specs=[pl.BlockSpec((ROW_TILE, block), lambda i: (i, 0))],
+        grid=(n // r,),
+        in_specs=[pl.BlockSpec((r, block), lambda i: (i, 0))],
         out_specs=[
-            pl.BlockSpec((ROW_TILE, block), lambda i: (i, 0)),
-            pl.BlockSpec((ROW_TILE, 128), lambda i: (i, 0)),
+            pl.BlockSpec((r, block), lambda i: (i, 0)),
+            pl.BlockSpec((r, 1), lambda i: (i, 0)),
         ],
         out_shape=[
             jax.ShapeDtypeStruct((n, block), jnp.int8),
-            jax.ShapeDtypeStruct((n, 128), jnp.float32),
+            jax.ShapeDtypeStruct((n, 1), jnp.float32),
         ],
         interpret=interpret,
     )(x2d)
@@ -90,18 +102,18 @@ def _quantize_pallas(x2d, interpret=False):
 @functools.partial(jax.jit, static_argnames=("interpret",))
 def _dequantize_pallas(q2d, scales, interpret=False):
     n, block = q2d.shape
-    s128 = jnp.broadcast_to(scales[:, None], (n, 128))
+    r = _step_rows(n)
     return pl.pallas_call(
         _dequant_kernel,
-        grid=(n // ROW_TILE,),
+        grid=(n // r,),
         in_specs=[
-            pl.BlockSpec((ROW_TILE, block), lambda i: (i, 0)),
-            pl.BlockSpec((ROW_TILE, 128), lambda i: (i, 0)),
+            pl.BlockSpec((r, block), lambda i: (i, 0)),
+            pl.BlockSpec((r, 1), lambda i: (i, 0)),
         ],
-        out_specs=pl.BlockSpec((ROW_TILE, block), lambda i: (i, 0)),
+        out_specs=pl.BlockSpec((r, block), lambda i: (i, 0)),
         out_shape=jax.ShapeDtypeStruct((n, block), jnp.float32),
         interpret=interpret,
-    )(q2d, s128)
+    )(q2d, scales[:, None])
 
 
 # -- public API: pads to tile geometry, picks backend -------------------------
