@@ -65,10 +65,14 @@ def init_resnet50(key, num_classes: int = 1000) -> Params:
 
 
 def _bn(x, p, eps=1e-5):
-    mean = jnp.mean(x, axis=(0, 1, 2), keepdims=True)
-    var = jnp.var(x, axis=(0, 1, 2), keepdims=True)
+    # Stats and affine in f32 for stability, output back in the compute dtype:
+    # the f32 scale/bias would otherwise promote the activations and drag every
+    # downstream conv off the MXU's bf16 path (measured 3x step time).
+    xf = x.astype(jnp.float32)
+    mean = jnp.mean(xf, axis=(0, 1, 2), keepdims=True)
+    var = jnp.var(xf, axis=(0, 1, 2), keepdims=True)
     inv = lax.rsqrt(var + eps)
-    return (x - mean) * inv * p["scale"] + p["bias"]
+    return ((xf - mean) * inv * p["scale"] + p["bias"]).astype(x.dtype)
 
 
 def _conv(x, w, stride=1):
