@@ -75,10 +75,22 @@ def _route(x, wg, n_experts: int, capacity: int, top_k: int = 1):
     return dispatch, combine, aux
 
 
-def _expert_ffn(buf, w1, w2):
-    """buf: (..., El, C, D); w1: (El, D, F); w2: (El, F, D)."""
-    h = jax.nn.gelu(jnp.einsum("...ecd,edf->...ecf", buf, w1.astype(buf.dtype)))
-    return jnp.einsum("...ecf,efd->...ecd", h, w2.astype(buf.dtype))
+def _expert_ffn(buf, w1, w2, compute_dtype=jnp.float32):
+    """buf: (..., El, C, D); w1: (El, D, F); w2: (El, F, D).
+
+    With a bf16 compute_dtype the expert matmuls run bf16-in/f32-accumulate
+    (MXU-native); dispatch, combine and the gate always stay f32 for routing
+    stability. f32 stays all-f32 (the CPU backend cannot execute mixed
+    bf16->f32 dots)."""
+    cdt = jnp.dtype(compute_dtype)
+    h = jax.nn.gelu(jnp.einsum(
+        "...ecd,edf->...ecf", buf.astype(cdt), w1.astype(cdt),
+        preferred_element_type=jnp.float32,
+    ))
+    return jnp.einsum(
+        "...ecf,efd->...ecd", h.astype(cdt), w2.astype(cdt),
+        preferred_element_type=jnp.float32,
+    )
 
 
 def moe_ffn(
@@ -88,6 +100,7 @@ def moe_ffn(
     ep: int,
     capacity_factor: float = 1.25,
     top_k: int = 1,
+    compute_dtype=jnp.float32,
 ) -> Tuple[jax.Array, jax.Array]:
     """SPMD MoE feed-forward (call inside shard_map over ``axis`` of size ep).
 
@@ -103,7 +116,8 @@ def moe_ffn(
     el = params["w1"].shape[0]
     n_experts = el * ep
     if ep == 1:
-        return _moe_slice(x, params, n_experts, capacity_factor, top_k)
+        return _moe_slice(x, params, n_experts, capacity_factor, top_k,
+                          compute_dtype)
 
     mlsl_assert(
         t % ep == 0,
@@ -116,9 +130,12 @@ def moe_ffn(
     capacity = max(1, int(tl * capacity_factor * top_k / n_experts))
     dispatch, combine, aux = _route(xs, params["wg"], n_experts, capacity, top_k)
     buf = jnp.einsum("tec,td->ecd", dispatch, xs.astype(jnp.float32))
-    buf = buf.reshape(ep, el, capacity, d)
+    # Cast to the compute dtype BEFORE the wire: the experts downcast anyway, so
+    # a bf16 dispatch alltoall moves half the bytes for identical inputs (the
+    # return path stays f32 — combine consumes it in f32).
+    buf = buf.reshape(ep, el, capacity, d).astype(compute_dtype)
     recv = lax.all_to_all(buf, axis, split_axis=0, concat_axis=0)  # (ep, El, C, D)
-    y = _expert_ffn(recv, params["w1"], params["w2"])              # (ep, El, C, D)
+    y = _expert_ffn(recv, params["w1"], params["w2"], compute_dtype)  # (ep, El, C, D)
     back = lax.all_to_all(y, axis, split_axis=0, concat_axis=0)
     y_full = back.reshape(n_experts, capacity, d)
     out_slice = jnp.einsum("tec,ecd->td", combine, y_full)         # (Tl, D)
@@ -126,11 +143,12 @@ def moe_ffn(
     return out, aux
 
 
-def _moe_slice(xs, params, n_experts: int, capacity_factor: float, top_k: int = 1):
+def _moe_slice(xs, params, n_experts: int, capacity_factor: float, top_k: int = 1,
+               compute_dtype=jnp.float32):
     capacity = max(1, int(xs.shape[0] * capacity_factor * top_k / n_experts))
     dispatch, combine, aux = _route(xs, params["wg"], n_experts, capacity, top_k)
     buf = jnp.einsum("tec,td->ecd", dispatch, xs.astype(jnp.float32))
-    y = _expert_ffn(buf, params["w1"], params["w2"])
+    y = _expert_ffn(buf, params["w1"], params["w2"], compute_dtype)
     return jnp.einsum("tec,ecd->td", combine, y), aux
 
 

@@ -107,7 +107,7 @@ def apply_resnet50(params: Params, x: jax.Array) -> jax.Array:
         for bi in range(blocks):
             stride = 2 if (bi == 0 and si > 0) else 1
             x = _bottleneck(x, stage[bi], stride)
-    x = jnp.mean(x, axis=(1, 2)).astype(jnp.float32)
+    x = jnp.mean(x.astype(jnp.float32), axis=(1, 2))  # pool accumulates in f32
     return x @ params["fc"]["w"] + params["fc"]["b"]
 
 

@@ -176,8 +176,11 @@ def forward_local(params, tokens, cfg: TransformerConfig, sp: int, tp: int):
             jnp.moveaxis(qkv[:, c], 2, 1) for c in range(3)
         )  # (Bl, Hl, Sl, Dh)
         attn = attn_fn(q, k, v, SEQ_AXIS, sp, causal=True)
+        # bf16 operands, f32 accumulate/output: keeps the projection on the MXU's
+        # native path while the residual add and TP psum stay f32.
         o = jnp.einsum(
-            "bhsx,hxd->bsd", attn.astype(jnp.float32), ap["wo"].astype(jnp.float32)
+            "bhsx,hxd->bsd", attn.astype(cdt), ap["wo"].astype(cdt),
+            preferred_element_type=jnp.float32,
         )
         o = lax.psum(o, MODEL_AXIS) if tp > 1 else o      # TP reduction (case-2 analog)
         h = (h.astype(jnp.float32) + o).astype(cdt)
@@ -188,6 +191,7 @@ def forward_local(params, tokens, cfg: TransformerConfig, sp: int, tp: int):
             o2d, aux = moe_ffn(
                 a.reshape(bl * sl_, dm).astype(jnp.float32),
                 mp, MODEL_AXIS, tp, cfg.capacity_factor, cfg.moe_top_k,
+                compute_dtype=cdt,
             )
             aux_total = aux_total + aux
             h = (h.astype(jnp.float32) + o2d.reshape(bl, sl_, dm)).astype(cdt)
@@ -197,7 +201,8 @@ def forward_local(params, tokens, cfg: TransformerConfig, sp: int, tp: int):
                 + mp["b1"].astype(cdt)
             )
             o = jnp.einsum(
-                "bsf,fd->bsd", f.astype(jnp.float32), mp["w2"].astype(jnp.float32)
+                "bsf,fd->bsd", f, mp["w2"].astype(cdt),
+                preferred_element_type=jnp.float32,
             )
             o = lax.psum(o, MODEL_AXIS) if tp > 1 else o
             h = (h.astype(jnp.float32) + o + mp["b2"]).astype(cdt)
