@@ -71,15 +71,33 @@ class CheckpointManager:
         self._mgr.close()
 
 
+def _trainer_state(trainer, step: int) -> dict:
+    state = {"params": trainer.params, "step": step}
+    # optax state (replicated and/or ZeRO-1 owned-shard buffers) must resume
+    # with the params — restarting Adam from zero moments silently diverges
+    # the trajectory.
+    if getattr(trainer, "_opt_state", None) is not None:
+        state["opt_state"] = trainer._opt_state
+    if getattr(trainer, "_du_opt_state", None) is not None:
+        state["du_opt_state"] = trainer._du_opt_state
+    return state
+
+
 def save_trainer(mgr: CheckpointManager, trainer, step: int, wait: bool = False) -> None:
-    """Persist a DataParallelTrainer/HybridTrainer's parameters."""
-    mgr.save(step, {"params": trainer.params, "step": step}, wait=wait)
+    """Persist a DataParallelTrainer/HybridTrainer's parameters (and optimizer
+    state, when the trainer carries one)."""
+    mgr.save(step, _trainer_state(trainer, step), wait=wait)
 
 
 def restore_trainer(mgr: CheckpointManager, trainer, step: Optional[int] = None) -> Optional[int]:
-    """Restore parameters in place; returns the restored step or None."""
-    state = mgr.restore(step, template={"params": trainer.params, "step": 0})
+    """Restore parameters (and optimizer state) in place; returns the restored
+    step or None."""
+    state = mgr.restore(step, template=_trainer_state(trainer, 0))
     if state is None:
         return None
     trainer.params = state["params"]
+    if "opt_state" in state:
+        trainer._opt_state = state["opt_state"]
+    if "du_opt_state" in state:
+        trainer._du_opt_state = state["du_opt_state"]
     return int(state["step"])

@@ -29,6 +29,7 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 from mlsl_tpu.comm.collectives import _BUF_SPEC
 from mlsl_tpu.comm.mesh import (
     DATA_AXIS,
+    GRID_AXES,
     MODEL_AXIS,
     NUM_GRID_AXES,
     REPLICA_AXIS,
@@ -59,6 +60,11 @@ def build_owned_increment_fn(mesh, lr: float, norm: float):
         return smap(body, mesh, in_specs=_BUF_SPEC, out_specs=_BUF_SPEC)(g)
 
     return jax.jit(inc)
+
+
+def _leaf_buf_spec(leaf) -> P:
+    """PartitionSpec for a distributed buffer with arbitrary payload rank."""
+    return P(*GRID_AXES, *([None] * (leaf.ndim - NUM_GRID_AXES)))
 
 
 def _unflatten_like(tree, flat: jax.Array):
@@ -100,7 +106,18 @@ class DataParallelTrainer:
         donate_params: bool = True,
         overlap_updates: bool = False,
         force_graph_path: bool = False,
+        optimizer=None,
     ):
+        """optimizer: an optax.GradientTransformation (e.g. optax.adam(lr)).
+        None keeps the built-in SGD (p - lr * mean_grad). With
+        distributed_update=True the optimizer state lives ONLY on each rank's
+        owned gradient shard (ZeRO-1 proper: Adam moments sharded over the data
+        group, reference owned-kernel math src/mlsl_impl.cpp:401-435). The
+        sharded path runs the transform on each rank's flat (owned,) shard, so
+        it is correct only for elementwise/shard-local transforms (adam, sgd
+        with momentum, rmsprop, ...); params-consuming (weight decay) or
+        cross-shard/shape-dependent transforms (clip_by_global_norm, adafactor)
+        need the plain path — they would silently see per-shard views here."""
         self.env = env
         self.dist = dist
         self.session = session
@@ -108,7 +125,13 @@ class DataParallelTrainer:
         self.layers = layers
         self.get_layer = get_layer
         self.lr = lr
+        self.optimizer = optimizer
         self.mesh = dist.topology.mesh
+        mlsl_assert(
+            not (optimizer is not None and overlap_updates),
+            "overlap_updates is not supported with an optax optimizer "
+            "(per-layer state slicing would impose its own schedule)",
+        )
         # Normalizer must match the reduction group (grad_group = data x seq); this
         # trainer only shards the batch, so it requires seq_parts == 1 and the two
         # coincide (HybridTrainer handles sequence-parallel grids).
@@ -171,6 +194,22 @@ class DataParallelTrainer:
             self.params = jax.tree.map(
                 lambda x: jax.device_put(jnp.array(x, copy=True), sharding), params
             )
+        # Optimizer state: replicated alongside the params on the plain path;
+        # per-layer buffers over each rank's OWNED gradient shard under
+        # distributed update (ZeRO-1: moments sharded over the data group).
+        self._opt_state = None
+        self._du_opt_state = None
+        if optimizer is not None:
+            if distributed_update and not use_fused:
+                self._du_opt_state = {
+                    n: self._init_owned_opt_state(n) for n in layers
+                }
+            else:
+                # Fused shortcut (incl. distributed_update on a single data
+                # rank, where owned == full) carries replicated state.
+                self._opt_state = jax.device_put(
+                    optimizer.init(self.params), sharding
+                )
         self._grad_fn = self._build_grad_fn()
         self._update_fn = self._build_update_fn()
         self._du_inc_fn = self._build_du_inc_fn() if distributed_update else None
@@ -196,6 +235,26 @@ class DataParallelTrainer:
         )
 
     # -- compiled pieces ---------------------------------------------------
+
+    def _init_owned_opt_state(self, name: str):
+        """Optimizer state over this layer's owned shard, as distributed buffers
+        (scalar leaves ride as payload shape (1,))."""
+        ps = self.ops[name].get_parameter_set(0)
+        state = self.optimizer.init(
+            jnp.zeros((ps.owned_kernel_count,), jnp.float32)
+        )
+        topo = self.dist.topology
+        grid = topo.grid_shape
+
+        def bufferize(leaf):
+            arr = np.asarray(leaf)
+            if arr.ndim == 0:
+                arr = arr.reshape(1)
+            return topo.shard_buffer(
+                np.ascontiguousarray(np.broadcast_to(arr, grid + arr.shape))
+            )
+
+        return jax.tree.map(bufferize, state)
 
     def _build_grad_fn(self):
         layers, get_layer, loss_fn = self.layers, self.get_layer, self.loss_fn
@@ -225,6 +284,8 @@ class DataParallelTrainer:
         return jax.jit(sm)
 
     def _build_update_fn(self):
+        if self.optimizer is not None:
+            return self._build_opt_update_fn()
         layers, get_layer = self.layers, self.get_layer
         data_size, lr = self.data_size, self.lr
         counts = self.layer_counts
@@ -254,9 +315,80 @@ class DataParallelTrainer:
 
         return jax.jit(update)
 
+    def _build_opt_update_fn(self):
+        """optax path: reduced per-layer gradient buffers -> (params, opt_state)."""
+        import optax
+
+        layers, get_layer = self.layers, self.get_layer
+        data_size, counts = self.data_size, self.layer_counts
+        optimizer = self.optimizer
+
+        def update(params, opt_state, reduced: Dict[str, jax.Array]):
+            def body(params, opt_state, *flat_grads):
+                grads = jax.tree.map(jnp.zeros_like, params)
+                for name, g in zip(layers, flat_grads):
+                    g = g.reshape(-1)[: counts[name]] / data_size
+                    sub = get_layer(params, name)
+                    grads = _set_layer(grads, name, _unflatten_like(sub, g))
+                updates, new_state = optimizer.update(grads, opt_state, params)
+                # Apply only to registered layers: leaves outside `layers`
+                # (frozen params) must stay untouched even under
+                # params-consuming transforms like weight decay, matching the
+                # SGD path's semantics.
+                new_params = params
+                for name in layers:
+                    new_params = _set_layer(
+                        new_params, name,
+                        optax.apply_updates(
+                            get_layer(params, name), get_layer(updates, name)
+                        ),
+                    )
+                return new_params, new_state
+
+            sm = smap(
+                body,
+                self.mesh,
+                in_specs=(P(), P()) + tuple(_BUF_SPEC for _ in layers),
+                out_specs=(P(), P()),
+                check=False,
+            )
+            return sm(params, opt_state, *[reduced[n] for n in layers])
+
+        return jax.jit(update)
+
     def _build_du_inc_fn(self):
         """distributed-update: owned-shard gradient -> owned-shard increment."""
-        return build_owned_increment_fn(self.mesh, self.lr, self.data_size)
+        if self.optimizer is None:
+            return build_owned_increment_fn(self.mesh, self.lr, self.data_size)
+        optimizer, norm, mesh = self.optimizer, self.data_size, self.mesh
+
+        def inc(g, state):
+            state_specs = jax.tree.map(_leaf_buf_spec, state)
+
+            def body(g, state):
+                gl = g.reshape(g.shape[NUM_GRID_AXES:]) / norm
+                local = jax.tree.map(
+                    lambda l: l.reshape(l.shape[NUM_GRID_AXES:]), state
+                )
+                # params-free update: the owned param shard never materializes
+                # on the inc path (document: weight-decay-style transforms need
+                # the plain path)
+                updates, new_state = optimizer.update(gl, local)
+                grid1 = (1,) * NUM_GRID_AXES
+                return (
+                    updates.reshape(grid1 + updates.shape),
+                    jax.tree.map(lambda l: l.reshape(grid1 + l.shape), new_state),
+                )
+
+            sm = smap(
+                body, mesh,
+                in_specs=(_BUF_SPEC, state_specs),
+                out_specs=(_BUF_SPEC, state_specs),
+                check=False,
+            )
+            return sm(g, state)
+
+        return jax.jit(inc)
 
     def _build_du_apply_fn(self):
         layers, get_layer = self.layers, self.get_layer
@@ -305,19 +437,34 @@ class DataParallelTrainer:
 
     def _build_fused_fn(self, donate: bool = True):
         loss_fn, lr = self.loss_fn, self.lr
+        optimizer = self.optimizer
 
         # Donating the params lets XLA update weights in place (the trainer owns
         # self.params and always replaces it) — halves parameter HBM traffic in the
         # optimizer tail, something a caller-owned raw-JAX step cannot safely do.
-        @functools.partial(jax.jit, donate_argnums=(0,) if donate else ())
-        def fused(params, batch):
+        if optimizer is None:
+            @functools.partial(jax.jit, donate_argnums=(0,) if donate else ())
+            def fused(params, batch):
+                x, y = batch
+                x = x.reshape(x.shape[NUM_GRID_AXES:])
+                y = y.reshape(y.shape[NUM_GRID_AXES:])
+                loss, grads = jax.value_and_grad(loss_fn)(params, (x, y))
+                return loss, jax.tree.map(lambda p, g: p - lr * g, params, grads)
+
+            return fused
+
+        import optax
+
+        @functools.partial(jax.jit, donate_argnums=(0, 1) if donate else ())
+        def fused_opt(params, opt_state, batch):
             x, y = batch
             x = x.reshape(x.shape[NUM_GRID_AXES:])
             y = y.reshape(y.shape[NUM_GRID_AXES:])
             loss, grads = jax.value_and_grad(loss_fn)(params, (x, y))
-            return loss, jax.tree.map(lambda p, g: p - lr * g, params, grads)
+            updates, new_state = optimizer.update(grads, opt_state, params)
+            return loss, optax.apply_updates(params, updates), new_state
 
-        return fused
+        return fused_opt
 
     # -- data placement ----------------------------------------------------
 
@@ -340,7 +487,12 @@ class DataParallelTrainer:
 
     def step(self, batch) -> jax.Array:
         if self._fused_fn is not None:
-            loss, self.params = self._fused_fn(self.params, batch)
+            if self.optimizer is None:
+                loss, self.params = self._fused_fn(self.params, batch)
+            else:
+                loss, self.params, self._opt_state = self._fused_fn(
+                    self.params, self._opt_state, batch
+                )
             return loss
         loss, grads = self._grad_fn(self.params, batch)
 
@@ -384,14 +536,24 @@ class DataParallelTrainer:
                 ps = self.ops[name].get_parameter_set(0)
                 out = ps.wait_gradient_comm()
                 reduced[name] = out if out is not None else grads[name]
-            self.params = self._update_fn(self.params, reduced)
+            if self.optimizer is None:
+                self.params = self._update_fn(self.params, reduced)
+            else:
+                self.params, self._opt_state = self._update_fn(
+                    self.params, self._opt_state, reduced
+                )
         else:
             incs = {}
             for name in self.layers:
                 ps = self.ops[name].get_parameter_set(0)
                 owned = ps.wait_gradient_comm()
                 mlsl_assert(owned is not None, "distributed update requires dataParts>1")
-                inc_local = self._du_inc_fn(owned)
+                if self.optimizer is None:
+                    inc_local = self._du_inc_fn(owned)
+                else:
+                    inc_local, self._du_opt_state[name] = self._du_inc_fn(
+                        owned, self._du_opt_state[name]
+                    )
                 ps.start_increment_comm(inc_local)
             for name in self.layers:
                 ps = self.ops[name].get_parameter_set(0)
