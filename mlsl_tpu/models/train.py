@@ -67,6 +67,55 @@ def _leaf_buf_spec(leaf) -> P:
     return P(*GRID_AXES, *([None] * (leaf.ndim - NUM_GRID_AXES)))
 
 
+def init_shard_opt_state(topo, optimizer, count: int):
+    """Optimizer state over a flat (count,) per-rank shard, as distributed
+    buffers (scalar leaves ride as payload shape (1,))."""
+    state = optimizer.init(jnp.zeros((count,), jnp.float32))
+    grid = topo.grid_shape
+
+    def bufferize(leaf):
+        arr = np.asarray(leaf)
+        if arr.ndim == 0:
+            arr = arr.reshape(1)
+        return topo.shard_buffer(
+            np.ascontiguousarray(np.broadcast_to(arr, grid + arr.shape))
+        )
+
+    return jax.tree.map(bufferize, state)
+
+
+def build_owned_opt_increment_fn(mesh, optimizer, norm: float):
+    """Jitted (owned-shard grad buffer, state buffers) -> (increment buffer,
+    new state buffers): the optax analog of build_owned_increment_fn. The
+    transform sees each rank's flat (owned,) shard, so only elementwise/
+    shard-local transforms are correct here (see DataParallelTrainer)."""
+
+    def inc(g, state):
+        state_specs = jax.tree.map(_leaf_buf_spec, state)
+
+        def body(g, state):
+            gl = g.reshape(g.shape[NUM_GRID_AXES:]) / norm
+            local = jax.tree.map(
+                lambda l: l.reshape(l.shape[NUM_GRID_AXES:]), state
+            )
+            updates, new_state = optimizer.update(gl, local)
+            grid1 = (1,) * NUM_GRID_AXES
+            return (
+                updates.reshape(grid1 + updates.shape),
+                jax.tree.map(lambda l: l.reshape(grid1 + l.shape), new_state),
+            )
+
+        sm = smap(
+            body, mesh,
+            in_specs=(_BUF_SPEC, state_specs),
+            out_specs=(_BUF_SPEC, state_specs),
+            check=False,
+        )
+        return sm(g, state)
+
+    return jax.jit(inc)
+
+
 def _unflatten_like(tree, flat: jax.Array):
     leaves, treedef = jax.tree.flatten(tree)
     out, off = [], 0
@@ -237,24 +286,11 @@ class DataParallelTrainer:
     # -- compiled pieces ---------------------------------------------------
 
     def _init_owned_opt_state(self, name: str):
-        """Optimizer state over this layer's owned shard, as distributed buffers
-        (scalar leaves ride as payload shape (1,))."""
+        """Optimizer state over this layer's owned shard (ZeRO-1)."""
         ps = self.ops[name].get_parameter_set(0)
-        state = self.optimizer.init(
-            jnp.zeros((ps.owned_kernel_count,), jnp.float32)
+        return init_shard_opt_state(
+            self.dist.topology, self.optimizer, ps.owned_kernel_count
         )
-        topo = self.dist.topology
-        grid = topo.grid_shape
-
-        def bufferize(leaf):
-            arr = np.asarray(leaf)
-            if arr.ndim == 0:
-                arr = arr.reshape(1)
-            return topo.shard_buffer(
-                np.ascontiguousarray(np.broadcast_to(arr, grid + arr.shape))
-            )
-
-        return jax.tree.map(bufferize, state)
 
     def _build_grad_fn(self):
         layers, get_layer, loss_fn = self.layers, self.get_layer, self.loss_fn
@@ -360,35 +396,9 @@ class DataParallelTrainer:
         """distributed-update: owned-shard gradient -> owned-shard increment."""
         if self.optimizer is None:
             return build_owned_increment_fn(self.mesh, self.lr, self.data_size)
-        optimizer, norm, mesh = self.optimizer, self.data_size, self.mesh
-
-        def inc(g, state):
-            state_specs = jax.tree.map(_leaf_buf_spec, state)
-
-            def body(g, state):
-                gl = g.reshape(g.shape[NUM_GRID_AXES:]) / norm
-                local = jax.tree.map(
-                    lambda l: l.reshape(l.shape[NUM_GRID_AXES:]), state
-                )
-                # params-free update: the owned param shard never materializes
-                # on the inc path (document: weight-decay-style transforms need
-                # the plain path)
-                updates, new_state = optimizer.update(gl, local)
-                grid1 = (1,) * NUM_GRID_AXES
-                return (
-                    updates.reshape(grid1 + updates.shape),
-                    jax.tree.map(lambda l: l.reshape(grid1 + l.shape), new_state),
-                )
-
-            sm = smap(
-                body, mesh,
-                in_specs=(_BUF_SPEC, state_specs),
-                out_specs=(_BUF_SPEC, state_specs),
-                check=False,
-            )
-            return sm(g, state)
-
-        return jax.jit(inc)
+        return build_owned_opt_increment_fn(
+            self.mesh, self.optimizer, self.data_size
+        )
 
     def _build_du_apply_fn(self):
         layers, get_layer = self.layers, self.get_layer

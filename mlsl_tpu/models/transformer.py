@@ -30,7 +30,15 @@ from mlsl_tpu.comm.collectives import _BUF_SPEC
 from mlsl_tpu.comm.mesh import DATA_AXIS, MODEL_AXIS, SEQ_AXIS
 from mlsl_tpu.log import mlsl_assert
 from mlsl_tpu.models.moe import init_moe_params, moe_ffn
-from mlsl_tpu.models.train import build_owned_increment_fn, smap, _unflatten_like
+from mlsl_tpu.models.train import (
+    _leaf_buf_spec,
+    build_owned_increment_fn,
+    build_owned_opt_increment_fn,
+    init_shard_opt_state,
+    smap,
+    _unflatten_like,
+)
+from mlsl_tpu.comm.mesh import NUM_GRID_AXES
 from mlsl_tpu.parallel.sequence import ring_attention, ulysses_attention
 from mlsl_tpu.types import CompressionType, DataType, OpType
 
@@ -255,13 +263,20 @@ class HybridTrainer:
                  batch: int = None, lr: float = 0.1, seed: int = 0,
                  distributed_update: bool = False,
                  compression=None,
-                 devices=None):
+                 devices=None,
+                 optimizer=None):
+        """optimizer: optional optax.GradientTransformation; state lives per
+        layer over each rank's flat local (TP-sharded) parameter vector, or the
+        owned gradient shard under distributed_update (ZeRO-1). Elementwise/
+        shard-local transforms only (adam, momentum, ...); params-consuming
+        transforms see the flat local param vector on the plain path."""
         self.env = env
         self.cfg = cfg
         self.dp, self.sp, self.tp = dp, sp, tp
         self.batch = batch if batch is not None else dp
         mlsl_assert(self.batch % dp == 0, "batch %d %% dp %d", self.batch, dp)
         self.lr = lr
+        self.optimizer = optimizer
         self.dist = env.create_distribution(
             dp, tp, seq_parts=sp, devices=devices
         )
@@ -348,6 +363,24 @@ class HybridTrainer:
             for name in self.layers
         }
 
+        self._opt_state = None
+        self._du_opt_state = None
+        if optimizer is not None:
+            topo = self.dist.topology
+            if self.distributed_update:
+                self._du_opt_state = {
+                    n: init_shard_opt_state(
+                        topo, optimizer,
+                        self.ops[n].get_parameter_set(0).owned_kernel_count,
+                    )
+                    for n in self.layers
+                }
+            else:
+                self._opt_state = {
+                    n: init_shard_opt_state(topo, optimizer, self.local_counts[n])
+                    for n in self.layers
+                }
+
         self._grad_fn = self._build_grad_fn()
         self._update_fn = self._build_update_fn()
         self._du_inc_fn = self._build_du_inc_fn() if self.distributed_update else None
@@ -412,6 +445,8 @@ class HybridTrainer:
         return jax.jit(sm)
 
     def _build_update_fn(self):
+        if self.optimizer is not None:
+            return self._build_opt_update_fn()
         layers, lr = self.layers, self.lr
         counts = self.local_counts
         # synced grads are sums of d(CE sum)/dw over all data x seq shards; SGD on the
@@ -442,8 +477,60 @@ class HybridTrainer:
 
         return jax.jit(update)
 
+    def _build_opt_update_fn(self):
+        """optax path: each layer's optimization variable is the rank's flat
+        local (TP-sharded) parameter vector; state buffers mirror it."""
+        layers, counts = self.layers, self.local_counts
+        norm = self.batch * self.cfg.seq_len
+        optimizer = self.optimizer
+
+        def update(params, states, reduced):
+            state_specs = {
+                n: jax.tree.map(_leaf_buf_spec, states[n]) for n in layers
+            }
+
+            def body(params, states, *flat_grads):
+                new, new_states = dict(params), {}
+                grid1 = (1,) * NUM_GRID_AXES
+                for name, g in zip(layers, flat_grads):
+                    gl = g.reshape(-1)[: counts[name]] / norm
+                    local = jax.tree.map(
+                        lambda l: l.reshape(l.shape[NUM_GRID_AXES:]), states[name]
+                    )
+                    sub = params[name]
+                    flat_p = jnp.concatenate(
+                        [l.reshape(-1).astype(jnp.float32)
+                         for l in jax.tree.leaves(sub)]
+                    )
+                    updates, ns = optimizer.update(gl, local, flat_p)
+                    new[name] = jax.tree.map(
+                        lambda p, uu: (p + uu).astype(p.dtype),
+                        sub,
+                        _unflatten_like(sub, updates),
+                    )
+                    new_states[name] = jax.tree.map(
+                        lambda l: l.reshape(grid1 + l.shape), ns
+                    )
+                return new, new_states
+
+            sm = smap(
+                body,
+                self.mesh,
+                in_specs=(self.specs, state_specs)
+                + tuple(_BUF_SPEC for _ in layers),
+                out_specs=(self.specs, state_specs),
+                check=False,
+            )
+            return sm(params, states, *[reduced[n] for n in layers])
+
+        return jax.jit(update)
+
     def _build_du_inc_fn(self):
-        """distributed update: owned-shard gradient -> owned-shard SGD increment."""
+        """distributed update: owned-shard gradient -> owned-shard increment."""
+        if self.optimizer is not None:
+            return build_owned_opt_increment_fn(
+                self.mesh, self.optimizer, self.batch * self.cfg.seq_len
+            )
         return build_owned_increment_fn(
             self.mesh, self.lr, self.batch * self.cfg.seq_len
         )
@@ -496,10 +583,17 @@ class HybridTrainer:
             for name in self.layers:
                 ps = self.ops[name].get_parameter_set(0)
                 owned = ps.wait_gradient_comm()
+                src = grads[name] if owned is None else owned
+                if self.optimizer is None:
+                    inc = self._du_inc_fn(src)
+                else:
+                    inc, self._du_opt_state[name] = self._du_inc_fn(
+                        src, self._du_opt_state[name]
+                    )
                 if owned is None:  # degenerate grad group: full local increment
-                    incs[name] = self._du_inc_fn(grads[name])
-                    continue
-                ps.start_increment_comm(self._du_inc_fn(owned))
+                    incs[name] = inc
+                else:
+                    ps.start_increment_comm(inc)
             for name in self.layers:
                 ps = self.ops[name].get_parameter_set(0)
                 inc = ps.wait_increment_comm()
@@ -512,7 +606,12 @@ class HybridTrainer:
                 ps = self.ops[name].get_parameter_set(0)
                 out = ps.wait_gradient_comm()
                 reduced[name] = out if out is not None else grads[name]
-            self.params = self._update_fn(self.params, reduced)
+            if self.optimizer is None:
+                self.params = self._update_fn(self.params, reduced)
+            else:
+                self.params, self._opt_state = self._update_fn(
+                    self.params, self._opt_state, reduced
+                )
         # loss buffer holds per-(data,seq)-shard partial CE sums (replicated over the
         # model axis -> take slot 0); mean = total / (batch * seq_len)
         return jnp.sum(loss[:, :, :, 0]) / (self.batch * self.cfg.seq_len)
