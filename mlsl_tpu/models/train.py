@@ -248,14 +248,17 @@ class DataParallelTrainer:
         # distributed update (ZeRO-1: moments sharded over the data group).
         self._opt_state = None
         self._du_opt_state = None
+        self._needs_comm = needs_comm
+        self._accum_fns = None
         if optimizer is not None:
-            if distributed_update and not use_fused:
+            if distributed_update and needs_comm:
                 self._du_opt_state = {
                     n: self._init_owned_opt_state(n) for n in layers
                 }
             else:
-                # Fused shortcut (incl. distributed_update on a single data
-                # rank, where owned == full) carries replicated state.
+                # No gradient comm (single data rank, fused or forced graph
+                # path): owned == full, replicated state drives the plain
+                # update.
                 self._opt_state = jax.device_put(
                     optimizer.init(self.params), sharding
                 )
@@ -495,6 +498,30 @@ class DataParallelTrainer:
 
     # -- the training step (reference loop mlsl_test.cpp:660-698) ----------
 
+    def step_accum(self, batches) -> jax.Array:
+        """Gradient accumulation (the Caffe iter_size pattern the reference's
+        per-layer sync was built around): k local fwd/bwd passes, ONE gradient
+        sync + update. Each entry of ``batches`` is a shard_batch() result with
+        the same local minibatch size; the effective loss is the mean over all
+        k micro-batches. Returns the mean loss."""
+        mlsl_assert(len(batches) >= 1, "step_accum needs at least one batch")
+        if self._accum_fns is None:
+            def add(a, b):
+                return jax.tree.map(jnp.add, a, b)
+
+            def scale(tree, k):
+                return jax.tree.map(lambda g: g / k, tree)
+
+            self._accum_fns = (jax.jit(add), jax.jit(scale, static_argnums=1))
+        add_fn, scale_fn = self._accum_fns
+        total, loss_sum = None, None
+        for b in batches:
+            loss, grads = self._grad_fn(self.params, b)
+            total = grads if total is None else add_fn(total, grads)
+            loss_sum = loss if loss_sum is None else loss_sum + loss
+        k = len(batches)
+        return self._sync_and_update(scale_fn(total, k), loss_sum / k)
+
     def step(self, batch) -> jax.Array:
         if self._fused_fn is not None:
             if self.optimizer is None:
@@ -505,7 +532,9 @@ class DataParallelTrainer:
                 )
             return loss
         loss, grads = self._grad_fn(self.params, batch)
+        return self._sync_and_update(grads, loss)
 
+    def _sync_and_update(self, grads, loss) -> jax.Array:
         # Start gradient comms newest-gradient-first (reverse layer order), the
         # stream shape eplib's priority allreduce was built for.
         for name in reversed(self.layers):
@@ -540,7 +569,7 @@ class DataParallelTrainer:
                     apply(name, out if out is not None else grads[name])
                 pending = still
             self.params = new_params
-        elif not self.distributed_update:
+        elif not (self.distributed_update and self._needs_comm):
             reduced = {}
             for name in self.layers:
                 ps = self.ops[name].get_parameter_set(0)

@@ -189,6 +189,44 @@ def test_checkpoint_resumes_optimizer_state(env, tmp_path):
     _assert_trees_close(jax.device_get(tr2.params), want)
 
 
+@pytest.mark.parametrize("du,use_opt", [(False, False), (True, False),
+                                        (False, True), (True, True)])
+def test_grad_accumulation_equals_full_batch(env, du, use_opt):
+    """step_accum over k micro-batches == step on their concatenation (the
+    Caffe iter_size pattern: k local fwd/bwd, one sync)."""
+    opt = optax.adam(1e-2) if use_opt else None
+    rng = np.random.default_rng(7)
+    x = rng.normal(size=(32, 8)).astype(np.float32)
+    y = rng.integers(0, 4, size=(32,)).astype(np.int32)
+
+    def make(env):
+        dist = env.create_distribution(8, 1)
+        sess = env.create_session()
+        sess.set_global_minibatch_size(16)
+        return DataParallelTrainer(
+            env, dist, sess, mlp_init(jax.random.PRNGKey(0)), loss_fn, LAYERS,
+            get_layer, distributed_update=du, optimizer=opt,
+        )
+
+    tr_a = make(env)
+    la = tr_a.step_accum([
+        tr_a.shard_batch(x[:16], y[:16]), tr_a.shard_batch(x[16:], y[16:])
+    ])
+
+    dist_b = env.create_distribution(8, 1)
+    sess_b = env.create_session()
+    sess_b.set_global_minibatch_size(32)
+    tr_b = DataParallelTrainer(
+        env, dist_b, sess_b, mlp_init(jax.random.PRNGKey(0)), loss_fn, LAYERS,
+        get_layer, distributed_update=du, optimizer=opt,
+    )
+    lb = tr_b.step(tr_b.shard_batch(x, y))
+    _assert_trees_close(jax.device_get(tr_a.params), jax.device_get(tr_b.params))
+    np.testing.assert_allclose(
+        float(np.asarray(la).mean()), float(np.asarray(lb).mean()), rtol=1e-5
+    )
+
+
 HCFG = None  # built lazily: transformer import is heavier
 
 
