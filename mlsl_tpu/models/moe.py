@@ -75,22 +75,27 @@ def _route(x, wg, n_experts: int, capacity: int, top_k: int = 1):
     return dispatch, combine, aux
 
 
+def mxu_einsum(spec: str, a, b):
+    """Einsum with f32 accumulation from (possibly) bf16 operands.
+
+    On TPU this is the MXU-native contract (bf16 in, f32 out). The CPU backend
+    cannot execute mixed bf16->f32 dots ("Unsupported element type for
+    DotThunk"), so there the dot runs in the operand dtype and the result is
+    cast — bf16 on CPU is a simulation path, not a precision contract."""
+    if jax.default_backend() == "cpu":
+        return jnp.einsum(spec, a, b).astype(jnp.float32)
+    return jnp.einsum(spec, a, b, preferred_element_type=jnp.float32)
+
+
 def _expert_ffn(buf, w1, w2, compute_dtype=jnp.float32):
     """buf: (..., El, C, D); w1: (El, D, F); w2: (El, F, D).
 
     With a bf16 compute_dtype the expert matmuls run bf16-in/f32-accumulate
     (MXU-native); dispatch, combine and the gate always stay f32 for routing
-    stability. f32 stays all-f32 (the CPU backend cannot execute mixed
-    bf16->f32 dots)."""
+    stability."""
     cdt = jnp.dtype(compute_dtype)
-    h = jax.nn.gelu(jnp.einsum(
-        "...ecd,edf->...ecf", buf.astype(cdt), w1.astype(cdt),
-        preferred_element_type=jnp.float32,
-    ))
-    return jnp.einsum(
-        "...ecf,efd->...ecd", h.astype(cdt), w2.astype(cdt),
-        preferred_element_type=jnp.float32,
-    )
+    h = jax.nn.gelu(mxu_einsum("...ecd,edf->...ecf", buf.astype(cdt), w1.astype(cdt)))
+    return mxu_einsum("...ecf,efd->...ecd", h.astype(cdt), w2.astype(cdt))
 
 
 def moe_ffn(

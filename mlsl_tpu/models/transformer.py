@@ -29,7 +29,7 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 from mlsl_tpu.comm.collectives import _BUF_SPEC
 from mlsl_tpu.comm.mesh import DATA_AXIS, MODEL_AXIS, SEQ_AXIS
 from mlsl_tpu.log import mlsl_assert
-from mlsl_tpu.models.moe import init_moe_params, moe_ffn
+from mlsl_tpu.models.moe import init_moe_params, moe_ffn, mxu_einsum
 from mlsl_tpu.models.train import (
     _leaf_buf_spec,
     build_owned_increment_fn,
@@ -186,10 +186,7 @@ def forward_local(params, tokens, cfg: TransformerConfig, sp: int, tp: int):
         attn = attn_fn(q, k, v, SEQ_AXIS, sp, causal=True)
         # bf16 operands, f32 accumulate/output: keeps the projection on the MXU's
         # native path while the residual add and TP psum stay f32.
-        o = jnp.einsum(
-            "bhsx,hxd->bsd", attn.astype(cdt), ap["wo"].astype(cdt),
-            preferred_element_type=jnp.float32,
-        )
+        o = mxu_einsum("bhsx,hxd->bsd", attn.astype(cdt), ap["wo"].astype(cdt))
         o = lax.psum(o, MODEL_AXIS) if tp > 1 else o      # TP reduction (case-2 analog)
         h = (h.astype(jnp.float32) + o).astype(cdt)
 
@@ -208,10 +205,7 @@ def forward_local(params, tokens, cfg: TransformerConfig, sp: int, tp: int):
                 jnp.einsum("bsd,df->bsf", a, mp["w1"].astype(cdt))
                 + mp["b1"].astype(cdt)
             )
-            o = jnp.einsum(
-                "bsf,fd->bsd", f, mp["w2"].astype(cdt),
-                preferred_element_type=jnp.float32,
-            )
+            o = mxu_einsum("bsf,fd->bsd", f, mp["w2"].astype(cdt))
             o = lax.psum(o, MODEL_AXIS) if tp > 1 else o
             h = (h.astype(jnp.float32) + o + mp["b2"]).astype(cdt)
 

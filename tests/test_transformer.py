@@ -170,3 +170,21 @@ def test_hybrid_ulysses_variant(env):
     for _ in range(5):
         l5 = float(trainer.step(st, sl_))
     assert np.isfinite(l0) and l5 < l0  # memorizing a fixed batch must reduce loss
+
+
+def test_bf16_config_runs_on_cpu_mesh(env):
+    """The production bf16 dtype must stay executable on the CPU simulation mesh
+    (mixed bf16->f32 dots are unsupported there; mxu_einsum guards this).
+    Regression: the multichip dryrun uses the default bf16 config."""
+    cfg = tfm.TransformerConfig(
+        vocab=32, d_model=16, n_heads=4, head_dim=4, n_blocks=1, seq_len=16,
+        n_experts=2,
+    )
+    assert cfg.dtype == "bfloat16"
+    tr = tfm.HybridTrainer(env, cfg, 2, 1, 2, batch=2, lr=0.1,
+                           devices=env.devices[:4])
+    rng = np.random.default_rng(0)
+    toks = rng.integers(0, 32, size=(2, 16)).astype(np.int32)
+    st, sl = tr.shard_tokens(toks, np.roll(toks, -1, axis=1))
+    loss = tr.step(st, sl)
+    assert np.isfinite(float(np.asarray(loss))), loss
