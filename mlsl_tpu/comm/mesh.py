@@ -127,6 +127,19 @@ class Topology:
         )
         return jax.device_put(array, self.buffer_sharding(array.ndim - NUM_GRID_AXES))
 
+    def shard_buffer_local(self, local_block, global_shape) -> jax.Array:
+        """Multi-process buffer construction: each host passes ONLY the block
+        covering its addressable devices' (r, d, s, m) coordinates (the
+        process-local portion of ``global_shape``), and no host ever
+        materializes the full global array — the multi-host input-pipeline
+        analog of the reference's file-IO offload streaming into local shm
+        (eplib ENABLE_FILEIO)."""
+        return jax.make_array_from_process_local_data(
+            self.buffer_sharding(len(global_shape) - NUM_GRID_AXES),
+            np.ascontiguousarray(local_block),
+            global_shape,
+        )
+
     def adopt_buffer(self, buf: jax.Array) -> jax.Array:
         """Re-view a distributed buffer laid out for ANOTHER topology over the same
         devices as this topology's (R, D, S, M, n) layout.

@@ -496,6 +496,36 @@ class DataParallelTrainer:
         )
         return topo.shard_buffer(xs), topo.shard_buffer(ys)
 
+    def shard_batch_local(self, x: np.ndarray, y: np.ndarray):
+        """Multi-host batch placement: x/y are THIS process's contiguous rows of
+        the global batch (global_batch / process_count rows each); no host
+        materializes the full batch. Requires the data-rank count (r*d) to be
+        divisible by the process count with replica-major contiguity (r == 1 or
+        process_count dividing r)."""
+        topo = self.dist.topology
+        r, d, s, m = topo.grid_shape
+        nproc = jax.process_count()
+        mlsl_assert(
+            (r * d) % nproc == 0 and (r == 1 or r % nproc == 0),
+            "data ranks (r=%d x d=%d) must split contiguously over %d processes",
+            r, d, nproc,
+        )
+        rd_local = (r * d) // nproc
+        local_b = x.shape[0] // rd_local
+        r_loc = max(1, r // nproc)
+        d_loc = rd_local // r_loc
+        xs = np.broadcast_to(
+            x.reshape(r_loc, d_loc, 1, 1, local_b, *x.shape[1:]),
+            (r_loc, d_loc, s, m, local_b, *x.shape[1:]),
+        )
+        ys = np.broadcast_to(
+            y.reshape(r_loc, d_loc, 1, 1, local_b, *y.shape[1:]),
+            (r_loc, d_loc, s, m, local_b, *y.shape[1:]),
+        )
+        gx = (r, d, s, m, local_b, *x.shape[1:])
+        gy = (r, d, s, m, local_b, *y.shape[1:])
+        return topo.shard_buffer_local(xs, gx), topo.shard_buffer_local(ys, gy)
+
     # -- the training step (reference loop mlsl_test.cpp:660-698) ----------
 
     def step_accum(self, batches) -> jax.Array:

@@ -69,6 +69,23 @@ loss = tr.step(tr.shard_batch(x, y))
 jax.block_until_ready(tr.params)
 lv = float(np.asarray(loss.addressable_shards[0].data).ravel()[0])
 assert np.isfinite(lv), lv
+
+# multi-host input pipeline: each host feeds ONLY its rows; must land on the
+# same trajectory as the full-batch shard_batch path
+sess2 = env.create_session()
+sess2.set_global_minibatch_size(16)
+tr2 = DataParallelTrainer(
+    env, dist, sess2, mlp_init(jax.random.PRNGKey(0)), loss_fn, LAYERS,
+    get_layer, lr=0.1,
+)
+half = 16 // 2
+lo = pid * half
+tr2.step(tr2.shard_batch_local(x[lo : lo + half], y[lo : lo + half]))
+jax.block_until_ready(tr2.params)
+for a, b in zip(jax.tree.leaves(tr.params), jax.tree.leaves(tr2.params)):
+    np.testing.assert_allclose(
+        np.asarray(a.addressable_shards[0].data),
+        np.asarray(b.addressable_shards[0].data), atol=1e-6)
 # grad sync must leave every host with identical (replicated) params
 leaves = jax.tree.leaves(tr.params)
 csum = float(sum(np.asarray(l.addressable_shards[0].data).astype(np.float64).sum()

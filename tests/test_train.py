@@ -204,3 +204,25 @@ def test_resnet50_smoke():
     total = sum(counts.values())
     # ResNet-50 has ~25.6M params at 1000 classes; at 10 classes ~23.5M
     assert 20_000_000 < total < 30_000_000
+
+
+def test_shard_batch_local_single_process(env):
+    """With one process, shard_batch_local(whole batch) == shard_batch."""
+    import jax
+
+    from mlsl_tpu.models.mlp import LAYERS, get_layer, init as mlp_init, loss_fn
+    from mlsl_tpu.models.train import DataParallelTrainer
+
+    dist = env.create_distribution(8, 1)
+    sess = env.create_session()
+    sess.set_global_minibatch_size(16)
+    tr = DataParallelTrainer(
+        env, dist, sess, mlp_init(jax.random.PRNGKey(0)), loss_fn, LAYERS,
+        get_layer,
+    )
+    rng = np.random.default_rng(0)
+    x = rng.normal(size=(16, 8)).astype(np.float32)
+    y = rng.integers(0, 4, size=(16,)).astype(np.int32)
+    ga, gb = tr.shard_batch(x, y), tr.shard_batch_local(x, y)
+    np.testing.assert_array_equal(np.asarray(ga[0]), np.asarray(gb[0]))
+    np.testing.assert_array_equal(np.asarray(ga[1]), np.asarray(gb[1]))
