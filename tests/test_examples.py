@@ -32,3 +32,10 @@ def test_train_transformer_example_runs():
     assert r.returncode == 0, r.stderr[-2000:]
     assert "transformer example OK" in r.stdout
     assert "checkpoint restored from step 10" in r.stdout
+
+
+def test_train_zero1_adam_example_runs():
+    r = _run_example("train_zero1_adam.py")
+    assert r.returncode == 0, r.stderr[-2000:]
+    assert "OK" in r.stdout
+    assert "resumed from step 2" in r.stdout
