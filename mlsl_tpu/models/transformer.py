@@ -567,8 +567,33 @@ class HybridTrainer:
             jax.device_put(jnp.asarray(labels), sharding),
         )
 
+    def step_accum(self, batches):
+        """Gradient accumulation: k local fwd/bwd passes over (tokens, labels)
+        pairs, one gradient sync + update (Caffe iter_size pattern). The
+        effective objective is the mean over all k micro-batches."""
+        mlsl_assert(len(batches) >= 1, "step_accum needs at least one batch")
+        if getattr(self, "_accum_fns", None) is None:
+            def add(a, b):
+                return jax.tree.map(jnp.add, a, b)
+
+            def scale(tree, k):
+                return jax.tree.map(lambda g: g / k, tree)
+
+            self._accum_fns = (jax.jit(add), jax.jit(scale, static_argnums=1))
+        add_fn, scale_fn = self._accum_fns
+        total, loss_sum = None, None
+        for tokens, labels in batches:
+            loss, grads = self._grad_fn(self.params, tokens, labels)
+            total = grads if total is None else add_fn(total, grads)
+            loss_sum = loss if loss_sum is None else loss_sum + loss
+        k = len(batches)
+        return self._sync_and_update(scale_fn(total, k), loss_sum) / k
+
     def step(self, tokens, labels):
         loss, grads = self._grad_fn(self.params, tokens, labels)
+        return self._sync_and_update(grads, loss)
+
+    def _sync_and_update(self, grads, loss):
         for name in reversed(self.layers):
             self.ops[name].get_parameter_set(0).start_gradient_comm(grads[name])
         if self.distributed_update:

@@ -288,6 +288,36 @@ def test_hybrid_adam_matches_oracle(env, dp, sp, tp, du):
     _assert_params_close(tr, want, atol=2e-4, rtol=2e-4)
 
 
+def test_hybrid_grad_accumulation(env):
+    """HybridTrainer.step_accum: two identical micro-batches == one step on the
+    same batch (identical grads after averaging), Adam + ZeRO-1."""
+    from mlsl_tpu.models import transformer as tfm
+
+    cfg = _hybrid_cfg()
+    rng = np.random.default_rng(9)
+    toks = rng.integers(0, 32, size=(4, 16)).astype(np.int32)
+    labels = np.roll(toks, -1, axis=1)
+
+    def make():
+        return tfm.HybridTrainer(env, cfg, 2, 2, 2, batch=4, seed=0,
+                                 distributed_update=True,
+                                 optimizer=optax.adam(1e-2))
+
+    tr_a = make()
+    st, sl = tr_a.shard_tokens(toks, labels)
+    la = tr_a.step_accum([(st, sl), (st, sl)])
+
+    tr_b = make()
+    st2, sl2 = tr_b.shard_tokens(toks, labels)
+    lb = tr_b.step(st2, sl2)
+
+    np.testing.assert_allclose(float(np.asarray(la)), float(np.asarray(lb)),
+                               rtol=1e-6)
+    from tests.test_transformer import _assert_params_close
+
+    _assert_params_close(tr_a, tr_b.params, atol=1e-6, rtol=1e-6)
+
+
 def test_optimizer_rejects_overlap(env):
     from mlsl_tpu.log import MLSLError
 
