@@ -47,24 +47,65 @@ def _flatten_layer(tree) -> jax.Array:
     return jnp.concatenate([l.reshape(-1).astype(jnp.float32) for l in leaves])
 
 
-def build_owned_increment_fn(mesh, lr: float, norm: float):
+def build_owned_increment_fn(mesh, lr: float, norm: float, with_scale: bool = False):
     """Jitted fn: owned-shard gradient buffer -> owned-shard SGD increment
-    (-lr * g / norm), shared by every distributed-update trainer."""
+    (-lr * g / norm), shared by every distributed-update trainer. With
+    with_scale the fn takes an extra replicated scalar multiplied into the
+    gradient (global-norm clipping)."""
+
+    def body(g, s):
+        return (-lr * s * g.reshape(g.shape[NUM_GRID_AXES:]) / norm)[
+            None, None, None, None
+        ]
+
+    if with_scale:
+        def inc_s(g, s):
+            return smap(
+                body, mesh, in_specs=(_BUF_SPEC, P()), out_specs=_BUF_SPEC
+            )(g, s)
+
+        return jax.jit(inc_s)
 
     def inc(g):
-        def body(g):
-            return (-lr * g.reshape(g.shape[NUM_GRID_AXES:]) / norm)[
-                None, None, None, None
-            ]
-
-        return smap(body, mesh, in_specs=_BUF_SPEC, out_specs=_BUF_SPEC)(g)
+        return smap(
+            lambda g: body(g, 1.0), mesh, in_specs=_BUF_SPEC, out_specs=_BUF_SPEC
+        )(g)
 
     return jax.jit(inc)
+
+
+def build_owned_norm_fn(mesh, norm: float, grad_axes=(DATA_AXIS, SEQ_AXIS)):
+    """Jitted fn: dict of owned-shard gradient buffers -> global L2 norm of the
+    mean gradient (replicated scalar). Owned shards partition the parameters
+    across the gradient group, so sq-sum locally + psum = the full norm — the
+    cross-shard reduction ZeRO-1 global-norm clipping needs."""
+
+    def gnorm(owned):
+        names = sorted(owned)
+
+        def body(*gs):
+            local = sum(jnp.sum((g / norm) ** 2) for g in gs)
+            return jnp.sqrt(jax.lax.psum(local, grad_axes))
+
+        sm = smap(
+            body, mesh,
+            in_specs=tuple(_BUF_SPEC for _ in names),
+            out_specs=P(),
+            check=False,
+        )
+        return sm(*[owned[n] for n in names])
+
+    return jax.jit(gnorm)
 
 
 def _leaf_buf_spec(leaf) -> P:
     """PartitionSpec for a distributed buffer with arbitrary payload rank."""
     return P(*GRID_AXES, *([None] * (leaf.ndim - NUM_GRID_AXES)))
+
+
+def _clip_scale(sq_norm, clip: float):
+    """Scale factor applying an L2 gradient clip: min(1, clip / norm)."""
+    return jnp.minimum(1.0, clip / jnp.maximum(jnp.sqrt(sq_norm), 1e-12))
 
 
 def init_shard_opt_state(topo, optimizer, count: int):
@@ -84,29 +125,42 @@ def init_shard_opt_state(topo, optimizer, count: int):
     return jax.tree.map(bufferize, state)
 
 
-def build_owned_opt_increment_fn(mesh, optimizer, norm: float):
-    """Jitted (owned-shard grad buffer, state buffers) -> (increment buffer,
-    new state buffers): the optax analog of build_owned_increment_fn. The
-    transform sees each rank's flat (owned,) shard, so only elementwise/
+def build_owned_opt_increment_fn(mesh, optimizer, norm: float,
+                                 with_scale: bool = False):
+    """Jitted (owned-shard grad buffer, state buffers[, scale]) -> (increment
+    buffer, new state buffers): the optax analog of build_owned_increment_fn.
+    The transform sees each rank's flat (owned,) shard, so only elementwise/
     shard-local transforms are correct here (see DataParallelTrainer)."""
+
+    def body(g, state, s):
+        gl = s * g.reshape(g.shape[NUM_GRID_AXES:]) / norm
+        local = jax.tree.map(
+            lambda l: l.reshape(l.shape[NUM_GRID_AXES:]), state
+        )
+        updates, new_state = optimizer.update(gl, local)
+        grid1 = (1,) * NUM_GRID_AXES
+        return (
+            updates.reshape(grid1 + updates.shape),
+            jax.tree.map(lambda l: l.reshape(grid1 + l.shape), new_state),
+        )
+
+    if with_scale:
+        def inc_s(g, state, s):
+            state_specs = jax.tree.map(_leaf_buf_spec, state)
+            sm = smap(
+                body, mesh,
+                in_specs=(_BUF_SPEC, state_specs, P()),
+                out_specs=(_BUF_SPEC, state_specs),
+                check=False,
+            )
+            return sm(g, state, s)
+
+        return jax.jit(inc_s)
 
     def inc(g, state):
         state_specs = jax.tree.map(_leaf_buf_spec, state)
-
-        def body(g, state):
-            gl = g.reshape(g.shape[NUM_GRID_AXES:]) / norm
-            local = jax.tree.map(
-                lambda l: l.reshape(l.shape[NUM_GRID_AXES:]), state
-            )
-            updates, new_state = optimizer.update(gl, local)
-            grid1 = (1,) * NUM_GRID_AXES
-            return (
-                updates.reshape(grid1 + updates.shape),
-                jax.tree.map(lambda l: l.reshape(grid1 + l.shape), new_state),
-            )
-
         sm = smap(
-            body, mesh,
+            lambda g, st: body(g, st, 1.0), mesh,
             in_specs=(_BUF_SPEC, state_specs),
             out_specs=(_BUF_SPEC, state_specs),
             check=False,
@@ -156,6 +210,7 @@ class DataParallelTrainer:
         overlap_updates: bool = False,
         force_graph_path: bool = False,
         optimizer=None,
+        clip_global_norm: Optional[float] = None,
     ):
         """optimizer: an optax.GradientTransformation (e.g. optax.adam(lr)).
         None keeps the built-in SGD (p - lr * mean_grad). With
@@ -166,7 +221,13 @@ class DataParallelTrainer:
         it is correct only for elementwise/shard-local transforms (adam, sgd
         with momentum, rmsprop, ...); params-consuming (weight decay) or
         cross-shard/shape-dependent transforms (clip_by_global_norm, adafactor)
-        need the plain path — they would silently see per-shard views here."""
+        need the plain path — they would silently see per-shard views here.
+
+        clip_global_norm: clip the (mean) gradient to this global L2 norm
+        BEFORE the optimizer — on every path, including ZeRO-1, where the norm
+        is assembled from per-rank owned-shard partials via a psum over the
+        gradient group (the cross-shard reduction a black-box optax
+        clip_by_global_norm cannot perform there)."""
         self.env = env
         self.dist = dist
         self.session = session
@@ -175,6 +236,7 @@ class DataParallelTrainer:
         self.get_layer = get_layer
         self.lr = lr
         self.optimizer = optimizer
+        self.clip_global_norm = clip_global_norm
         self.mesh = dist.topology.mesh
         mlsl_assert(
             not (optimizer is not None and overlap_updates),
@@ -250,6 +312,7 @@ class DataParallelTrainer:
         self._du_opt_state = None
         self._needs_comm = needs_comm
         self._accum_fns = None
+        self._du_norm_fn = None
         if optimizer is not None:
             if distributed_update and needs_comm:
                 self._du_opt_state = {
@@ -328,12 +391,24 @@ class DataParallelTrainer:
         layers, get_layer = self.layers, self.get_layer
         data_size, lr = self.data_size, self.lr
         counts = self.layer_counts
+        clip = self.clip_global_norm
 
         def update(params, reduced: Dict[str, jax.Array]):
             def body(params, *flat_grads):
+                cscale = (
+                    _clip_scale(
+                        sum(
+                            jnp.sum((g.reshape(-1)[: counts[n]] / data_size) ** 2)
+                            for n, g in zip(layers, flat_grads)
+                        ),
+                        clip,
+                    )
+                    if clip is not None
+                    else 1.0
+                )
                 new = params
                 for name, g in zip(layers, flat_grads):
-                    g = g.reshape(-1)[: counts[name]] / data_size
+                    g = g.reshape(-1)[: counts[name]] / data_size * cscale
                     sub = get_layer(new, name)
                     new_sub = jax.tree.map(
                         lambda p, gg: p - lr * gg,
@@ -361,6 +436,7 @@ class DataParallelTrainer:
         layers, get_layer = self.layers, self.get_layer
         data_size, counts = self.data_size, self.layer_counts
         optimizer = self.optimizer
+        clip = self.clip_global_norm
 
         def update(params, opt_state, reduced: Dict[str, jax.Array]):
             def body(params, opt_state, *flat_grads):
@@ -369,6 +445,11 @@ class DataParallelTrainer:
                     g = g.reshape(-1)[: counts[name]] / data_size
                     sub = get_layer(params, name)
                     grads = _set_layer(grads, name, _unflatten_like(sub, g))
+                if clip is not None:
+                    cscale = _clip_scale(
+                        sum(jnp.sum(g ** 2) for g in jax.tree.leaves(grads)), clip
+                    )
+                    grads = jax.tree.map(lambda g: g * cscale, grads)
                 updates, new_state = optimizer.update(grads, opt_state, params)
                 # Apply only to registered layers: leaves outside `layers`
                 # (frozen params) must stay untouched even under
@@ -397,10 +478,13 @@ class DataParallelTrainer:
 
     def _build_du_inc_fn(self):
         """distributed-update: owned-shard gradient -> owned-shard increment."""
+        with_scale = self.clip_global_norm is not None
         if self.optimizer is None:
-            return build_owned_increment_fn(self.mesh, self.lr, self.data_size)
+            return build_owned_increment_fn(
+                self.mesh, self.lr, self.data_size, with_scale=with_scale
+            )
         return build_owned_opt_increment_fn(
-            self.mesh, self.optimizer, self.data_size
+            self.mesh, self.optimizer, self.data_size, with_scale=with_scale
         )
 
     def _build_du_apply_fn(self):
@@ -451,6 +535,16 @@ class DataParallelTrainer:
     def _build_fused_fn(self, donate: bool = True):
         loss_fn, lr = self.loss_fn, self.lr
         optimizer = self.optimizer
+        clip = self.clip_global_norm
+
+        def _clipped(grads):
+            if clip is None:
+                return grads
+            cscale = _clip_scale(
+                sum(jnp.sum(g.astype(jnp.float32) ** 2)
+                    for g in jax.tree.leaves(grads)), clip
+            )
+            return jax.tree.map(lambda g: g * cscale, grads)
 
         # Donating the params lets XLA update weights in place (the trainer owns
         # self.params and always replaces it) — halves parameter HBM traffic in the
@@ -462,6 +556,7 @@ class DataParallelTrainer:
                 x = x.reshape(x.shape[NUM_GRID_AXES:])
                 y = y.reshape(y.shape[NUM_GRID_AXES:])
                 loss, grads = jax.value_and_grad(loss_fn)(params, (x, y))
+                grads = _clipped(grads)
                 return loss, jax.tree.map(lambda p, g: p - lr * g, params, grads)
 
             return fused
@@ -474,7 +569,7 @@ class DataParallelTrainer:
             x = x.reshape(x.shape[NUM_GRID_AXES:])
             y = y.reshape(y.shape[NUM_GRID_AXES:])
             loss, grads = jax.value_and_grad(loss_fn)(params, (x, y))
-            updates, new_state = optimizer.update(grads, opt_state, params)
+            updates, new_state = optimizer.update(_clipped(grads), opt_state, params)
             return loss, optax.apply_updates(params, updates), new_state
 
         return fused_opt
@@ -613,15 +708,39 @@ class DataParallelTrainer:
                 )
         else:
             incs = {}
+            owned_all, scale_args = {}, ()
+            if self.clip_global_norm is not None:
+                # Global-norm clipping needs every owned shard before any
+                # increment: wait all, psum the shard norms, then scale.
+                for name in self.layers:
+                    ps = self.ops[name].get_parameter_set(0)
+                    owned_all[name] = ps.wait_gradient_comm()
+                    mlsl_assert(
+                        owned_all[name] is not None,
+                        "distributed update requires dataParts>1",
+                    )
+                if self._du_norm_fn is None:
+                    self._du_norm_fn = build_owned_norm_fn(
+                        self.mesh, self.data_size
+                    )
+                cscale = _clip_scale(
+                    self._du_norm_fn(owned_all) ** 2, self.clip_global_norm
+                )
+                scale_args = (cscale,)
             for name in self.layers:
                 ps = self.ops[name].get_parameter_set(0)
-                owned = ps.wait_gradient_comm()
-                mlsl_assert(owned is not None, "distributed update requires dataParts>1")
+                if name in owned_all:
+                    owned = owned_all[name]
+                else:
+                    owned = ps.wait_gradient_comm()
+                    mlsl_assert(
+                        owned is not None, "distributed update requires dataParts>1"
+                    )
                 if self.optimizer is None:
-                    inc_local = self._du_inc_fn(owned)
+                    inc_local = self._du_inc_fn(owned, *scale_args)
                 else:
                     inc_local, self._du_opt_state[name] = self._du_inc_fn(
-                        owned, self._du_opt_state[name]
+                        owned, self._du_opt_state[name], *scale_args
                     )
                 ps.start_increment_comm(inc_local)
             for name in self.layers:

@@ -227,6 +227,69 @@ def test_grad_accumulation_equals_full_batch(env, du, use_opt):
     )
 
 
+@pytest.mark.parametrize("du", [False, True])
+def test_clip_global_norm_matches_optax_chain(env, du):
+    """clip_global_norm=c + adam == single-device chain(clip_by_global_norm(c),
+    adam) — incl. the ZeRO-1 path, where the norm is psum'd from owned
+    shards."""
+    c = 0.1  # binds: initial MLP grad norms exceed this
+    inner = optax.adam(1e-2)
+    dist = env.create_distribution(8, 1)
+    sess = env.create_session()
+    sess.set_global_minibatch_size(BATCH)
+    tr = DataParallelTrainer(
+        env, dist, sess, mlp_init(jax.random.PRNGKey(0)), loss_fn, LAYERS,
+        get_layer, distributed_update=du, optimizer=inner, clip_global_norm=c,
+    )
+    xs, ys = _data()
+    for x, y in zip(xs, ys):
+        tr.step(tr.shard_batch(x, y))
+    want = _oracle(optax.chain(optax.clip_by_global_norm(c), optax.adam(1e-2)))
+    _assert_trees_close(jax.device_get(tr.params), want)
+
+
+def test_clip_global_norm_fused_single_device(env):
+    """The fused (no-comm) jit applies the same clip."""
+    c = 0.1
+    dist = env.create_distribution(1, 1, devices=env.devices[:1])
+    sess = env.create_session()
+    sess.set_global_minibatch_size(BATCH)
+    tr = DataParallelTrainer(
+        env, dist, sess, mlp_init(jax.random.PRNGKey(0)), loss_fn, LAYERS,
+        get_layer, optimizer=optax.adam(1e-2), clip_global_norm=c,
+    )
+    assert tr._fused_fn is not None
+    xs, ys = _data()
+    for x, y in zip(xs, ys):
+        tr.step(tr.shard_batch(x, y))
+    want = _oracle(optax.chain(optax.clip_by_global_norm(c), optax.adam(1e-2)))
+    _assert_trees_close(jax.device_get(tr.params), want)
+
+
+@pytest.mark.parametrize("du", [False, True])
+def test_clip_global_norm_sgd(env, du):
+    """Built-in SGD + clip_global_norm vs a manual clipped-SGD loop."""
+    c, lr = 0.1, 5e-2
+    dist = env.create_distribution(8, 1)
+    sess = env.create_session()
+    sess.set_global_minibatch_size(BATCH)
+    tr = DataParallelTrainer(
+        env, dist, sess, mlp_init(jax.random.PRNGKey(0)), loss_fn, LAYERS,
+        get_layer, distributed_update=du, lr=lr, clip_global_norm=c,
+    )
+    xs, ys = _data()
+    for x, y in zip(xs, ys):
+        tr.step(tr.shard_batch(x, y))
+
+    params = mlp_init(jax.random.PRNGKey(0))
+    for x, y in zip(xs, ys):
+        g = jax.grad(loss_fn)(params, (jnp.asarray(x), jnp.asarray(y)))
+        gn = jnp.sqrt(sum(jnp.sum(l ** 2) for l in jax.tree.leaves(g)))
+        s = jnp.minimum(1.0, c / gn)
+        params = jax.tree.map(lambda p, gg: p - lr * s * gg, params, g)
+    _assert_trees_close(jax.device_get(tr.params), params)
+
+
 HCFG = None  # built lazily: transformer import is heavier
 
 
