@@ -23,7 +23,7 @@ import numpy as np
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--quick", action="store_true", help="tiny shapes (CI/CPU)")
-    ap.add_argument("--iters", type=int, default=36)
+    ap.add_argument("--iters", type=int, default=54)
     ap.add_argument("--warmup", type=int, default=6)
     args = ap.parse_args()
 

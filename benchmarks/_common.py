@@ -1,0 +1,53 @@
+"""Shared preamble for on-chip benchmark scripts.
+
+`setup_chip(tag)` fail-fast-probes the accelerator tunnel in a subprocess (a
+dead tunnel hangs uninterruptibly inside backend init — bench.py:30-35), then
+applies the platform override and the persistent compilation cache, and returns
+the imported jax module.
+"""
+
+import os
+import subprocess
+import sys
+import time
+
+REPO_ROOT = os.path.join(os.path.dirname(os.path.abspath(__file__)), "..")
+sys.path.insert(0, REPO_ROOT)
+
+
+def probe_accelerator(tag: str, timeout: float = 180.0) -> None:
+    src = (
+        "from mlsl_tpu.sysinfo import apply_platform_override\n"
+        "apply_platform_override()\n"
+        "import jax.numpy as jnp\n"
+        "jnp.ones((8, 8)).sum().block_until_ready(); print('ok', flush=True)"
+    )
+    child = subprocess.Popen(
+        [sys.executable, "-c", src], stdout=subprocess.PIPE,
+        stderr=subprocess.PIPE, text=True, start_new_session=True,
+        cwd=REPO_ROOT,
+    )
+    deadline = time.time() + timeout
+    while child.poll() is None and time.time() < deadline:
+        time.sleep(1)
+    if child.poll() is None:
+        child.kill()  # best effort; a D-state child never reaps, so don't wait()
+        print(f"{tag}: accelerator unreachable", file=sys.stderr)
+        sys.exit(3)
+    if child.returncode != 0:
+        print(f"{tag}: probe failed:\n{child.stderr.read()[-500:]}", file=sys.stderr)
+        sys.exit(3)
+
+
+def setup_chip(tag: str):
+    probe_accelerator(tag)
+    from mlsl_tpu.sysinfo import apply_platform_override
+
+    apply_platform_override()
+    import jax
+
+    jax.config.update(
+        "jax_compilation_cache_dir", os.path.join(REPO_ROOT, ".jax_cache")
+    )
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 5)
+    return jax

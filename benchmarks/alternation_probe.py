@@ -1,0 +1,77 @@
+"""Probe: does alternating between compiled programs slow each one down?
+
+bench.py times fw/raw/per-layer in alternating blocks; standalone runs of the
+same step once measured ~2x faster. This isolates whether program switching
+itself costs milliseconds (HBM re-paging of weights between resident programs).
+
+Measured (v5e, batch 32): solo blocks are just as bimodal (~19-30 ms) as
+alternating ones — the variance is shared-tunnel load, not program switching.
+"""
+
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), ".."))
+
+from benchmarks._common import setup_chip
+
+jax = setup_chip("alternation_probe")
+
+import jax.numpy as jnp
+
+from mlsl_tpu.models import resnet
+
+
+def main():
+    lr = 0.05
+    params = jax.device_put(resnet.init_resnet50(jax.random.PRNGKey(0), 1000))
+    params2 = jax.tree.map(jnp.copy, params)
+    rng = np.random.default_rng(0)
+    batch = 32
+    x = jax.device_put(jnp.asarray(rng.normal(size=(batch, 224, 224, 3)), jnp.float32))
+    y = jax.device_put(jnp.asarray(rng.integers(0, 1000, size=(batch,)), jnp.int32))
+
+    @jax.jit
+    def sgd(p, b):
+        loss, g = jax.value_and_grad(resnet.loss_fn)(p, b)
+        return loss, jax.tree.map(lambda w, gg: w - lr * gg, p, g)
+
+    # a second, distinct executable over its own param copy (like bench's 3 sides)
+    @jax.jit
+    def sgd_b(p, b):
+        loss, g = jax.value_and_grad(resnet.loss_fn)(p, b)
+        return loss, jax.tree.map(lambda w, gg: w - lr * gg * 0.999, p, g)
+
+    def block(fn, p, iters):
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            _, p = fn(p, (x, y))
+        jax.block_until_ready(p)
+        return (time.perf_counter() - t0) / iters * 1e3, p
+
+    for _ in range(4):
+        _, params = sgd(params, (x, y))
+        _, params2 = sgd_b(params2, (x, y))
+    jax.block_until_ready((params, params2))
+
+    solo = []
+    for _ in range(9):
+        ms, params = block(sgd, params, 4)
+        solo.append(ms)
+    print("solo      blocks:", " ".join(f"{m:6.2f}" for m in solo))
+
+    alt_a, alt_b = [], []
+    for _ in range(9):
+        ms, params = block(sgd, params, 4)
+        alt_a.append(ms)
+        ms, params2 = block(sgd_b, params2, 4)
+        alt_b.append(ms)
+    print("alternate A blocks:", " ".join(f"{m:6.2f}" for m in alt_a))
+    print("alternate B blocks:", " ".join(f"{m:6.2f}" for m in alt_b))
+
+
+if __name__ == "__main__":
+    main()

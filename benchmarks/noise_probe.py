@@ -1,0 +1,65 @@
+"""Probe: tunnel/device noise profile over many blocks.
+
+Runs 30 blocks of 6 SGD steps and prints each block mean with a timestamp, to
+see whether the slow mode is bursty (median ok) or persistent (min-of-blocks is
+the only stable capability estimator).
+
+Measured (v5e, batch 32): block means swing 18-25 ms on a seconds timescale
+with no trend — bursty shared-tunnel load. bench.py therefore reports the
+median of many 6-iter blocks plus a best_ms capability estimate.
+"""
+
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), ".."))
+
+from benchmarks._common import setup_chip
+
+jax = setup_chip("noise_probe")
+
+import jax.numpy as jnp
+
+from mlsl_tpu.models import resnet
+
+
+def main():
+    lr = 0.05
+    params = jax.device_put(resnet.init_resnet50(jax.random.PRNGKey(0), 1000))
+    rng = np.random.default_rng(0)
+    x = jax.device_put(jnp.asarray(rng.normal(size=(32, 224, 224, 3)), jnp.float32))
+    y = jax.device_put(jnp.asarray(rng.integers(0, 1000, size=(32,)), jnp.int32))
+
+    @jax.jit
+    def sgd(p, b):
+        loss, g = jax.value_and_grad(resnet.loss_fn)(p, b)
+        return loss, jax.tree.map(lambda w, gg: w - lr * gg, p, g)
+
+    p = params
+    for _ in range(4):
+        _, p = sgd(p, (x, y))
+    jax.block_until_ready(p)
+
+    t_start = time.perf_counter()
+    means = []
+    for i in range(30):
+        t0 = time.perf_counter()
+        for _ in range(6):
+            _, p = sgd(p, (x, y))
+        jax.block_until_ready(p)
+        ms = (time.perf_counter() - t0) / 6 * 1e3
+        means.append(ms)
+        print(f"t={time.perf_counter()-t_start:6.1f}s  block {i:2d}: {ms:6.2f} ms")
+    means = np.array(means)
+    print(
+        f"min {means.min():.2f}  p25 {np.percentile(means,25):.2f}  "
+        f"median {np.median(means):.2f}  p75 {np.percentile(means,75):.2f}  "
+        f"max {means.max():.2f}"
+    )
+
+
+if __name__ == "__main__":
+    main()
