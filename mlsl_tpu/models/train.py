@@ -218,16 +218,21 @@ class DataParallelTrainer:
         owned gradient shard (ZeRO-1 proper: Adam moments sharded over the data
         group, reference owned-kernel math src/mlsl_impl.cpp:401-435). The
         sharded path runs the transform on each rank's flat (owned,) shard, so
-        it is correct only for elementwise/shard-local transforms (adam, sgd
-        with momentum, rmsprop, ...); params-consuming (weight decay) or
-        cross-shard/shape-dependent transforms (clip_by_global_norm, adafactor)
-        need the plain path — they would silently see per-shard views here.
+        a black-box optax transform is correct only if it is elementwise/
+        shard-local (adam, sgd with momentum, rmsprop, ...); params-consuming
+        (weight decay) or shape-dependent black-box transforms would silently
+        see per-shard views. The shape-dependent cases this framework supports
+        cross-shard have dedicated implementations: pass
+        mlsl_tpu.optim.ShardedAdafactor for factored-stats Adafactor under
+        ZeRO-1, and clip_global_norm= (below) for global-norm clipping.
 
         clip_global_norm: clip the (mean) gradient to this global L2 norm
         BEFORE the optimizer — on every path, including ZeRO-1, where the norm
         is assembled from per-rank owned-shard partials via a psum over the
         gradient group (the cross-shard reduction a black-box optax
         clip_by_global_norm cannot perform there)."""
+        from mlsl_tpu.optim import ShardedAdafactor
+
         self.env = env
         self.dist = dist
         self.session = session
@@ -236,6 +241,13 @@ class DataParallelTrainer:
         self.get_layer = get_layer
         self.lr = lr
         self.optimizer = optimizer
+        # ShardedAdafactor is a config marker: the plain/fused paths run its
+        # optax equivalent; distributed update runs the cross-shard factored
+        # implementation (mlsl_tpu/optim.py) with identical numerics.
+        self._af_cfg = optimizer if isinstance(optimizer, ShardedAdafactor) else None
+        self._optax_opt = (
+            optimizer.as_optax() if self._af_cfg is not None else optimizer
+        )
         self.clip_global_norm = clip_global_norm
         self.mesh = dist.topology.mesh
         mlsl_assert(
@@ -310,6 +322,8 @@ class DataParallelTrainer:
         # distributed update (ZeRO-1: moments sharded over the data group).
         self._opt_state = None
         self._du_opt_state = None
+        self._af_layouts = {}
+        self._du_inc_fns = None
         self._needs_comm = needs_comm
         self._accum_fns = None
         self._du_norm_fn = None
@@ -323,7 +337,7 @@ class DataParallelTrainer:
                 # path): owned == full, replicated state drives the plain
                 # update.
                 self._opt_state = jax.device_put(
-                    optimizer.init(self.params), sharding
+                    self._optax_opt.init(self.params), sharding
                 )
         self._grad_fn = self._build_grad_fn()
         self._update_fn = self._build_update_fn()
@@ -353,7 +367,21 @@ class DataParallelTrainer:
 
     def _init_owned_opt_state(self, name: str):
         """Optimizer state over this layer's owned shard (ZeRO-1)."""
+        from mlsl_tpu import optim
+
         ps = self.ops[name].get_parameter_set(0)
+        if self._af_cfg is not None:
+            layout = optim.build_adafactor_layout(
+                [tuple(l.shape)
+                 for l in jax.tree.leaves(self.get_layer(self.params, name))],
+                self.padded_counts[name],
+                self.data_size,
+                self._af_cfg.min_dim_size_to_factor,
+            )
+            self._af_layouts[name] = layout
+            return optim.init_adafactor_state(
+                self.dist.topology, layout, self._af_cfg, self.data_size
+            )
         return init_shard_opt_state(
             self.dist.topology, self.optimizer, ps.owned_kernel_count
         )
@@ -435,7 +463,7 @@ class DataParallelTrainer:
 
         layers, get_layer = self.layers, self.get_layer
         data_size, counts = self.data_size, self.layer_counts
-        optimizer = self.optimizer
+        optimizer = self._optax_opt
         clip = self.clip_global_norm
 
         def update(params, opt_state, reduced: Dict[str, jax.Array]):
@@ -478,11 +506,26 @@ class DataParallelTrainer:
 
     def _build_du_inc_fn(self):
         """distributed-update: owned-shard gradient -> owned-shard increment."""
+        from mlsl_tpu import optim
+
         with_scale = self.clip_global_norm is not None
         if self.optimizer is None:
             return build_owned_increment_fn(
                 self.mesh, self.lr, self.data_size, with_scale=with_scale
             )
+        if self._af_cfg is not None:
+            self._du_inc_fns = {
+                name: optim.build_adafactor_inc_fn(
+                    self.mesh,
+                    self.dist.topology,
+                    self._af_cfg,
+                    self._af_layouts[name],
+                    self.data_size,
+                    with_scale=with_scale,
+                )
+                for name in self._af_layouts
+            }
+            return None
         return build_owned_opt_increment_fn(
             self.mesh, self.optimizer, self.data_size, with_scale=with_scale
         )
@@ -534,7 +577,7 @@ class DataParallelTrainer:
 
     def _build_fused_fn(self, donate: bool = True):
         loss_fn, lr = self.loss_fn, self.lr
-        optimizer = self.optimizer
+        optimizer = self._optax_opt
         clip = self.clip_global_norm
 
         def _clipped(grads):
@@ -738,6 +781,13 @@ class DataParallelTrainer:
                     )
                 if self.optimizer is None:
                     inc_local = self._du_inc_fn(owned, *scale_args)
+                elif self._du_inc_fns is not None:
+                    # sharded adafactor: factored stats need the replicated
+                    # layer subtree (per-leaf shapes / parameter scale)
+                    inc_local, self._du_opt_state[name] = self._du_inc_fns[name](
+                        owned, self._du_opt_state[name],
+                        self.get_layer(self.params, name), *scale_args
+                    )
                 else:
                     inc_local, self._du_opt_state[name] = self._du_inc_fn(
                         owned, self._du_opt_state[name], *scale_args

@@ -270,6 +270,14 @@ class HybridTrainer:
         self.batch = batch if batch is not None else dp
         mlsl_assert(self.batch % dp == 0, "batch %d %% dp %d", self.batch, dp)
         self.lr = lr
+        from mlsl_tpu.optim import ShardedAdafactor
+
+        mlsl_assert(
+            not isinstance(optimizer, ShardedAdafactor),
+            "ShardedAdafactor's cross-shard factored stats are implemented for "
+            "DataParallelTrainer's distributed update; pass "
+            "optimizer.as_optax() to HybridTrainer (plain path only)",
+        )
         self.optimizer = optimizer
         self.dist = env.create_distribution(
             dp, tp, seq_parts=sp, devices=devices

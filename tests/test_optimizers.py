@@ -392,3 +392,168 @@ def test_optimizer_rejects_overlap(env):
             env, dist, sess, mlp_init(jax.random.PRNGKey(0)), loss_fn, LAYERS,
             get_layer, optimizer=optax.adam(1e-2), overlap_updates=True,
         )
+
+
+# ---------------- sharded adafactor (factored stats under ZeRO-1) ----------
+
+
+def _af_cfg(**kw):
+    from mlsl_tpu.optim import ShardedAdafactor
+
+    # min_dim_size_to_factor=4 so the MLP's (8,16)/(16,4) weights take the
+    # factored path while biases stay elementwise; owned shards cross leaf
+    # boundaries (layer l1 pads 144 -> 18 per rank), exercising the index maps.
+    return ShardedAdafactor(learning_rate=0.01, min_dim_size_to_factor=4, **kw)
+
+
+@pytest.mark.parametrize("du", [False, True])
+def test_adafactor_matches_oracle(env, du):
+    """ShardedAdafactor == optax.adafactor on both the plain path (via
+    as_optax) and distributed update, where the factored row/col stats are
+    assembled cross-shard from owned-shard partial sums."""
+    cfg = _af_cfg()
+    got = _train(env, cfg, distributed_update=du)
+    want = _oracle(cfg.as_optax())
+    _assert_trees_close(got, want, atol=2e-5, rtol=2e-4)
+
+
+@pytest.mark.parametrize(
+    "kw",
+    [
+        {"momentum": 0.9},
+        {"weight_decay_rate": 1e-3},
+        {"clipping_threshold": None},
+        {"multiply_by_parameter_scale": False},
+        {"min_dim_size_to_factor": 128},  # nothing factored: elementwise path
+        {"momentum": 0.9, "weight_decay_rate": 1e-3},
+    ],
+)
+def test_adafactor_variants_match_oracle(env, kw):
+    """Every optional leg of the optax.adafactor chain (momentum EMA, decayed
+    weights from owned param slices, no block clipping, no parameter scale,
+    unfactored fallback) reproduces the oracle under distributed update."""
+    from mlsl_tpu.optim import ShardedAdafactor
+
+    kw = {"min_dim_size_to_factor": 4, **kw}
+    cfg = ShardedAdafactor(learning_rate=0.01, **kw)
+    got = _train(env, cfg, distributed_update=True)
+    want = _oracle(cfg.as_optax())
+    _assert_trees_close(got, want, atol=2e-5, rtol=2e-4)
+
+
+def test_adafactor_with_global_norm_clip(env):
+    """clip_global_norm composes with sharded adafactor exactly like
+    optax.chain(clip_by_global_norm, adafactor)."""
+    cfg = _af_cfg()
+    opt = optax.chain(optax.clip_by_global_norm(0.05), cfg.as_optax())
+    want = _oracle(opt)
+
+    dist = env.create_distribution(8, 1)
+    sess = env.create_session()
+    sess.set_global_minibatch_size(BATCH)
+    tr = DataParallelTrainer(
+        env, dist, sess, mlp_init(jax.random.PRNGKey(0)), loss_fn, LAYERS,
+        get_layer, distributed_update=True, optimizer=cfg, clip_global_norm=0.05,
+    )
+    for x, y in zip(*_data()):
+        tr.step(tr.shard_batch(x, y))
+    _assert_trees_close(jax.device_get(tr.params), want, atol=2e-5, rtol=2e-4)
+
+
+def test_adafactor_checkpoint_resume(env, tmp_path):
+    """Restore resumes the factored trajectory (v_row/v_col/count buffers)."""
+    from mlsl_tpu.checkpoint import CheckpointManager, restore_trainer, save_trainer
+
+    cfg = _af_cfg()
+    xs, ys = _data()
+
+    def make_trainer():
+        dist = env.create_distribution(8, 1)
+        sess = env.create_session()
+        sess.set_global_minibatch_size(BATCH)
+        return DataParallelTrainer(
+            env, dist, sess, mlp_init(jax.random.PRNGKey(0)), loss_fn, LAYERS,
+            get_layer, distributed_update=True, optimizer=cfg,
+        )
+
+    tr = make_trainer()
+    mgr = CheckpointManager(str(tmp_path / "ckpt"))
+    for x, y in zip(xs[:2], ys[:2]):
+        tr.step(tr.shard_batch(x, y))
+    save_trainer(mgr, tr, 2, wait=True)
+    for x, y in zip(xs[2:], ys[2:]):
+        tr.step(tr.shard_batch(x, y))
+    want = jax.device_get(tr.params)
+    mgr.close()
+
+    tr2 = make_trainer()
+    mgr2 = CheckpointManager(str(tmp_path / "ckpt"))
+    assert restore_trainer(mgr2, tr2) == 2
+    for x, y in zip(xs[2:], ys[2:]):
+        tr2.step(tr2.shard_batch(x, y))
+    mgr2.close()
+    _assert_trees_close(jax.device_get(tr2.params), want)
+
+
+def test_adafactor_fully_factored_layer_skips_elementwise_state(env):
+    """A layer whose leaves are all factored keeps v as a (1,) dummy —
+    Adafactor's sublinear state memory survives the sharding — and still
+    matches the oracle."""
+    def bias_free_init(key):
+        k1, k2 = jax.random.split(key)
+        return {
+            "w1": {"w": jax.random.normal(k1, (8, 16)) * 0.3},
+            "w2": {"w": jax.random.normal(k2, (16, 4)) * 0.3},
+        }
+
+    def bias_free_loss(params, batch):
+        x, y = batch
+        h = jnp.tanh(x @ params["w1"]["w"])
+        logits = h @ params["w2"]["w"]
+        logp = jax.nn.log_softmax(logits)
+        return -jnp.mean(jnp.take_along_axis(logp, y[:, None], axis=1))
+
+    def bf_get_layer(params, name):
+        return params[name]
+
+    cfg = _af_cfg()
+    xs, ys = _data()
+
+    dist = env.create_distribution(8, 1)
+    sess = env.create_session()
+    sess.set_global_minibatch_size(BATCH)
+    tr = DataParallelTrainer(
+        env, dist, sess, bias_free_init(jax.random.PRNGKey(3)), bias_free_loss,
+        ["w1", "w2"], bf_get_layer, distributed_update=True, optimizer=cfg,
+    )
+    assert tr._du_opt_state["w1"]["v"].shape[-1] == 1  # dummy, not owned-shard
+    for x, y in zip(xs, ys):
+        tr.step(tr.shard_batch(x, y))
+
+    opt = cfg.as_optax()
+    params = bias_free_init(jax.random.PRNGKey(3))
+    state = opt.init(params)
+
+    @jax.jit
+    def step(params, state, x, y):
+        loss, grads = jax.value_and_grad(bias_free_loss)(params, (x, y))
+        updates, state = opt.update(grads, state, params)
+        return optax.apply_updates(params, updates), state, loss
+
+    for x, y in zip(xs, ys):
+        params, state, _ = step(params, state, jnp.asarray(x), jnp.asarray(y))
+    _assert_trees_close(jax.device_get(tr.params), jax.device_get(params),
+                        atol=2e-5, rtol=2e-4)
+
+
+def test_hybrid_rejects_sharded_adafactor(env):
+    """HybridTrainer must reject the marker config with a clear error."""
+    from mlsl_tpu.log import MLSLError
+    from mlsl_tpu.models.transformer import HybridTrainer, TransformerConfig
+
+    with pytest.raises(MLSLError, match="ShardedAdafactor"):
+        HybridTrainer(
+            env, TransformerConfig(vocab=32, d_model=16, n_heads=2,
+                                   head_dim=8, n_blocks=1, seq_len=8),
+            dp=2, sp=1, tp=2, optimizer=_af_cfg(),
+        )
