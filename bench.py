@@ -132,16 +132,22 @@ def main():
         loss, grads = jax.value_and_grad(resnet.loss_fn)(p, (bx, by))
         return loss, jax.tree.map(lambda w, g: w - lr * g, p, grads)
 
+    # End every timing block with a HOST READBACK of one param element, not
+    # block_until_ready: through the axon tunnel block_until_ready can return
+    # before the device finishes (measured 0.9 ms/"step" on a 30 ms transformer
+    # step); a d2h read of an output forces true completion of the chain.
+    from benchmarks._common import device_sync as _sync
+
     def run_fw(n):
         for _ in range(n):
             trainer.step(fw_batch)
-        jax.block_until_ready(trainer.params)
+        _sync(trainer.params)
 
     def run_raw(n):
         nonlocal raw_params
         for _ in range(n):
             loss, raw_params = raw_step(raw_params, xb, yb)
-        jax.block_until_ready(raw_params)
+        _sync(raw_params)
 
     # Forced per-layer trainer: bypasses the fused shortcut so the Session/
     # Operation Start/Wait machinery (reference loop mlsl_test.cpp:660-698) is
@@ -157,7 +163,7 @@ def main():
     def run_pl(n):
         for _ in range(n):
             trainer_pl.step(fw_batch)
-        jax.block_until_ready(trainer_pl.params)
+        _sync(trainer_pl.params)
 
     # warm up all compiled programs, then measure in ALTERNATING blocks so slow
     # machine/tunnel drift hits all sides equally; medians of per-block means.
@@ -206,6 +212,15 @@ def main():
     except Exception as e:  # cost_analysis unsupported on some backends
         print(f"bench: cost_analysis unavailable ({e})", file=sys.stderr)
 
+    # Secondary evidence: transformer training throughput (tokens/s) through
+    # the HybridTrainer on the same chip — the long-context workload family.
+    tfm_tok_s = tfm_ms = None
+    if not args.quick:
+        try:
+            tfm_tok_s, tfm_ms = _transformer_throughput(env)
+        except Exception as e:
+            print(f"bench: transformer throughput skipped ({e})", file=sys.stderr)
+
     print(
         json.dumps(
             {
@@ -218,10 +233,57 @@ def main():
                 "per_layer_vs_fused": round(fw_ms / pl_ms, 4),
                 "tflops": round(tflops, 3) if tflops else None,
                 "mfu": round(mfu, 4) if mfu else None,
+                "transformer_tok_s": round(tfm_tok_s) if tfm_tok_s else None,
+                "transformer_step_ms": round(tfm_ms, 3) if tfm_ms else None,
                 "device": device_kind,
             }
         )
     )
+
+
+def _transformer_throughput(env):
+    """Tokens/s for a d512 x 8-block transformer train step (batch 32, seq 512)
+    on the attached device, via the HybridTrainer on ONE device (dp=sp=tp=1 and
+    devices pinned to the first chip, so multi-device hosts don't trip the
+    replica-count check)."""
+    import statistics
+    import time
+
+    import jax
+    import numpy as np
+
+    from mlsl_tpu.models import transformer as tfm
+
+    cfg = tfm.TransformerConfig(
+        vocab=32768, d_model=512, n_heads=8, head_dim=64, n_blocks=8,
+        seq_len=512,
+    )
+    batch = 32
+    trainer = tfm.HybridTrainer(
+        env, cfg, 1, 1, 1, batch=batch, lr=0.1, devices=env.devices[:1]
+    )
+    rng = np.random.default_rng(0)
+    toks = rng.integers(0, cfg.vocab, size=(batch, cfg.seq_len)).astype(np.int32)
+    labels = np.roll(toks, -1, axis=1)
+    tb, lb = trainer.shard_tokens(toks, labels)
+
+    from benchmarks._common import device_sync
+
+    def sync():
+        return device_sync(trainer.params)
+
+    for _ in range(4):
+        trainer.step(tb, lb)
+    sync()
+    blocks = []
+    for _ in range(6):
+        t0 = time.perf_counter()
+        for _ in range(6):
+            trainer.step(tb, lb)
+        sync()
+        blocks.append((time.perf_counter() - t0) / 6 * 1e3)
+    ms = statistics.median(blocks)
+    return batch * cfg.seq_len / (ms / 1e3), ms
 
 
 def _peak_tflops(device_kind: str) -> float:

@@ -51,3 +51,36 @@ def setup_chip(tag: str):
     )
     jax.config.update("jax_persistent_cache_min_compile_time_secs", 5)
     return jax
+
+
+def device_sync(tree):
+    """Force TRUE device completion of a result tree via a d2h readback of one
+    element — through the axon tunnel block_until_ready can return before the
+    device finishes (memory: axon-tunnel-timing)."""
+    import numpy as np
+    import jax
+
+    return float(np.asarray(jax.tree.leaves(tree)[0]).ravel()[0])
+
+
+def timed(fn, *args, iters=30, warmup=5, blocks=5):
+    """Best-of-blocks per-call ms with a TRUE device sync: through the axon
+    tunnel block_until_ready can return before the device finishes (memory:
+    axon-tunnel-timing), so every block ends with a d2h readback of one element
+    of the final result. The minimum across blocks is the capability estimate —
+    shared-tunnel load spikes inflate the mean by 2x+ on a seconds timescale."""
+    import time
+
+    r = fn(*args)  # also covers warmup=0: r must exist for the first sync
+    for _ in range(max(0, warmup - 1)):
+        r = fn(*args)
+    device_sync(r)
+    per_block = max(1, iters // blocks)
+    best = float("inf")
+    for _ in range(blocks):
+        t0 = time.perf_counter()
+        for _ in range(per_block):
+            r = fn(*args)
+        device_sync(r)
+        best = min(best, (time.perf_counter() - t0) / per_block * 1e3)
+    return best

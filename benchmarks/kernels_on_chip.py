@@ -45,17 +45,7 @@ def _probe():
         sys.exit(3)
 
 
-def _time(fn, *args, iters=30, warmup=5):
-    import jax
-
-    for _ in range(warmup):
-        r = fn(*args)
-    jax.block_until_ready(r)
-    t0 = time.perf_counter()
-    for _ in range(iters):
-        r = fn(*args)
-    jax.block_until_ready(r)
-    return (time.perf_counter() - t0) / iters * 1e3
+from benchmarks._common import timed as _time
 
 
 def main():
