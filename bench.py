@@ -246,10 +246,6 @@ def _transformer_throughput(env):
     on the attached device, via the HybridTrainer on ONE device (dp=sp=tp=1 and
     devices pinned to the first chip, so multi-device hosts don't trip the
     replica-count check)."""
-    import statistics
-    import time
-
-    import jax
     import numpy as np
 
     from mlsl_tpu.models import transformer as tfm
@@ -269,20 +265,9 @@ def _transformer_throughput(env):
 
     from benchmarks._common import device_sync
 
-    def sync():
-        return device_sync(trainer.params)
+    from benchmarks._common import timed
 
-    for _ in range(4):
-        trainer.step(tb, lb)
-    sync()
-    blocks = []
-    for _ in range(6):
-        t0 = time.perf_counter()
-        for _ in range(6):
-            trainer.step(tb, lb)
-        sync()
-        blocks.append((time.perf_counter() - t0) / 6 * 1e3)
-    ms = statistics.median(blocks)
+    ms = timed(lambda: trainer.step(tb, lb), iters=36, warmup=4, blocks=6)
     return batch * cfg.seq_len / (ms / 1e3), ms
 
 

@@ -54,13 +54,16 @@ def setup_chip(tag: str):
 
 
 def device_sync(tree):
-    """Force TRUE device completion of a result tree via a d2h readback of one
+    """Force TRUE device completion of a result tree via a d2h readback of ONE
     element — through the axon tunnel block_until_ready can return before the
-    device finishes (memory: axon-tunnel-timing)."""
+    device finishes (memory: axon-tunnel-timing). The element is sliced
+    device-side first so only 4 bytes cross the tunnel (np.asarray of a full
+    leaf would ship the whole array inside the timed window)."""
     import numpy as np
     import jax
 
-    return float(np.asarray(jax.tree.leaves(tree)[0]).ravel()[0])
+    leaf = jax.tree.leaves(tree)[0]
+    return float(np.asarray(jax.numpy.ravel(leaf)[0]))
 
 
 def timed(fn, *args, iters=30, warmup=5, blocks=5):

@@ -389,32 +389,72 @@ class HybridTrainer:
         self._du_apply_fn = (
             self._build_du_apply_fn() if self.distributed_update else None
         )
+        # When no ParameterSet needs gradient comm (grad group of one: dp=sp=1;
+        # TP-only grids qualify — TP grad psums live inside the loss body), fuse
+        # loss+grad+update into ONE donated jit: skips the flatten/unflatten
+        # round trip through per-layer buffers and lets XLA update params in
+        # place — the same shortcut DataParallelTrainer takes.
+        needs_comm = any(
+            self.ops[n].get_parameter_set(0).need_comm for n in self.layers
+        )
+        self._needs_comm = needs_comm
+        self._fused_fn = (
+            self._build_fused_fn()
+            if (not needs_comm and not self.distributed_update)
+            else None
+        )
 
     # -- compiled programs -------------------------------------------------
 
     def _token_spec(self):
         return P((DATA_AXIS,), (SEQ_AXIS,))
 
-    def _build_grad_fn(self):
-        cfg, sp, tp = self.cfg, self.sp, self.tp
-        layers, padded = self.layers, self.padded_counts
-        specs = self.specs
+    def _scaled_loss_fn(self):
+        """Per-device loss whose autodiff yields d(global CE sum)/d(local leaf).
 
-        # SPMD autodiff semantics: differentiating a per-device scalar seeds cotangent
-        # 1 on EVERY device, so the computed gradient is d(sum of all devices'
-        # losses)/d(local leaf). The CE loss is replicated over the model axis (logits
-        # are psum'd), so that sum counts the true loss tp times — scale it by 1/tp.
-        # The MoE aux loss is per-slice (DEVICE-VARYING over model), so the natural
-        # sum over model ranks is already the total. The synced gradient is later
-        # divided by batch*seq_len (the CE-mean normalizer); pre-scaling aux by
-        # tokens-per-slice makes the effective objective
-        # mean_CE + moe_aux_weight * mean_aux, independent of token count.
+        SPMD autodiff semantics: differentiating a per-device scalar seeds
+        cotangent 1 on EVERY device, so the computed gradient is d(sum of all
+        devices' losses)/d(local leaf). The CE loss is replicated over the model
+        axis (logits are psum'd), so that sum counts the true loss tp times —
+        scale it by 1/tp. The MoE aux loss is per-slice (DEVICE-VARYING over
+        model), so the natural sum over model ranks is already the total. The
+        synced gradient is later divided by batch*seq_len (the CE-mean
+        normalizer); pre-scaling aux by tokens-per-slice makes the effective
+        objective mean_CE + moe_aux_weight * mean_aux, independent of token
+        count. Shared by the graph and fused paths — the two must not diverge.
+        """
+        cfg, sp, tp = self.cfg, self.sp, self.tp
         tokens_per_slice = (self.batch // self.dp) * (cfg.seq_len // self.sp) / tp
         aux_w = cfg.moe_aux_weight * tokens_per_slice
 
         def scaled_loss(p, t, l):
             ce, aux = local_loss(p, t, l, cfg, sp, tp)
             return ce / tp + aux_w * aux, ce
+
+        return scaled_loss
+
+    def _flat_opt_layer_update(self, params_sub, state_sub, flat_grad):
+        """One layer's optax update on the rank's flat local parameter vector
+        (shared by the graph update path and the fused path; the flat state
+        layout keeps checkpoints interchangeable between them). Inputs are
+        LOCAL (grid dims stripped); returns (new subtree, new local state)."""
+        flat_p = jnp.concatenate(
+            [l.reshape(-1).astype(jnp.float32)
+             for l in jax.tree.leaves(params_sub)]
+        )
+        updates, ns = self.optimizer.update(flat_grad, state_sub, flat_p)
+        new_sub = jax.tree.map(
+            lambda p, uu: (p + uu).astype(p.dtype),
+            params_sub,
+            _unflatten_like(params_sub, updates),
+        )
+        return new_sub, ns
+
+    def _build_grad_fn(self):
+        cfg, sp, tp = self.cfg, self.sp, self.tp
+        layers, padded = self.layers, self.padded_counts
+        specs = self.specs
+        scaled_loss = self._scaled_loss_fn()
 
         def body(params, tokens, labels):
             (_, loss), grads = jax.value_and_grad(scaled_loss, has_aux=True)(
@@ -479,6 +519,86 @@ class HybridTrainer:
 
         return jax.jit(update)
 
+    def _build_fused_fn(self):
+        """One donated jit: loss + grads (+ in-body TP psum for replicated
+        leaves) + update, bypassing the per-layer buffer round trip. Optimizer
+        state keeps the flat per-layer layout of _build_opt_update_fn, so
+        checkpoints are interchangeable with the graph path."""
+
+        cfg, sp, tp = self.cfg, self.sp, self.tp
+        lr, layers, specs = self.lr, self.layers, self.specs
+        norm = self.batch * cfg.seq_len
+        optimizer = self.optimizer
+        scaled_loss = self._scaled_loss_fn()
+
+        def synced_layer_grads(params, grads, name):
+            leaf_specs = jax.tree.leaves(
+                specs[name], is_leaf=lambda x: isinstance(x, P)
+            )
+            out = []
+            for leaf, spec in zip(jax.tree.leaves(grads[name]), leaf_specs):
+                g = leaf.astype(jnp.float32)
+                if tp > 1 and MODEL_AXIS not in spec:
+                    g = lax.psum(g, MODEL_AXIS)
+                out.append(g / norm)
+            return out
+
+        tok = self._token_spec()
+        if optimizer is None:
+            def body(params, tokens, labels):
+                (_, loss), grads = jax.value_and_grad(
+                    scaled_loss, has_aux=True
+                )(params, tokens, labels)
+                new = dict(params)
+                for name in layers:
+                    subl, treedef = jax.tree.flatten(params[name])
+                    gl = synced_layer_grads(params, grads, name)
+                    new[name] = jax.tree.unflatten(
+                        treedef,
+                        [(p - lr * g).astype(p.dtype) for p, g in zip(subl, gl)],
+                    )
+                return loss[None, None, None, None, None], new
+
+            sm = smap(
+                body, self.mesh,
+                in_specs=(specs, tok, tok),
+                out_specs=(_BUF_SPEC, specs),
+                check=False,
+            )
+            return jax.jit(sm, donate_argnums=(0,))
+
+        def body(params, states, tokens, labels):
+            (_, loss), grads = jax.value_and_grad(scaled_loss, has_aux=True)(
+                params, tokens, labels
+            )
+            new, new_states = dict(params), {}
+            grid1 = (1,) * NUM_GRID_AXES
+            for name in layers:
+                gl = jnp.concatenate(
+                    [g.reshape(-1) for g in synced_layer_grads(params, grads, name)]
+                )
+                local = jax.tree.map(
+                    lambda l: l.reshape(l.shape[NUM_GRID_AXES:]), states[name]
+                )
+                new[name], ns = self._flat_opt_layer_update(
+                    params[name], local, gl
+                )
+                new_states[name] = jax.tree.map(
+                    lambda l: l.reshape(grid1 + l.shape), ns
+                )
+            return loss[None, None, None, None, None], new, new_states
+
+        state_specs = {
+            n: jax.tree.map(_leaf_buf_spec, self._opt_state[n]) for n in layers
+        }
+        sm = smap(
+            body, self.mesh,
+            in_specs=(specs, state_specs, tok, tok),
+            out_specs=(_BUF_SPEC, specs, state_specs),
+            check=False,
+        )
+        return jax.jit(sm, donate_argnums=(0, 1))
+
     def _build_opt_update_fn(self):
         """optax path: each layer's optimization variable is the rank's flat
         local (TP-sharded) parameter vector; state buffers mirror it."""
@@ -499,16 +619,8 @@ class HybridTrainer:
                     local = jax.tree.map(
                         lambda l: l.reshape(l.shape[NUM_GRID_AXES:]), states[name]
                     )
-                    sub = params[name]
-                    flat_p = jnp.concatenate(
-                        [l.reshape(-1).astype(jnp.float32)
-                         for l in jax.tree.leaves(sub)]
-                    )
-                    updates, ns = optimizer.update(gl, local, flat_p)
-                    new[name] = jax.tree.map(
-                        lambda p, uu: (p + uu).astype(p.dtype),
-                        sub,
-                        _unflatten_like(sub, updates),
+                    new[name], ns = self._flat_opt_layer_update(
+                        params[name], local, gl
                     )
                     new_states[name] = jax.tree.map(
                         lambda l: l.reshape(grid1 + l.shape), ns
@@ -598,6 +710,14 @@ class HybridTrainer:
         return self._sync_and_update(scale_fn(total, k), loss_sum) / k
 
     def step(self, tokens, labels):
+        if self._fused_fn is not None:
+            if self.optimizer is None:
+                loss, self.params = self._fused_fn(self.params, tokens, labels)
+            else:
+                loss, self.params, self._opt_state = self._fused_fn(
+                    self.params, self._opt_state, tokens, labels
+                )
+            return jnp.sum(loss[:, :, :, 0]) / (self.batch * self.cfg.seq_len)
         loss, grads = self._grad_fn(self.params, tokens, labels)
         return self._sync_and_update(grads, loss)
 

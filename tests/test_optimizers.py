@@ -324,7 +324,7 @@ def _hybrid_oracle(optimizer, toks, labels, n_steps):
 
 
 @pytest.mark.parametrize("dp,sp,tp,du", [(2, 2, 2, False), (8, 1, 1, False),
-                                         (2, 2, 2, True)])
+                                         (2, 2, 2, True), (1, 1, 2, False)])
 def test_hybrid_adam_matches_oracle(env, dp, sp, tp, du):
     """Adam through the hybrid dp x sp x tp trainer (flat per-layer state;
     owned-shard state under ZeRO-1) equals the structured single-device loop —
@@ -335,7 +335,8 @@ def test_hybrid_adam_matches_oracle(env, dp, sp, tp, du):
     opt = optax.adam(1e-2)
     b = 2 * dp
     tr = tfm.HybridTrainer(env, cfg, dp, sp, tp, batch=b, seed=0,
-                           distributed_update=du, optimizer=opt)
+                           distributed_update=du, optimizer=opt,
+                           devices=env.devices[: dp * sp * tp])
     rng = np.random.default_rng(5)
     toks = rng.integers(0, 32, size=(b, 16)).astype(np.int32)
     labels = np.roll(toks, -1, axis=1)

@@ -46,10 +46,11 @@ def _assert_params_close(trainer, ref_params, atol=2e-2, rtol=2e-2):
         )
 
 
-@pytest.mark.parametrize("dp,sp,tp", [(2, 2, 2), (8, 1, 1), (1, 4, 2), (2, 4, 1), (1, 2, 4)])
+@pytest.mark.parametrize("dp,sp,tp", [(2, 2, 2), (8, 1, 1), (1, 4, 2), (2, 4, 1), (1, 2, 4), (1, 1, 2)])
 def test_hybrid_matches_oracle(env, dp, sp, tp):
     b = 2 * dp
-    trainer = tfm.HybridTrainer(env, CFG, dp, sp, tp, batch=b, lr=0.5)
+    trainer = tfm.HybridTrainer(env, CFG, dp, sp, tp, batch=b, lr=0.5,
+                                devices=env.devices[: dp * sp * tp])
     toks, labels = _data(b)
     # oracle from identical initial params (single device, no sharding)
     ref_params = tfm.init_params(jax.random.PRNGKey(0), CFG)
@@ -62,13 +63,14 @@ def test_hybrid_matches_oracle(env, dp, sp, tp):
     assert np.isfinite(losses).all()
 
 
-@pytest.mark.parametrize("dp,sp,tp", [(2, 2, 2), (4, 1, 2), (8, 1, 1)])
+@pytest.mark.parametrize("dp,sp,tp", [(2, 2, 2), (4, 1, 2), (8, 1, 1), (1, 1, 2)])
 def test_hybrid_distributed_update_matches_oracle(env, dp, sp, tp):
     """ZeRO-1 (reduce-scatter grads / owned update / all-gather increments)
     combined with TP and SP must still reproduce plain SGD."""
     b = 2 * dp
     trainer = tfm.HybridTrainer(
-        env, CFG, dp, sp, tp, batch=b, lr=0.5, distributed_update=True
+        env, CFG, dp, sp, tp, batch=b, lr=0.5, distributed_update=True,
+        devices=env.devices[: dp * sp * tp],
     )
     toks, labels = _data(b)
     ref_params = tfm.init_params(jax.random.PRNGKey(0), CFG)
