@@ -66,18 +66,46 @@ def device_sync(tree):
     return float(np.asarray(jax.numpy.ravel(leaf)[0]))
 
 
-def timed(fn, *args, iters=30, warmup=5, blocks=5):
+_RTT_CACHE = {}
+
+
+def sync_rtt(samples: int = 6) -> float:
+    """Calibrated d2h readback round-trip (seconds, min of samples): through
+    the axon tunnel a single device_sync costs ~65 ms regardless of payload,
+    which would otherwise ride inside every timed block (the bias is
+    RTT/per_block per call). Cached per process."""
+    import time
+
+    if "rtt" not in _RTT_CACHE:
+        import jax
+        import jax.numpy as jnp
+
+        z = jax.device_put(jnp.zeros((8, 128)))
+        device_sync(z)
+        best = float("inf")
+        for _ in range(samples):
+            t0 = time.perf_counter()
+            device_sync(z)
+            best = min(best, time.perf_counter() - t0)
+        _RTT_CACHE["rtt"] = best
+    return _RTT_CACHE["rtt"]
+
+
+def timed(fn, *args, iters=30, warmup=5, blocks=3):
     """Best-of-blocks per-call ms with a TRUE device sync: through the axon
     tunnel block_until_ready can return before the device finishes (memory:
     axon-tunnel-timing), so every block ends with a d2h readback of one element
-    of the final result. The minimum across blocks is the capability estimate —
-    shared-tunnel load spikes inflate the mean by 2x+ on a seconds timescale."""
+    of the final result — and the readback's own ~65 ms round trip is
+    calibrated out (sync_rtt), otherwise it adds RTT/per_block to every call.
+    The minimum across blocks is the capability estimate — shared-tunnel load
+    spikes inflate the mean by 2x+ on a seconds timescale."""
     import time
 
     r = fn(*args)  # also covers warmup=0: r must exist for the first sync
     for _ in range(max(0, warmup - 1)):
         r = fn(*args)
     device_sync(r)
+    rtt = sync_rtt()
     per_block = max(1, iters // blocks)
     best = float("inf")
     for _ in range(blocks):
@@ -85,5 +113,5 @@ def timed(fn, *args, iters=30, warmup=5, blocks=5):
         for _ in range(per_block):
             r = fn(*args)
         device_sync(r)
-        best = min(best, (time.perf_counter() - t0) / per_block * 1e3)
-    return best
+        best = min(best, (time.perf_counter() - t0 - rtt) / per_block * 1e3)
+    return max(best, 0.0)

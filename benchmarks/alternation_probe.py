@@ -16,7 +16,7 @@ import numpy as np
 
 sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), ".."))
 
-from benchmarks._common import setup_chip
+from benchmarks._common import device_sync, setup_chip, timed
 
 jax = setup_chip("alternation_probe")
 
@@ -49,13 +49,13 @@ def main():
         t0 = time.perf_counter()
         for _ in range(iters):
             _, p = fn(p, (x, y))
-        jax.block_until_ready(p)
+        device_sync(p)
         return (time.perf_counter() - t0) / iters * 1e3, p
 
     for _ in range(4):
         _, params = sgd(params, (x, y))
         _, params2 = sgd_b(params2, (x, y))
-    jax.block_until_ready((params, params2))
+    device_sync((params, params2))
 
     solo = []
     for _ in range(9):

@@ -17,7 +17,7 @@ import numpy as np
 
 sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), ".."))
 
-from benchmarks._common import setup_chip
+from benchmarks._common import device_sync, setup_chip, timed
 
 jax = setup_chip("bn_probe")
 
@@ -54,13 +54,13 @@ def timed_step(bn_impl, params, batch, tag):
         p = jax.tree.map(jnp.copy, params)
         for _ in range(4):
             _, p = sgd(p, batch)
-        jax.block_until_ready(p)
+        device_sync(p)
         best = float("inf")
         for _ in range(5):
             t0 = time.perf_counter()
             for _ in range(8):
                 _, p = sgd(p, batch)
-            jax.block_until_ready(p)
+            device_sync(p)
             best = min(best, (time.perf_counter() - t0) / 8 * 1e3)
         loss, _ = sgd(p, batch)
         print(f"{tag:12s}: best {best:6.2f} ms   loss {float(loss):.4f}")

@@ -17,7 +17,7 @@ import numpy as np
 
 sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), ".."))
 
-from benchmarks._common import setup_chip
+from benchmarks._common import device_sync, setup_chip, timed
 
 jax = setup_chip("noise_probe")
 
@@ -41,7 +41,7 @@ def main():
     p = params
     for _ in range(4):
         _, p = sgd(p, (x, y))
-    jax.block_until_ready(p)
+    device_sync(p)
 
     t_start = time.perf_counter()
     means = []
@@ -49,7 +49,7 @@ def main():
         t0 = time.perf_counter()
         for _ in range(6):
             _, p = sgd(p, (x, y))
-        jax.block_until_ready(p)
+        device_sync(p)
         ms = (time.perf_counter() - t0) / 6 * 1e3
         means.append(ms)
         print(f"t={time.perf_counter()-t_start:6.1f}s  block {i:2d}: {ms:6.2f} ms")

@@ -14,7 +14,7 @@ import numpy as np
 
 sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), ".."))
 
-from benchmarks._common import setup_chip
+from benchmarks._common import device_sync, setup_chip, timed
 
 jax = setup_chip("resnet_tuning")
 
@@ -24,14 +24,7 @@ from mlsl_tpu.models import resnet
 
 
 def timeit(fn, *args, iters=20, warmup=4):
-    for _ in range(warmup):
-        out = fn(*args)
-    jax.block_until_ready(out)
-    t0 = time.perf_counter()
-    for _ in range(iters):
-        out = fn(*args)
-    jax.block_until_ready(out)
-    return (time.perf_counter() - t0) / iters * 1e3
+    return timed(fn, *args, iters=iters, warmup=warmup)
 
 
 def main():
@@ -64,11 +57,11 @@ def main():
         # threads params through (for donated variants)
         for _ in range(warmup):
             _, p = fn(p, b)
-        jax.block_until_ready(p)
+        device_sync(p)
         t0 = time.perf_counter()
         for _ in range(iters):
             _, p = fn(p, b)
-        jax.block_until_ready(p)
+        device_sync(p)
         return (time.perf_counter() - t0) / iters * 1e3
 
     for batch in (32, 64, 128):
