@@ -221,24 +221,46 @@ def main():
         except Exception as e:
             print(f"bench: transformer throughput skipped ({e})", file=sys.stderr)
 
-    print(
-        json.dumps(
+    result = {
+        "metric": "resnet50_dp_train_step_time",
+        "value": round(fw_ms, 3),
+        "unit": "ms",
+        "vs_baseline": round(raw_ms / fw_ms, 4),
+        "best_ms": round(fw_best, 3),
+        "per_layer_ms": round(pl_ms, 3),
+        "per_layer_vs_fused": round(fw_ms / pl_ms, 4),
+        "tflops": round(tflops, 3) if tflops else None,
+        "mfu": round(mfu, 4) if mfu else None,
+        "transformer_tok_s": round(tfm_tok_s) if tfm_tok_s else None,
+        "transformer_step_ms": round(tfm_ms, 3) if tfm_ms else None,
+        "device": device_kind,
+    }
+    print(json.dumps(result))
+    if not args.quick:  # --quick CPU runs are smoke tests, not evidence
+        _persist_measurement(result)
+
+
+def _persist_measurement(result):
+    """Append this run's numbers to BENCH_MEASURED.json so a mid-round on-chip
+    success survives a later tunnel outage (durable evidence; the driver's
+    BENCH_r{N}.json only captures the end-of-round run). Suppressed when
+    benchmarks/capture.py drives this script — it records the run itself."""
+    if os.environ.get("MLSL_BENCH_NO_PERSIST"):
+        return
+    try:
+        from benchmarks._common import append_measurement, git_sha
+
+        append_measurement(
             {
-                "metric": "resnet50_dp_train_step_time",
-                "value": round(fw_ms, 3),
-                "unit": "ms",
-                "vs_baseline": round(raw_ms / fw_ms, 4),
-                "best_ms": round(fw_best, 3),
-                "per_layer_ms": round(pl_ms, 3),
-                "per_layer_vs_fused": round(fw_ms / pl_ms, 4),
-                "tflops": round(tflops, 3) if tflops else None,
-                "mfu": round(mfu, 4) if mfu else None,
-                "transformer_tok_s": round(tfm_tok_s) if tfm_tok_s else None,
-                "transformer_step_ms": round(tfm_ms, 3) if tfm_ms else None,
-                "device": device_kind,
+                "run_id": f"bench-{int(time.time())}-{os.getpid()}",
+                "timestamp": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
+                "git_sha": git_sha(),
+                "device_kind": result.get("device"),
+                "steps": [{"step": "bench", "rc": 0, "rows": [result]}],
             }
         )
-    )
+    except Exception as e:  # evidence persistence must never fail the bench
+        print(f"bench: could not persist measurement ({e})", file=sys.stderr)
 
 
 def _transformer_throughput(env):
@@ -262,8 +284,6 @@ def _transformer_throughput(env):
     toks = rng.integers(0, cfg.vocab, size=(batch, cfg.seq_len)).astype(np.int32)
     labels = np.roll(toks, -1, axis=1)
     tb, lb = trainer.shard_tokens(toks, labels)
-
-    from benchmarks._common import device_sync
 
     from benchmarks._common import timed
 

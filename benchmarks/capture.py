@@ -1,0 +1,153 @@
+"""Durable on-chip perf capture (VERDICT r2 item 1).
+
+Watches the accelerator tunnel; the moment it answers, runs the full perf
+suite (bench.py, kernels_on_chip.py, allreduce_curve.py) and appends a
+timestamped record to BENCH_MEASURED.json at the repo root so a mid-round
+success survives an end-of-round tunnel outage. Re-run after perf-relevant
+commits with --once to refresh the record.
+
+Methodology anchor: the reference's isolation-stats capture
+(/root/reference/src/mlsl_impl_stats.cpp:387-562) — repeated replay, warmup
+skipped, numbers recorded to a durable log rather than reported transiently.
+
+Usage:
+    python benchmarks/capture.py            # wait for tunnel, capture, exit
+    python benchmarks/capture.py --once     # single probe; exit 3 if dead
+    python benchmarks/capture.py --suite quick   # bench.py only
+"""
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+import time
+
+REPO = os.path.join(os.path.dirname(os.path.abspath(__file__)), "..")
+OUT = os.path.join(REPO, "BENCH_MEASURED.json")
+
+PROBE_SRC = (
+    "from mlsl_tpu.sysinfo import apply_platform_override\n"
+    "apply_platform_override()\n"
+    "import jax\n"
+    "import jax.numpy as jnp\n"
+    "jnp.ones((8, 8)).sum().block_until_ready()\n"
+    "print('KIND=' + jax.devices()[0].device_kind, flush=True)"
+)
+
+
+def probe(timeout: float = 90.0):
+    """Returns device_kind string if the tunnel answers, else None."""
+    child = subprocess.Popen(
+        [sys.executable, "-c", PROBE_SRC], stdout=subprocess.PIPE,
+        stderr=subprocess.PIPE, text=True, start_new_session=True, cwd=REPO,
+    )
+    deadline = time.time() + timeout
+    while child.poll() is None and time.time() < deadline:
+        time.sleep(1)
+    if child.poll() is None:
+        child.kill()  # D-state children never reap; walk away
+        return None
+    if child.returncode != 0:
+        return None
+    for line in child.stdout.read().splitlines():
+        if line.startswith("KIND="):
+            return line[5:]
+    return None
+
+
+def run_step(name, cmd, timeout, env=None):
+    """Run one benchmark subprocess; returns a record with parsed JSON lines."""
+    t0 = time.time()
+    try:
+        proc = subprocess.run(
+            cmd, cwd=REPO, capture_output=True, text=True, timeout=timeout,
+            start_new_session=True, env=env,
+        )
+        rc, out, err = proc.returncode, proc.stdout, proc.stderr
+    except subprocess.TimeoutExpired as e:
+        rc = -1
+        out = (e.stdout or b"").decode() if isinstance(e.stdout, bytes) else (e.stdout or "")
+        err = f"timeout after {timeout}s"
+    rows = []
+    for line in out.splitlines():
+        line = line.strip()
+        if line.startswith("{"):
+            try:
+                rows.append(json.loads(line))
+            except json.JSONDecodeError:
+                pass
+    return {
+        "step": name, "rc": rc, "wall_s": round(time.time() - t0, 1),
+        "rows": rows,
+        "stderr_tail": err[-400:] if rc != 0 else "",
+    }
+
+
+sys.path.insert(0, REPO)
+from benchmarks._common import append_measurement, git_sha  # noqa: E402
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--once", action="store_true",
+                    help="single probe; exit 3 if the tunnel is dead")
+    ap.add_argument("--suite", choices=["full", "quick"], default="full")
+    ap.add_argument("--poll-sleep", type=float, default=180.0)
+    ap.add_argument("--max-wait-hours", type=float, default=11.0)
+    args = ap.parse_args()
+
+    deadline = time.time() + args.max_wait_hours * 3600
+    attempt = 0
+    while True:
+        attempt += 1
+        kind = probe()
+        if kind:
+            break
+        print(f"capture: probe {attempt} dead tunnel "
+              f"({time.strftime('%H:%M:%S')})", flush=True)
+        if args.once:
+            sys.exit(3)
+        if time.time() > deadline:
+            print("capture: gave up waiting for the tunnel", flush=True)
+            sys.exit(3)
+        time.sleep(args.poll_sleep)
+
+    print(f"capture: tunnel ALIVE, device={kind}; running suite", flush=True)
+    env = dict(os.environ)
+    env.setdefault("MLSL_BENCH_PROBE_ATTEMPTS", "2")
+    # capture.py writes the record itself; stop bench.py double-recording
+    env["MLSL_BENCH_NO_PERSIST"] = "1"
+
+    steps = [("bench", [sys.executable, "bench.py"], 3000)]
+    if args.suite == "full":
+        steps += [
+            ("kernels_on_chip",
+             [sys.executable, "benchmarks/kernels_on_chip.py"], 2400),
+            ("allreduce_curve",
+             [sys.executable, "benchmarks/allreduce_curve.py"], 2400),
+        ]
+
+    record = {
+        "run_id": f"{int(time.time())}-{os.getpid()}",
+        "timestamp": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
+        "git_sha": git_sha(),
+        "device_kind": kind,
+        "steps": [],
+    }
+    for name, cmd, to in steps:
+        print(f"capture: running {name} ...", flush=True)
+        rec = run_step(name, cmd, to, env=env)
+        print(f"capture: {name} rc={rec['rc']} wall={rec['wall_s']}s "
+              f"rows={len(rec['rows'])}", flush=True)
+        record["steps"].append(rec)
+        # persist after EVERY step so a crash mid-suite loses nothing
+        append_measurement(dict(record, partial=(name != steps[-1][0])))
+
+    ok = all(s["rc"] == 0 for s in record["steps"])
+    print(f"capture: done ok={ok}; appended to {OUT}", flush=True)
+    sys.exit(0 if ok else 1)
+
+
+if __name__ == "__main__":
+    main()
