@@ -35,32 +35,31 @@ def main():
     # giving up.
     import subprocess
 
-    probe_src = (
-        "from mlsl_tpu.sysinfo import apply_platform_override\n"
-        "apply_platform_override()\n"
-        "import jax.numpy as jnp\n"
-        "jnp.ones((8, 8)).sum().block_until_ready(); print('ok', flush=True)"
-    )
+    from benchmarks._common import PROBE_SRC  # d2h-readback probe (not
+    # block_until_ready, which can acknowledge at dispatch through the tunnel)
+
     attempts = int(os.environ.get("MLSL_BENCH_PROBE_ATTEMPTS", "4"))
     probe_timeout = float(os.environ.get("MLSL_BENCH_PROBE_TIMEOUT", "180"))
     last_err = ""
     for attempt in range(attempts):
         child = subprocess.Popen(
-            [sys.executable, "-c", probe_src],
+            [sys.executable, "-c", PROBE_SRC],
             stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
             start_new_session=True,
             cwd=os.path.dirname(os.path.abspath(__file__)),
         )
-        deadline = time.time() + probe_timeout
-        while child.poll() is None and time.time() < deadline:
-            time.sleep(1)
-        if child.poll() is None:
+        try:
+            # communicate() drains pipes while waiting so a chatty runtime
+            # can't wedge an alive probe into a false timeout
+            _, err_out = child.communicate(timeout=probe_timeout)
+        except subprocess.TimeoutExpired:
             child.kill()  # best effort; do NOT wait() — a D-state child never reaps
             last_err = f"probe timed out after {probe_timeout:.0f}s"
-        elif child.returncode != 0:
-            last_err = f"probe exited {child.returncode}:\n{child.stderr.read()[-500:]}"
         else:
-            break
+            if child.returncode != 0:
+                last_err = f"probe exited {child.returncode}:\n{err_out[-500:]}"
+            else:
+                break
         if attempt + 1 < attempts:
             backoff = 30 * (2 ** attempt)
             print(f"bench: backend unreachable ({last_err.splitlines()[0]}); "

@@ -15,27 +15,37 @@ REPO_ROOT = os.path.join(os.path.dirname(os.path.abspath(__file__)), "..")
 sys.path.insert(0, REPO_ROOT)
 
 
+# Ends with float(...) — a true d2h readback — because block_until_ready can
+# acknowledge at dispatch through the axon tunnel (memory: axon-tunnel-timing),
+# which would let a half-dead tunnel probe ALIVE. The single source of truth
+# for every benchmark probe (bench.py, capture.py, kernels_on_chip.py).
+PROBE_SRC = (
+    "from mlsl_tpu.sysinfo import apply_platform_override\n"
+    "apply_platform_override()\n"
+    "import jax\n"
+    "import jax.numpy as jnp\n"
+    "v = float(jnp.ones((8, 8)).sum())\n"
+    "assert v == 64.0, v\n"
+    "print('KIND=' + jax.devices()[0].device_kind, flush=True)"
+)
+
+
 def probe_accelerator(tag: str, timeout: float = 180.0) -> None:
-    src = (
-        "from mlsl_tpu.sysinfo import apply_platform_override\n"
-        "apply_platform_override()\n"
-        "import jax.numpy as jnp\n"
-        "jnp.ones((8, 8)).sum().block_until_ready(); print('ok', flush=True)"
-    )
     child = subprocess.Popen(
-        [sys.executable, "-c", src], stdout=subprocess.PIPE,
+        [sys.executable, "-c", PROBE_SRC], stdout=subprocess.PIPE,
         stderr=subprocess.PIPE, text=True, start_new_session=True,
         cwd=REPO_ROOT,
     )
-    deadline = time.time() + timeout
-    while child.poll() is None and time.time() < deadline:
-        time.sleep(1)
-    if child.poll() is None:
+    try:
+        # communicate() drains pipes while waiting (a chatty runtime must not
+        # wedge an alive probe into a false timeout)
+        _, err = child.communicate(timeout=timeout)
+    except subprocess.TimeoutExpired:
         child.kill()  # best effort; a D-state child never reaps, so don't wait()
         print(f"{tag}: accelerator unreachable", file=sys.stderr)
         sys.exit(3)
     if child.returncode != 0:
-        print(f"{tag}: probe failed:\n{child.stderr.read()[-500:]}", file=sys.stderr)
+        print(f"{tag}: probe failed:\n{err[-500:]}", file=sys.stderr)
         sys.exit(3)
 
 

@@ -26,31 +26,22 @@ import time
 REPO = os.path.join(os.path.dirname(os.path.abspath(__file__)), "..")
 OUT = os.path.join(REPO, "BENCH_MEASURED.json")
 
-PROBE_SRC = (
-    "from mlsl_tpu.sysinfo import apply_platform_override\n"
-    "apply_platform_override()\n"
-    "import jax\n"
-    "import jax.numpy as jnp\n"
-    "jnp.ones((8, 8)).sum().block_until_ready()\n"
-    "print('KIND=' + jax.devices()[0].device_kind, flush=True)"
-)
-
-
 def probe(timeout: float = 90.0):
     """Returns device_kind string if the tunnel answers, else None."""
     child = subprocess.Popen(
         [sys.executable, "-c", PROBE_SRC], stdout=subprocess.PIPE,
         stderr=subprocess.PIPE, text=True, start_new_session=True, cwd=REPO,
     )
-    deadline = time.time() + timeout
-    while child.poll() is None and time.time() < deadline:
-        time.sleep(1)
-    if child.poll() is None:
-        child.kill()  # D-state children never reap; walk away
+    try:
+        # communicate() drains the pipes while waiting, so a chatty runtime
+        # can't fill the pipe and wedge an alive probe into a false negative
+        out, _ = child.communicate(timeout=timeout)
+    except subprocess.TimeoutExpired:
+        child.kill()  # best effort; a D-state child never reaps — walk away
         return None
     if child.returncode != 0:
         return None
-    for line in child.stdout.read().splitlines():
+    for line in out.splitlines():
         if line.startswith("KIND="):
             return line[5:]
     return None

@@ -13,39 +13,16 @@ exit 3 if the accelerator is unreachable (same probe as bench.py).
 import json
 import os
 import sys
-import time
 
 sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), ".."))
 
 
-def _probe():
-    import subprocess
-
-    src = (
-        "from mlsl_tpu.sysinfo import apply_platform_override\n"
-        "apply_platform_override()\n"
-        "import jax.numpy as jnp\n"
-        "jnp.ones((8, 8)).sum().block_until_ready(); print('ok', flush=True)"
-    )
-    child = subprocess.Popen(
-        [sys.executable, "-c", src], stdout=subprocess.PIPE,
-        stderr=subprocess.PIPE, text=True, start_new_session=True,
-        cwd=os.path.join(os.path.dirname(os.path.abspath(__file__)), ".."),
-    )
-    deadline = time.time() + 180
-    while child.poll() is None and time.time() < deadline:
-        time.sleep(1)
-    if child.poll() is None:
-        child.kill()
-        print("kernels_on_chip: accelerator unreachable", file=sys.stderr)
-        sys.exit(3)
-    if child.returncode != 0:
-        print(f"kernels_on_chip: probe failed:\n{child.stderr.read()[-500:]}",
-              file=sys.stderr)
-        sys.exit(3)
-
-
+from benchmarks._common import probe_accelerator as _probe_impl
 from benchmarks._common import timed as _time
+
+
+def _probe():
+    _probe_impl("kernels_on_chip")
 
 
 def main():

@@ -1,0 +1,285 @@
+/* mlsl.hpp — MLSL-compatible C++ surface (namespace MLSL) for mlsl_tpu.
+ *
+ * Drop-in compatibility header for the reference MLSL API
+ * (reference include/mlsl.hpp:82-913): same namespace, class names, method
+ * signatures, and rank-local void* buffer semantics, so a program written
+ * against the reference — e.g. its mlsl_test.cpp — ports with only launcher
+ * changes.
+ *
+ * Execution model: the reference runs one OS process per rank under mpiexec;
+ * this framework is single-controller SPMD (one process drives every device).
+ * The compat runtime bridges the two by running each "rank" as a THREAD of the
+ * controller process: MLSL::RunRanks(argc, argv, rankMain) spawns one thread
+ * per device, and every communication call rendezvouses the rank threads,
+ * assembles their rank-local buffers into the (world, count) device buffer,
+ * executes the collective once through the mlsl_tpu C API, and hands each
+ * rank its slice of the result. Rank-local pointer semantics (in-place Bcast,
+ * WaitComm returning a wire-buffer pointer, owned-shard increment AllGather)
+ * are preserved exactly.
+ *
+ * Requirements inherited from SPMD: every rank thread must issue collective
+ * and graph-construction calls in the same order (the same congruence MPI
+ * collectives already require).
+ */
+
+#ifndef MLSL_HPP
+#define MLSL_HPP
+
+#include <cstddef>
+
+#define MLSL_MAJOR_VERSION 1
+#define MLSL_MINOR_VERSION 0
+#define MLSL_VERSION(major, minor) ((major << 16) | (minor))
+#define MLSL_MAJOR(version) (version >> 16)
+#define MLSL_MINOR(version) (version & 0xFFFF)
+#define MLSL_VERSION_GE(v1, v2)                                               \
+  ((MLSL_MAJOR(v1) > MLSL_MAJOR(v2)) ||                                       \
+   (MLSL_MAJOR(v1) == MLSL_MAJOR(v2) && MLSL_MINOR(v1) == MLSL_MINOR(v2)) ||  \
+   (MLSL_MAJOR(v1) == MLSL_MAJOR(v2) && MLSL_MINOR(v1) > MLSL_MINOR(v2)))
+#define MLSL_VERSION_LT(v1, v2)                                               \
+  ((MLSL_MAJOR(v1) < MLSL_MAJOR(v2)) ||                                       \
+   (MLSL_MAJOR(v1) == MLSL_MAJOR(v2) && MLSL_MINOR(v1) < MLSL_MINOR(v2)))
+
+namespace MLSL
+{
+    typedef int CommReq;
+
+    enum DataType
+    {
+        DT_FLOAT  = 0,
+        DT_DOUBLE = 1,
+        DT_BYTE   = 2
+    };
+
+    enum PhaseType
+    {
+        PT_TRAIN = 0,
+        PT_TEST  = 1
+    };
+
+    enum GroupType
+    {
+        GT_DATA   = 0,
+        GT_MODEL  = 1,
+        GT_GLOBAL = 2
+    };
+
+    enum ReductionType
+    {
+        RT_SUM = 0,
+        RT_MIN = 1,
+        RT_MAX = 2
+    };
+
+    enum OpType
+    {
+        OT_CC     = 0,
+        OT_BIAS   = 1,
+        OT_ACT    = 2,
+        OT_POOL   = 3,
+        OT_SPLIT  = 4,
+        OT_CONCAT = 5,
+        OT_BCAST  = 6,
+        OT_REDUCE = 7,
+        OT_DATA   = 8,
+        OT_EVAL   = 9
+    };
+
+    enum CompressionType
+    {
+        CT_NONE         = 0,
+        CT_QUANTIZATION = 1
+    };
+
+    typedef struct
+    {
+        char* lib_path;
+        char* quant_buffer_func_name;
+        char* dequant_buffer_func_name;
+        char* reduce_sum_func_name;
+        size_t block_size;
+        size_t elem_in_block;
+    } QuantParams;
+
+    class CommBlockInfo
+    {
+    public:
+        size_t GetMbOffset();
+        size_t GetMbCount();
+        size_t GetFmOffset();
+        size_t GetFmCount();
+        size_t GetFmSize();
+        DataType GetDataType();
+        size_t GetBufOffset();
+    };
+
+    class Activation
+    {
+    public:
+        size_t GetGlobalFmCount();
+        size_t GetGlobalFmOffset();
+        size_t GetLocalFmCount();
+        size_t GetPackBlockCount();
+        size_t GetUnpackBlockCount();
+        CommBlockInfo* GetPackBlock(size_t idx);
+        CommBlockInfo* GetUnpackBlock(size_t idx);
+        DataType GetDataType();
+        size_t GetFmSize();
+        void* GetCommBuf();
+        size_t GetCommBufSize();
+        void StartComm(void* buf);
+        void* WaitComm();
+    };
+
+    class ParameterSet
+    {
+    public:
+        size_t GetGlobalKernelCount();
+        size_t GetGlobalKernelOffset();
+        size_t GetLocalKernelCount();
+        size_t GetOwnedKernelCount();
+        size_t GetOwnedKernelOffset();
+        DataType GetDataType();
+        size_t GetKernelSize();
+        bool IsDistributedUpdate();
+        void StartGradientComm(void* buf);
+        void StartIncrementComm(void* buf);
+        void* WaitGradientComm();
+        void* TestGradientComm(bool* isCompleted);
+        void* WaitIncrementComm();
+    };
+
+    class Distribution
+    {
+    public:
+        size_t GetProcessIdx(GroupType groupType);
+        size_t GetProcessCount(GroupType groupType);
+        CommReq* Bcast(void* buffer, size_t count, DataType dataType,
+                       size_t rootIdx, GroupType groupType);
+        CommReq* Reduce(void* sendBuffer, void* recvBuffer, size_t count,
+                        DataType dataType, ReductionType redType,
+                        size_t rootIdx, GroupType groupType);
+        CommReq* AllReduce(void* sendBuffer, void* recvBuffer, size_t count,
+                           DataType dataType, ReductionType redType,
+                           GroupType groupType);
+        CommReq* AlltoAll(void* sendBuffer, size_t sendCount, void* recvBuffer,
+                          DataType dataType, GroupType groupType);
+        CommReq* Gather(void* sendBuffer, size_t sendCount, void* recvBuffer,
+                        DataType dataType, size_t rootIdx, GroupType groupType);
+        CommReq* AllGather(void* sendBuffer, size_t sendCount, void* recvBuffer,
+                           DataType dataType, GroupType groupType);
+        CommReq* Scatter(void* sendBuffer, void* recvBuffer, size_t recvCount,
+                         DataType dataType, size_t rootIdx, GroupType groupType);
+        CommReq* ReduceScatter(void* sendBuffer, void* recvBuffer,
+                               size_t recvCount, DataType dataType,
+                               ReductionType redType, GroupType groupType);
+        void Barrier(GroupType groupType);
+    };
+
+    class OperationRegInfo
+    {
+    public:
+        void SetName(const char* name);
+        size_t AddInput(size_t featureMapCount, size_t featureMapSize,
+                        DataType dataType);
+        size_t AddOutput(size_t featureMapCount, size_t featureMapSize,
+                         DataType dataType);
+        size_t AddParameterSet(size_t kernelCount, size_t kernelSize,
+                               DataType dataType, bool distributedUpdate = false,
+                               CompressionType compressType = CT_NONE);
+        void Validate(Distribution* dist = NULL);
+    };
+
+    class Session;
+
+    class Operation
+    {
+    public:
+        void SetDistribution(Distribution* dist);
+        Distribution* GetDistribution();
+        Session* GetSession();
+        OpType GetOpType();
+        void SetPrev(Operation* prev, size_t actIdx, size_t prevOpActIdx);
+        void SetNext(Operation* next, size_t actIdx, size_t nextOpActIdx);
+        const char* GetName();
+        size_t GetGlobalMinibatchSize();
+        size_t GetLocalMinibatchSize();
+        size_t GetGlobalMinibatchOffset();
+        size_t GetInputCount();
+        Activation* GetInput(size_t idx);
+        size_t GetOutputCount();
+        Activation* GetOutput(size_t idx);
+        bool HasParameterSets();
+        size_t GetParameterSetCount();
+        ParameterSet* GetParameterSet(size_t idx);
+    };
+
+    class Statistics
+    {
+    public:
+        void Start();
+        void Stop();
+        void Reset();
+        bool IsStarted();
+        bool IsEnabled();
+        void Print();
+        unsigned long long GetIsolationCommCycles(size_t opIdx);
+        size_t GetCommSize(size_t opIdx);
+        unsigned long long GetCommCycles(size_t opIdx);
+        unsigned long long GetComputeCycles(size_t opIdx);
+        unsigned long long GetTotalIsolationCommCycles();
+        size_t GetTotalCommSize();
+        unsigned long long GetTotalCommCycles();
+        unsigned long long GetTotalComputeCycles();
+    };
+
+    class Session
+    {
+    public:
+        void SetGlobalMinibatchSize(size_t globalMinibatchSize);
+        size_t GetGlobalMinibatchSize();
+        PhaseType GetPhaseType();
+        OperationRegInfo* CreateOperationRegInfo(OpType opType);
+        void DeleteOperationRegInfo(OperationRegInfo* info);
+        size_t AddOperation(OperationRegInfo* info, Distribution* dist = NULL);
+        void RemoveOperations();
+        size_t GetOperationCount();
+        Operation* GetOperation(size_t idx);
+        void Commit();
+        Statistics* GetStats();
+    };
+
+    class Environment
+    {
+    public:
+        static Environment& GetEnv();
+        static int GetVersion();
+        void Configure(const char* config = NULL);
+        void Init(int* argc, char** argv[]);
+        void Finalize();
+        bool IsInitialized();
+        size_t GetProcessIdx();
+        size_t GetProcessCount();
+        Session* CreateSession(PhaseType phaseType = PT_TRAIN);
+        void DeleteSession(Session* session);
+        Distribution* CreateDistribution(size_t dataPartitions,
+                                         size_t modelPartitions);
+        void DeleteDistribution(Distribution* distribution);
+        void Wait(CommReq* req);
+        void Test(CommReq* req, bool* isCompleted);
+        void* Alloc(size_t size, size_t alignment);
+        void Free(void* ptr);
+        void SetQuantizationParams(QuantParams* params);
+        QuantParams* GetQuantizationParams();
+    };
+
+    /* Compat launcher (replaces mpiexec): spawns one rank thread per device of
+     * the attached platform, each running rankMain(argc, argv) with rank-local
+     * MLSL semantics. Returns the first nonzero rankMain result (0 if all
+     * succeed). worldOverride > 0 forces the rank count (must not exceed the
+     * device count). */
+    int RunRanks(int argc, char** argv, int (*rankMain)(int, char**),
+                 int worldOverride = 0);
+};
+
+#endif /* MLSL_HPP */

@@ -53,6 +53,11 @@ mlsl_handle_t mlsl_environment_create_session(void);
 /* ---- distribution collectives ---- */
 int64_t mlsl_distribution_get_process_count(mlsl_handle_t dist,
                                             mlsl_group_type_t group);
+/* Member index of world rank `global_idx` within the group (the per-rank
+ * GetProcessIdx of reference mlsl.hpp:361, rank made explicit). */
+int64_t mlsl_distribution_get_process_idx(mlsl_handle_t dist,
+                                          mlsl_group_type_t group,
+                                          int64_t global_idx);
 /* send: (world, count); returns a request handle (0 on failure). */
 mlsl_handle_t mlsl_distribution_all_reduce(mlsl_handle_t dist, const void* send,
                                            int64_t count, mlsl_data_type_t dt,
@@ -122,7 +127,10 @@ mlsl_handle_t mlsl_session_add_operation(mlsl_handle_t sess, mlsl_handle_t reg,
 int mlsl_session_commit(mlsl_handle_t sess);
 int mlsl_operation_set_next(mlsl_handle_t op, mlsl_handle_t next,
                             int64_t out_idx, int64_t in_idx);
+int mlsl_operation_set_prev(mlsl_handle_t op, mlsl_handle_t prev,
+                            int64_t in_idx, int64_t prev_out_idx);
 int64_t mlsl_operation_get_local_minibatch_size(mlsl_handle_t op);
+int64_t mlsl_operation_get_global_minibatch_size(mlsl_handle_t op);
 int64_t mlsl_operation_get_parameter_local_count(mlsl_handle_t op, int64_t idx);
 int64_t mlsl_operation_get_parameter_owned_count(mlsl_handle_t op, int64_t idx);
 
@@ -153,9 +161,15 @@ mlsl_handle_t mlsl_operation_get_output(mlsl_handle_t op, int64_t idx);
 int64_t mlsl_activation_get_global_fm_count(mlsl_handle_t act);
 int64_t mlsl_activation_get_local_fm_count(mlsl_handle_t act);
 int64_t mlsl_activation_get_fm_size(mlsl_handle_t act);
+/* Per-rank GetGlobalFmOffset with the rank's model-group index explicit. */
+int64_t mlsl_activation_get_global_fm_offset(mlsl_handle_t act,
+                                             int64_t model_idx);
 int mlsl_activation_needs_comm(mlsl_handle_t act);
 /* Per-rank wire-buffer element count for start_comm/wait_comm (0 = no comm). */
 int64_t mlsl_activation_get_wire_count(mlsl_handle_t act);
+/* Per-rank element count of this activation's request RESULT (what a peer's
+ * wait_comm writes per rank; 0 = no comm). */
+int64_t mlsl_activation_get_recv_count(mlsl_handle_t act);
 int64_t mlsl_activation_get_pack_block_count(mlsl_handle_t act);
 int64_t mlsl_activation_get_unpack_block_count(mlsl_handle_t act);
 /* field: 0=mb_offset 1=mb_count 2=fm_offset 3=fm_count 4=fm_size 5=buf_offset
@@ -194,6 +208,10 @@ int64_t mlsl_parameter_set_get_local_kernel_count(mlsl_handle_t op,
                                                   int64_t ps_idx);
 int64_t mlsl_parameter_set_get_owned_kernel_count(mlsl_handle_t op,
                                                   int64_t ps_idx);
+/* Per-rank GetOwnedKernelOffset with the rank's data-group index explicit. */
+int64_t mlsl_parameter_set_get_owned_kernel_offset(mlsl_handle_t op,
+                                                   int64_t ps_idx,
+                                                   int64_t data_idx);
 int64_t mlsl_parameter_set_get_kernel_size(mlsl_handle_t op, int64_t ps_idx);
 int mlsl_parameter_set_is_distributed_update(mlsl_handle_t op, int64_t ps_idx);
 

@@ -176,6 +176,12 @@ def dist_process_count(dist_h: int, group: int) -> int:
     return _get(dist_h).get_process_count(GroupType(group))
 
 
+def dist_process_idx(dist_h: int, group: int, global_idx: int) -> int:
+    """Member index of world rank `global_idx` within the group — the per-rank
+    GetProcessIdx (reference include/mlsl.hpp:361) with the rank explicit."""
+    return _get(dist_h).get_process_idx(GroupType(group), global_idx)
+
+
 # ---- session graph ----
 
 def session_set_minibatch(sess_h: int, size: int) -> int:
@@ -221,8 +227,17 @@ def operation_set_next(op_h: int, next_h: int, out_idx: int, in_idx: int) -> int
     return 0
 
 
+def operation_set_prev(op_h: int, prev_h: int, in_idx: int, prev_out_idx: int) -> int:
+    _get(op_h).set_prev(_get(prev_h), in_idx, prev_out_idx)
+    return 0
+
+
 def operation_local_minibatch(op_h: int) -> int:
     return _get(op_h).get_local_minibatch_size()
+
+
+def operation_global_minibatch(op_h: int) -> int:
+    return _get(op_h).get_global_minibatch_size()
 
 
 def operation_param_local_count(op_h: int, ps_idx: int) -> int:
@@ -256,7 +271,8 @@ def operation_output_count(op_h: int) -> int:
 
 def activation_query(act_h: int, what: int) -> int:
     """what: 0=global_fm_count 1=local_fm_count 2=fm_size 3=pack_block_count
-    4=unpack_block_count 5=comm_buf_size 6=need_comm 7=send_count."""
+    4=unpack_block_count 5=comm_buf_size 6=need_comm 7=send_count
+    8=recv_count."""
     act = _get(act_h)
     if what == 0:
         return act.get_global_fm_count()
@@ -274,6 +290,8 @@ def activation_query(act_h: int, what: int) -> int:
         return int(act.need_comm)
     if what == 7:
         return _act_wire_count(act)
+    if what == 8:
+        return _act_recv_count(act)
     raise ValueError(f"unknown activation query {what}")
 
 
@@ -288,6 +306,28 @@ def _act_wire_count(act) -> int:
         g = req.desc.group
         return req.desc.count * (1 if g.is_self else g.size)
     return req.desc.count
+
+
+def _act_recv_count(act) -> int:
+    """Per-rank element count of this activation's request RESULT (what a
+    peer's wait_comm delivers) — sizes the C caller's recv buffer."""
+    req = act.comm_req
+    if req is None:
+        return 0
+    g = req.desc.group
+    gsize = 1 if g.is_self else g.size
+    kind = req.desc.kind
+    if kind in ("allgather", "alltoall"):
+        return req.desc.count * gsize
+    if kind == "reduce_scatter":
+        return req.desc.recv_count
+    return req.desc.count  # allreduce
+
+
+def activation_fm_offset(act_h: int, model_idx: int) -> int:
+    """Per-rank GetGlobalFmOffset (reference include/mlsl.hpp:219) with the
+    rank's model-group index explicit."""
+    return _get(act_h).get_global_fm_offset(model_idx)
 
 
 def activation_block_query(act_h: int, is_unpack: int, idx: int, field: int) -> int:
@@ -416,6 +456,12 @@ def param_query(op_h: int, ps_idx: int, what: int) -> int:
     return (ps.get_global_kernel_count(), ps.get_local_kernel_count(),
             ps.get_owned_kernel_count(), ps.get_kernel_size(),
             int(ps.is_distributed_update()))[what]
+
+
+def param_owned_offset(op_h: int, ps_idx: int, data_idx: int) -> int:
+    """Per-rank GetOwnedKernelOffset (reference include/mlsl.hpp:298) with the
+    rank's data-group index explicit."""
+    return _get(op_h).get_parameter_set(ps_idx).get_owned_kernel_offset(data_idx)
 
 
 def param_test_gradient_comm(op_h: int, ps_idx: int) -> int:

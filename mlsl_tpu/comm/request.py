@@ -94,7 +94,6 @@ class CommRequest:
         self._quant_fns: Optional[List[Callable]] = None  # chunked quant programs
         self._err_lens: Optional[List[int]] = None
         self._errs: Optional[List[jax.Array]] = None
-        self._completed_via_test = False
         self.is_started = False
         self.is_setup = False
         self._epoch = 0
@@ -226,7 +225,6 @@ class CommRequest:
             self._epoch += 1
             self._results = []
             self._result = None
-            self._completed_via_test = False
             self._dispatch_error = None
             self.is_started = True
         self.dispatcher.submit(self, buf)
@@ -344,7 +342,6 @@ class CommRequest:
             out = self._assemble()
             jax.block_until_ready(out)
             self.is_started = False
-            self._completed_via_test = True
             return True, out
         return False, None
 

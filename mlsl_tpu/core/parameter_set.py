@@ -144,7 +144,12 @@ class ParameterSet:
     def wait_gradient_comm(self):
         self.op.session._stat_event(self, "wait", is_param=True)
         out = None
-        if self.need_comm and self.grad_req.is_started:
+        # A request completed via test() has is_started False but a cached
+        # result; wait() must still deliver it (MPI: MPI_Wait on a completed
+        # request). Only a never-started request yields None.
+        if self.need_comm and (
+            self.grad_req.is_started or self.grad_req._result is not None
+        ):
             out = self.grad_req.wait()
         self.op.session._stat_event(self, "wait_done", is_param=True)
         return out

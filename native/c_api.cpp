@@ -170,6 +170,12 @@ int64_t mlsl_distribution_get_process_count(mlsl_handle_t dist,
   return call_i("dist_process_count", {(int64_t)dist, (int64_t)group});
 }
 
+int64_t mlsl_distribution_get_process_idx(mlsl_handle_t dist,
+                                          mlsl_group_type_t group,
+                                          int64_t global_idx) {
+  return call_i("dist_process_idx", {(int64_t)dist, (int64_t)group, global_idx});
+}
+
 mlsl_handle_t mlsl_distribution_all_reduce(mlsl_handle_t dist, const void* send,
                                            int64_t count, mlsl_data_type_t dt,
                                            mlsl_reduction_t op,
@@ -295,8 +301,18 @@ int mlsl_operation_set_next(mlsl_handle_t op, mlsl_handle_t next,
                      {(int64_t)op, (int64_t)next, out_idx, in_idx});
 }
 
+int mlsl_operation_set_prev(mlsl_handle_t op, mlsl_handle_t prev,
+                            int64_t in_idx, int64_t prev_out_idx) {
+  return (int)call_i("operation_set_prev",
+                     {(int64_t)op, (int64_t)prev, in_idx, prev_out_idx});
+}
+
 int64_t mlsl_operation_get_local_minibatch_size(mlsl_handle_t op) {
   return call_i("operation_local_minibatch", {(int64_t)op});
+}
+
+int64_t mlsl_operation_get_global_minibatch_size(mlsl_handle_t op) {
+  return call_i("operation_global_minibatch", {(int64_t)op});
 }
 
 int64_t mlsl_operation_get_parameter_local_count(mlsl_handle_t op,
@@ -365,12 +381,21 @@ int64_t mlsl_activation_get_fm_size(mlsl_handle_t act) {
   return call_i("activation_query", {(int64_t)act, 2});
 }
 
+int64_t mlsl_activation_get_global_fm_offset(mlsl_handle_t act,
+                                             int64_t model_idx) {
+  return call_i("activation_fm_offset", {(int64_t)act, model_idx});
+}
+
 int mlsl_activation_needs_comm(mlsl_handle_t act) {
   return (int)call_i("activation_query", {(int64_t)act, 6});
 }
 
 int64_t mlsl_activation_get_wire_count(mlsl_handle_t act) {
   return call_i("activation_query", {(int64_t)act, 7});
+}
+
+int64_t mlsl_activation_get_recv_count(mlsl_handle_t act) {
+  return call_i("activation_query", {(int64_t)act, 8});
 }
 
 int64_t mlsl_activation_get_pack_block_count(mlsl_handle_t act) {
@@ -435,6 +460,12 @@ int64_t mlsl_parameter_set_get_local_kernel_count(mlsl_handle_t op,
 int64_t mlsl_parameter_set_get_owned_kernel_count(mlsl_handle_t op,
                                                   int64_t ps_idx) {
   return call_i("param_query", {(int64_t)op, ps_idx, 2});
+}
+
+int64_t mlsl_parameter_set_get_owned_kernel_offset(mlsl_handle_t op,
+                                                   int64_t ps_idx,
+                                                   int64_t data_idx) {
+  return call_i("param_owned_offset", {(int64_t)op, ps_idx, data_idx});
 }
 
 int64_t mlsl_parameter_set_get_kernel_size(mlsl_handle_t op, int64_t ps_idx) {

@@ -1,0 +1,411 @@
+/* compat_test — the reference's mlsl_test workload ported to the MLSL compat
+ * surface (include/mlsl.hpp).
+ *
+ * Re-implements the reference correctness program's semantics
+ * (tests/examples/mlsl_test/mlsl_test.cpp): a 2-layer CONV graph registered
+ * through Session/Operation/Distribution, driven through Forward / Backward1 /
+ * Backward2 / Update phases for 2 epochs x 3 minibatches, with buffers filled
+ * by algebraic index patterns and every exchanged value checked against the
+ * closed-form expectation:
+ *   - layer-1 forward input (after the model-group reduce+redistribute):
+ *     expected = fmGroupSize * (mb*localFm*fmSize*fmGroupSize
+ *                               + (fmOffset+fm)*fmSize + space)
+ *     (reference oracle mlsl_test.cpp:276-301);
+ *   - gradient after data-group sync: expected = mbGroupSize * (ownedOff+idx)
+ *     (reference oracle mlsl_test.cpp:397-406);
+ *   - parameters after the distributed-update increment AllGather: param[i]==i.
+ *
+ * Launcher difference from the reference: mpiexec spawns processes; here
+ * MLSL::RunRanks spawns one rank thread per device (the compat execution
+ * model). Everything between Init and Finalize is the same rank-local
+ * program.
+ *
+ * Usage: compat_test GROUP_COUNT [DIST_UPDATE] [USER_BUF] [USE_TEST]
+ */
+
+#include <cmath>
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+#include <string>
+#include <vector>
+
+#include "../include/mlsl.hpp"
+
+using namespace MLSL;
+
+namespace {
+
+const size_t kGlobalMinibatch = 16;
+const size_t kLayers = 2;
+const size_t kEpochs = 2;
+const size_t kMinibatchesPerEpoch = 3;
+
+struct Config {
+  size_t group_count = 1;
+  bool dist_update = false;
+  bool user_buf = false;
+  bool use_test = false;
+};
+Config cfg;
+
+struct Shape {
+  size_t ifm, ofm, fm_w, fm_h, kw, kh;
+};
+/* same conv shapes as the reference matrix (mlsl_test.cpp:619-644) */
+const Shape kShapes[kLayers] = {
+    {128, 256, 12, 12, 3, 3},
+    {256, 256, 12, 12, 3, 3},
+};
+
+#define CHECK(cond, ...)                                              \
+  do {                                                                \
+    if (!(cond)) {                                                    \
+      std::printf("[rank %zu] %s:%d CHECK(%s) failed: ",              \
+                  Environment::GetEnv().GetProcessIdx(), __FILE__,    \
+                  __LINE__, #cond);                                   \
+      std::printf(__VA_ARGS__);                                       \
+      std::printf("\n");                                              \
+      std::exit(1);                                                   \
+    }                                                                 \
+  } while (0)
+
+/* Pack a local activation tensor (localMb, localFm, fmSize) into the wire
+ * buffer per the CommBlockInfo layout (the user-side copy loop the reference
+ * test performs, mlsl_test.cpp:214-233 — rewritten, same contract). */
+void pack(Activation* act, const float* local, float* wire) {
+  size_t lfm = act->GetLocalFmCount();
+  for (size_t bi = 0; bi < act->GetPackBlockCount(); bi++) {
+    CommBlockInfo* b = act->GetPackBlock(bi);
+    float* dst = wire + b->GetBufOffset();
+    size_t fms = b->GetFmSize();
+    for (size_t m = 0; m < b->GetMbCount(); m++)
+      for (size_t f = 0; f < b->GetFmCount(); f++)
+        std::memcpy(
+            dst + (m * b->GetFmCount() + f) * fms,
+            local + ((m + b->GetMbOffset()) * lfm + f + b->GetFmOffset()) * fms,
+            fms * sizeof(float));
+  }
+}
+
+void unpack(Activation* act, const float* wire, float* local) {
+  size_t lfm = act->GetLocalFmCount();
+  for (size_t bi = 0; bi < act->GetUnpackBlockCount(); bi++) {
+    CommBlockInfo* b = act->GetUnpackBlock(bi);
+    const float* src = wire + b->GetBufOffset();
+    size_t fms = b->GetFmSize();
+    for (size_t m = 0; m < b->GetMbCount(); m++)
+      for (size_t f = 0; f < b->GetFmCount(); f++)
+        std::memcpy(
+            local + ((m + b->GetMbOffset()) * lfm + f + b->GetFmOffset()) * fms,
+            src + (m * b->GetFmCount() + f) * fms, fms * sizeof(float));
+  }
+}
+
+class TestLayer {
+ public:
+  TestLayer(size_t idx, Operation* op, TestLayer* prev)
+      : idx_(idx), op_(op) {
+    Activation* in = op->GetInput(0);
+    Activation* out = op->GetOutput(0);
+    size_t in_elems =
+        in->GetLocalFmCount() * op->GetLocalMinibatchSize() * in->GetFmSize();
+    out_elems_ =
+        out->GetLocalFmCount() * op->GetLocalMinibatchSize() * out->GetFmSize();
+    /* adjacent layers SHARE the activation buffer (reference
+     * mlsl_test.cpp:139-162: prev->outputActBuf = inputActBuf) so data flows
+     * even on edges with no communication (e.g. pure data parallelism) */
+    size_t store = in_elems;
+    if (prev != nullptr && prev->out_elems_ > store) store = prev->out_elems_;
+    in_store_.resize(store);
+    in_grad_store_.resize(store);
+    in_act_ = in_store_.data();
+    in_grad_ = in_grad_store_.data();
+    if (prev != nullptr) {
+      prev->out_act_ = in_act_;
+      prev->out_grad_ = in_grad_;
+      op->SetPrev(prev->op_, 0, 0);
+    }
+
+    ParameterSet* ps = op->GetParameterSet(0);
+    param_elems_ = ps->GetLocalKernelCount() * ps->GetKernelSize();
+    size_t bytes = param_elems_ * sizeof(float);
+    if (cfg.user_buf) {
+      param_ = (float*)std::malloc(bytes);
+      param_grad_ = (float*)std::malloc(bytes);
+    } else {
+      param_ = (float*)Environment::GetEnv().Alloc(bytes, 64);
+      param_grad_ = (float*)Environment::GetEnv().Alloc(bytes, 64);
+    }
+    CHECK(param_ != nullptr && param_grad_ != nullptr, "param alloc");
+    for (size_t i = 0; i < param_elems_; i++) param_[i] = (float)i;
+  }
+
+  ~TestLayer() {
+    if (cfg.user_buf) {
+      std::free(param_);
+      std::free(param_grad_);
+    } else {
+      Environment::GetEnv().Free(param_);
+      Environment::GetEnv().Free(param_grad_);
+    }
+  }
+
+  float* param() { return param_; }
+  size_t param_elems() const { return param_elems_; }
+  Operation* op() { return op_; }
+
+  /* Phase 1: receive input activation (and the previous increment), verify,
+   * produce output, send it (reference flow mlsl_test.cpp:440-461). */
+  void Forward() {
+    Activation* in = op_->GetInput(0);
+    float* wire = (float*)in->WaitComm();
+    if (wire != nullptr) unpack(in, wire, in_act_);
+    op_->GetParameterSet(0)->WaitIncrementComm();
+
+    VerifyForward();
+
+    Activation* out = op_->GetOutput(0);
+    if (idx_ == 0) {
+      /* layer 0 writes index values into its output */
+      for (size_t i = 0; i < out_elems_; i++) out_act_[i] = (float)i;
+    }
+    float* comm = (float*)out->GetCommBuf();
+    if (comm != nullptr && out_act_ != nullptr) {
+      pack(out, out_act_, comm);
+      out->StartComm(comm);
+    }
+    bwd_unpacked_ = false;
+  }
+
+  /* Phase 2: receive output-activation gradient, produce and send the
+   * input-activation gradient (mlsl_test.cpp:464-483). */
+  void Backward1() {
+    ReceiveOutputGrad();
+    if (idx_ == 0) {
+      VerifyOutputGrad();
+    } else {
+      /* last layer seeds the gradient so that layer 0's check below holds:
+       * grad value at (mb, fm, space) = mb*localFm*fmSize*groupSize
+       *                                 + (fmOffset+fm)*fmSize + space */
+      Activation* in = op_->GetInput(0);
+      size_t lfm = in->GetLocalFmCount();
+      size_t fms = in->GetFmSize();
+      size_t off = in->GetGlobalFmOffset();
+      size_t g = op_->GetDistribution()->GetProcessCount(GT_MODEL);
+      size_t mb = op_->GetLocalMinibatchSize();
+      for (size_t m = 0; m < mb; m++)
+        for (size_t f = 0; f < lfm; f++)
+          for (size_t s = 0; s < fms; s++)
+            in_grad_[(m * lfm + f) * fms + s] =
+                (float)(m * lfm * fms * g + (off + f) * fms + s);
+    }
+    Activation* in = op_->GetInput(0);
+    float* comm = (float*)in->GetCommBuf();
+    if (comm != nullptr) {
+      pack(in, in_grad_, comm);
+      in->StartComm(comm);
+    }
+  }
+
+  /* Phase 3: produce and send the parameter gradient (mlsl_test.cpp:486-503). */
+  void Backward2() {
+    ReceiveOutputGrad();
+    for (size_t i = 0; i < param_elems_; i++) param_grad_[i] = (float)i;
+    op_->GetParameterSet(0)->StartGradientComm(param_grad_);
+  }
+
+  /* Phase 4: receive the synced gradient, verify the data-group reduction,
+   * update owned parameters, send the increment (mlsl_test.cpp:506-528). */
+  void Update() {
+    ParameterSet* ps = op_->GetParameterSet(0);
+    float* synced = nullptr;
+    if (cfg.use_test) {
+      bool done = false;
+      while (!done) synced = (float*)ps->TestGradientComm(&done);
+    } else {
+      synced = (float*)ps->WaitGradientComm();
+    }
+    if (synced == nullptr) synced = param_grad_;
+
+    size_t ksize = ps->GetKernelSize();
+    size_t owned = ps->GetOwnedKernelCount() * ksize;
+    size_t owned_off = ps->GetOwnedKernelOffset() * ksize;
+    size_t mb_group = op_->GetDistribution()->GetProcessCount(GT_DATA);
+    size_t bad = 0;
+    for (size_t i = 0; i < owned; i++) {
+      float expected = (float)(mb_group * (owned_off + i));
+      if (std::fabs(synced[i] - expected) > 1e-4) bad++;
+      param_[owned_off + i] = (float)(owned_off + i);
+    }
+    CHECK(bad == 0, "update_%zu: %zu gradient mismatches", idx_, bad);
+    ps->StartIncrementComm(param_);
+  }
+
+ private:
+  void ReceiveOutputGrad() {
+    if (bwd_unpacked_) return;
+    Activation* out = op_->GetOutput(0);
+    float* wire = (float*)out->WaitComm();
+    if (wire != nullptr && out_grad_ != nullptr) unpack(out, wire, out_grad_);
+    bwd_unpacked_ = true;
+  }
+
+  void VerifyForward() {
+    /* parameters must hold index values on every rank after increment sync */
+    size_t bad = 0;
+    for (size_t i = 0; i < param_elems_; i++)
+      if (std::fabs(param_[i] - (float)i) > 1e-4) bad++;
+    CHECK(bad == 0, "forward_%zu: %zu parameter mismatches", idx_, bad);
+
+    if (idx_ != 1) return;
+    /* layer 1's input came from layer 0's output through the model-group
+     * reduce + redistribution; closed form per mlsl_test.cpp:276-301 */
+    Activation* in = op_->GetInput(0);
+    size_t lfm = in->GetLocalFmCount();
+    size_t fms = in->GetFmSize();
+    size_t off = in->GetGlobalFmOffset();
+    size_t g = op_->GetDistribution()->GetProcessCount(GT_MODEL);
+    size_t mb = op_->GetLocalMinibatchSize();
+    bad = 0;
+    for (size_t m = 0; m < mb && bad < 5; m++)
+      for (size_t f = 0; f < lfm; f++)
+        for (size_t s = 0; s < fms; s++) {
+          float expected =
+              (float)(g * (m * lfm * fms * g + (off + f) * fms + s));
+          float got = in_act_[(m * lfm + f) * fms + s];
+          if (std::fabs(got - expected) > 1e-4) {
+            if (bad < 5)
+              std::printf("[rank %zu] fwd_%zu mismatch at (%zu,%zu,%zu): "
+                          "want %.0f got %.0f\n",
+                          Environment::GetEnv().GetProcessIdx(), idx_, m, f, s,
+                          expected, got);
+            bad++;
+          }
+        }
+    CHECK(bad == 0, "forward_%zu: input activation mismatches", idx_);
+  }
+
+  void VerifyOutputGrad() {
+    /* layer 0's output gradient equals layer 1's seeded input gradient:
+     * identity after the backward redistribution (mlsl_test.cpp:338-361) */
+    size_t bad = 0;
+    for (size_t i = 0; i < out_elems_; i++)
+      if (std::fabs(out_grad_[i] - (float)i) > 1e-4) bad++;
+    CHECK(bad == 0, "backward_%zu: %zu output-grad mismatches", idx_, bad);
+  }
+
+  size_t idx_;
+  Operation* op_;
+  std::vector<float> in_store_, in_grad_store_;
+  float* in_act_ = nullptr;
+  float* in_grad_ = nullptr;
+  float* out_act_ = nullptr;   // aliases the next layer's input store
+  float* out_grad_ = nullptr;  // aliases the next layer's input-grad store
+  size_t out_elems_ = 0;
+  float* param_ = nullptr;
+  float* param_grad_ = nullptr;
+  size_t param_elems_ = 0;
+  bool bwd_unpacked_ = false;
+};
+
+int rank_main(int argc, char** argv) {
+  Environment& env = Environment::GetEnv();
+  CHECK(MLSL_MAJOR(Environment::GetVersion()) == MLSL_MAJOR_VERSION,
+        "API version mismatch");
+  env.Init(&argc, &argv);
+
+  size_t world = env.GetProcessCount();
+  size_t rank = env.GetProcessIdx();
+  if (cfg.group_count > world) cfg.group_count = world;
+
+  Session* session = env.CreateSession();
+  session->SetGlobalMinibatchSize(kGlobalMinibatch);
+  Distribution* dist =
+      env.CreateDistribution(world / cfg.group_count, cfg.group_count);
+
+  if (rank == 0)
+    std::printf("compat_test: world=%zu dist=%zux%zu dist_update=%d "
+                "user_buf=%d use_test=%d\n",
+                world, world / cfg.group_count, cfg.group_count,
+                (int)cfg.dist_update, (int)cfg.user_buf, (int)cfg.use_test);
+
+  std::vector<TestLayer*> layers;
+  for (size_t li = 0; li < kLayers; li++) {
+    const Shape& sh = kShapes[li];
+    OperationRegInfo* reg = session->CreateOperationRegInfo(OT_CC);
+    reg->SetName(("layer_" + std::to_string(li)).c_str());
+    reg->AddInput(sh.ifm, sh.fm_w * sh.fm_h, DT_FLOAT);
+    reg->AddOutput(sh.ofm, sh.fm_w * sh.fm_h, DT_FLOAT);
+    reg->AddParameterSet(sh.ifm * sh.ofm, sh.kw * sh.kh, DT_FLOAT,
+                         cfg.dist_update, CT_NONE);
+    size_t op_idx = session->AddOperation(reg, dist);
+    session->DeleteOperationRegInfo(reg);
+    layers.push_back(new TestLayer(li, session->GetOperation(op_idx),
+                                   li == 0 ? nullptr : layers[li - 1]));
+    /* broadcast initial parameters from rank 0 (mlsl_test.cpp:651-652) */
+    CommReq* req = dist->Bcast(layers[li]->param(), layers[li]->param_elems(),
+                               DT_FLOAT, 0, GT_GLOBAL);
+    env.Wait(req);
+  }
+
+  session->Commit();
+
+  Statistics* stats = session->GetStats();
+  stats->Start();
+
+  for (size_t epoch = 0; epoch < kEpochs; epoch++) {
+    for (size_t mb = 0; mb < kMinibatchesPerEpoch; mb++) {
+      for (size_t li = 0; li < kLayers; li++) layers[li]->Forward();
+      for (size_t li = kLayers; li-- > 0;) {
+        layers[li]->Backward1();
+        layers[li]->Backward2();
+      }
+      for (size_t li = 0; li < kLayers; li++) layers[li]->Update();
+    }
+    /* drain increment comms at epoch end (mlsl_test.cpp:689-697) */
+    for (size_t li = 0; li < kLayers; li++)
+      layers[li]->op()->GetParameterSet(0)->WaitIncrementComm();
+  }
+
+  stats->Stop();
+  if (stats->IsEnabled()) stats->Print();
+
+  for (TestLayer* l : layers) delete l;
+  env.DeleteSession(session);
+  env.DeleteDistribution(dist);
+  env.Finalize();
+  if (rank == 0) std::printf("compat_test: PASSED\n");
+  return 0;
+}
+
+}  // namespace
+
+#include <execinfo.h>
+#include <csignal>
+#include <unistd.h>
+
+static void segv_handler(int sig) {
+  void* frames[48];
+  int n = backtrace(frames, 48);
+  std::fprintf(stderr, "compat_test: signal %d, backtrace:\n", sig);
+  backtrace_symbols_fd(frames, n, 2);
+  _exit(139);
+}
+
+int main(int argc, char** argv) {
+  std::setvbuf(stdout, nullptr, _IONBF, 0);
+  std::signal(SIGSEGV, segv_handler);
+  std::signal(SIGABRT, segv_handler);
+  if (argc < 2) {
+    std::printf(
+        "usage: compat_test GROUP_COUNT [DIST_UPDATE] [USER_BUF] [USE_TEST]\n");
+    return 0;
+  }
+  cfg.group_count = (size_t)std::atoi(argv[1]);
+  if (cfg.group_count < 1) cfg.group_count = 1;
+  if (argc > 2) cfg.dist_update = std::atoi(argv[2]) != 0;
+  if (argc > 3) cfg.user_buf = std::atoi(argv[3]) != 0;
+  if (argc > 4) cfg.use_test = std::atoi(argv[4]) != 0;
+  return MLSL::RunRanks(argc, argv, rank_main);
+}

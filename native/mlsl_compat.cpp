@@ -1,0 +1,1239 @@
+/* mlsl_compat.cpp — MLSL-compatible rank-thread runtime (include/mlsl.hpp).
+ *
+ * Bridges the reference's per-rank MPI programming model (reference
+ * include/mlsl.hpp:82-913; one OS process per rank, rank-local void* buffers)
+ * onto the single-controller SPMD core, through the flat C API
+ * (include/mlsl_tpu.h). Each "rank" is a thread; every communication call
+ * rendezvouses the rank threads, the LAST arrival assembles the rank-local
+ * buffers into one (world, count) staging buffer and issues the collective
+ * once, and each rank receives a pointer to its slice of the result. Graph
+ * construction calls (CreateDistribution, AddOperation, Commit, ...) execute
+ * exactly once per matched call site via the same rendezvous.
+ *
+ * Semantics preserved from the reference:
+ *  - in-place Bcast (Environment::Wait writes the result back into the
+ *    caller's buffer);
+ *  - Activation::WaitComm waits the PEER's transfer and returns a wire-buffer
+ *    pointer (reference src/mlsl_impl.cpp:377-380);
+ *  - ParameterSet::StartIncrementComm takes the FULL local parameter buffer
+ *    and gathers each data rank's owned shard back into it in place
+ *    (reference usage tests/examples/mlsl_test/mlsl_test.cpp:526);
+ *  - Wait with nothing started returns NULL (empty-request no-op).
+ *
+ * Constraint inherited from SPMD: all ranks must issue collective and
+ * construction calls congruently (same order) — the same requirement MPI
+ * collectives impose. Result pointers returned by a Wait are valid until the
+ * same entity's next-but-one Start (double-buffered rounds).
+ *
+ * This layer is a compatibility surface, not the hot path: per-call staging
+ * copies are the cost of exact rank-local pointer semantics. Performance
+ * work lives in the Python/JAX core.
+ */
+
+#include "../include/mlsl.hpp"
+#include "../include/mlsl_tpu.h"
+
+#include <atomic>
+#include <condition_variable>
+#include <cstdint>
+#include <cstdlib>
+#include <cstring>
+#include <deque>
+#include <functional>
+#include <map>
+#include <mutex>
+#include <stdexcept>
+#include <string>
+#include <thread>
+#include <unordered_map>
+#include <vector>
+
+namespace MLSL {
+namespace {
+
+int g_world = 0;
+thread_local int tl_rank = -1;
+
+size_t dt_size(int dt) { return dt == DT_DOUBLE ? 8 : dt == DT_BYTE ? 1 : 4; }
+
+[[noreturn]] void die(const std::string& msg) {
+  std::fprintf(stderr, "mlsl_compat: %s (last error: %s)\n", msg.c_str(),
+               mlsl_get_last_error());
+  std::abort();
+}
+
+/* ---- shared_call: execute fn exactly once across the world ------------- */
+
+struct SharedSlot {
+  std::mutex mu;
+  std::condition_variable cv;
+  int arrived = 0;
+  bool done = false;
+  uint64_t result = 0;
+};
+
+std::deque<SharedSlot> g_slots;
+std::mutex g_slots_mu;
+thread_local size_t tl_shared_seq = 0;
+
+SharedSlot& slot_at(size_t i) {
+  std::lock_guard<std::mutex> lk(g_slots_mu);
+  while (g_slots.size() <= i) g_slots.emplace_back();
+  return g_slots[i];
+}
+
+/* All ranks arrive (in matched program order); the last arrival runs fn; all
+ * ranks observe the result. Construction-phase rendezvous. */
+uint64_t shared_call(const std::function<uint64_t()>& fn) {
+  SharedSlot& s = slot_at(tl_shared_seq++);
+  std::unique_lock<std::mutex> lk(s.mu);
+  s.arrived++;
+  if (s.arrived == g_world) {
+    s.result = fn();
+    s.done = true;
+    s.cv.notify_all();
+  } else {
+    s.cv.wait(lk, [&] { return s.done; });
+  }
+  return s.result;
+}
+
+/* ---- Channel: one comm entity's rendezvous + round state --------------- */
+
+struct DistImpl;
+std::atomic<uint64_t> g_channel_ids{1};
+
+struct Channel {
+  const uint64_t id = g_channel_ids.fetch_add(1);  // stable key across reuse
+  std::mutex mu;
+  std::condition_variable cv;
+  int arrived = 0;
+  long dispatched_rounds = 0;
+  long completed_rounds = 0;
+  bool waiting = false;  // one thread at a time executes the global wait
+  /* one-shot (generic collective) channels are reclaimed after every rank
+   * consumed their single round — a training loop issuing Distribution
+   * collectives per step must not accumulate staging buffers */
+  bool one_shot = false;
+  int consumed = 0;
+  DistImpl* owner = nullptr;
+  long seq = -1;
+
+  /* recv/user state is round-parity double-buffered: the FIRST depositor of
+   * round N+1 resets slot (N+1)&1 while a lagging rank may still be reading
+   * round N's slot N&1 inside channel_wait — single-buffered state would be
+   * clobbered under it. Round N+2 cannot start before every rank finished
+   * waiting round N (each rank's deposits are ordered after its waits), so
+   * two slots suffice. */
+  std::vector<char> send_buf;            // (world, send_elems) staging
+  std::vector<char> recv_buf[2];         // round-parity double buffer
+  int64_t recv_n[2] = {0, 0};            // per-rank elems actually received
+  std::vector<void*> user_ptr[2];        // per-rank in-place write-back target
+  uint64_t c_req = 0;                    // generic request handle (if any)
+  size_t esize = 4;
+
+  std::function<void(const void*)> start_fn;  // issues the global collective
+  std::function<int64_t(void*)> wait_fn;      // completes it; returns per-rank n
+};
+
+struct TLCounts {
+  long started = 0;
+  long waited = 0;
+};
+/* keyed by channel id, not pointer: a reclaimed channel's address can be
+ * reused, and stale counts under the old pointer would corrupt round math */
+thread_local std::unordered_map<uint64_t, TLCounts> tl_counts;
+
+void reclaim_one_shot(Channel& ch);  // defined after DistImpl
+
+/* Deposit this rank's send data (src may be null: no payload, e.g. non-root
+ * scatter) and this rank's write-back pointer; the last depositor issues the
+ * collective. recv_elems sizes the result staging buffer (upper bound). */
+void channel_start(Channel& ch, const void* src, size_t elems,
+                   size_t esize, int64_t recv_elems, void* user_ptr,
+                   std::function<void(const void*)> start_fn,
+                   std::function<int64_t(void*)> wait_fn) {
+  TLCounts& tl = tl_counts[ch.id];
+  std::unique_lock<std::mutex> lk(ch.mu);
+  long round = tl.started;
+  tl.started++;
+  if (ch.arrived == 0) {
+    ch.send_buf.assign((size_t)g_world * elems * esize, 0);
+    ch.user_ptr[round & 1].assign(g_world, nullptr);
+    ch.esize = esize;
+    ch.start_fn = std::move(start_fn);
+    ch.wait_fn = std::move(wait_fn);
+    ch.recv_buf[round & 1].assign(
+        (size_t)g_world * (recv_elems > 0 ? (size_t)recv_elems : 1) * esize, 0);
+  }
+  if (src != nullptr && elems > 0)
+    std::memcpy(ch.send_buf.data() + (size_t)tl_rank * elems * esize, src,
+                elems * esize);
+  ch.user_ptr[round & 1][tl_rank] = user_ptr;
+  ch.arrived++;
+  if (ch.arrived == g_world) {
+    ch.arrived = 0;
+    ch.start_fn(ch.send_buf.data());
+    ch.dispatched_rounds = round + 1;
+    ch.cv.notify_all();
+  } else {
+    ch.cv.wait(lk, [&] { return ch.dispatched_rounds > round; });
+  }
+}
+
+/* Complete this rank's oldest outstanding round. Returns the rank's slice (or
+ * the registered user pointer after in-place write-back); null when nothing
+ * is pending or the collective produced nothing (no-comm degenerate group). */
+void* channel_wait(Channel& ch) {
+  TLCounts& tl = tl_counts[ch.id];
+  if (tl.waited == tl.started) return nullptr;  // nothing pending on this rank
+  long round = tl.waited;
+  tl.waited++;
+  std::unique_lock<std::mutex> lk(ch.mu);
+  while (ch.completed_rounds <= round) {
+    if (!ch.waiting) {
+      ch.waiting = true;
+      std::function<int64_t(void*)> wfn = ch.wait_fn;
+      char* dst = ch.recv_buf[round & 1].data();
+      lk.unlock();
+      int64_t n = wfn(dst);
+      lk.lock();
+      ch.recv_n[round & 1] = n;
+      ch.completed_rounds = round + 1;
+      ch.waiting = false;
+      ch.cv.notify_all();
+    } else {
+      ch.cv.wait(lk);
+    }
+  }
+  int64_t n = ch.recv_n[round & 1];
+  char* mine = nullptr;
+  void* up = nullptr;
+  if (n > 0) {
+    mine = ch.recv_buf[round & 1].data() + (size_t)tl_rank * n * ch.esize;
+    up = ch.user_ptr[round & 1][tl_rank];
+  }
+  lk.unlock();
+  if (up != nullptr) std::memcpy(up, mine, (size_t)n * ch.esize);
+  if (ch.one_shot) {
+    /* consume accounting LAST — for one-shot channels the rank that brings
+     * consumed to world reclaims the channel, so every other rank must have
+     * finished touching it (including the memcpy above) by then. The slice
+     * pointer is not handed out for one-shot channels (results land in the
+     * caller's registered buffer), so freeing recv_buf here is safe. */
+    tl_counts.erase(ch.id);
+    bool last;
+    {
+      std::lock_guard<std::mutex> lk2(ch.mu);
+      ch.consumed++;
+      last = ch.consumed == g_world;
+    }
+    if (last) reclaim_one_shot(ch);
+    return up;  // internal slice must not escape a reclaimed channel
+  }
+  return up != nullptr ? up : mine;
+}
+
+/* Non-consuming poll + consume-on-complete (reference TestGradientComm
+ * semantics: NULL until complete, then the result pointer). */
+void* channel_test(Channel& ch, const std::function<int(void)>& test_fn,
+                   bool* is_completed) {
+  TLCounts& tl = tl_counts[ch.id];
+  if (tl.waited == tl.started) {  // nothing in flight: trivially complete
+    *is_completed = true;
+    return nullptr;
+  }
+  long round = tl.waited;
+  {
+    std::unique_lock<std::mutex> lk(ch.mu);
+    if (ch.completed_rounds <= round) {
+      if (ch.waiting) {  // someone is already completing it; poll again later
+        *is_completed = false;
+        return nullptr;
+      }
+      lk.unlock();
+      int done = test_fn();
+      if (done <= 0) {
+        *is_completed = false;
+        return nullptr;
+      }
+      /* complete: fall through to channel_wait, which performs the (now
+       * immediate) global wait and consumes this rank's round */
+    }
+  }
+  *is_completed = true;
+  return channel_wait(ch);
+}
+
+/* ---- impl structs (pimpl-by-reinterpret, the reference's own pattern:
+ * public classes carry no data, methods downcast to *Impl) ---------------- */
+
+struct BlockImpl {
+  size_t mb_off, mb_cnt, fm_off, fm_cnt, fm_size, buf_off;
+  int dt;
+};
+
+struct SessImpl;
+struct OpImpl;
+
+struct DistImpl {
+  uint64_t h = 0;
+  size_t data_parts = 0, model_parts = 0;
+  /* generic-collective channels, keyed by per-rank call sequence (congruent
+   * program order makes the k-th call on every rank the same collective) */
+  std::map<long, Channel*> gen;
+  std::mutex gen_mu;
+  Channel& gen_channel(long seq) {
+    std::lock_guard<std::mutex> lk(gen_mu);
+    Channel*& c = gen[seq];
+    if (c == nullptr) {
+      c = new Channel();
+      c->one_shot = true;
+      c->owner = this;
+      c->seq = seq;
+    }
+    return *c;
+  }
+};
+thread_local std::unordered_map<const void*, long> tl_gen_seq;
+
+void reclaim_one_shot(Channel& ch) {
+  DistImpl* owner = ch.owner;
+  if (owner != nullptr) {
+    std::lock_guard<std::mutex> lk(owner->gen_mu);
+    auto it = owner->gen.find(ch.seq);
+    if (it != owner->gen.end() && it->second == &ch) owner->gen.erase(it);
+  }
+  delete &ch;
+}
+
+struct ActImpl {
+  uint64_t h = 0;
+  OpImpl* op = nullptr;
+  bool is_input = false;
+  ActImpl* peer = nullptr;
+  Channel ch;
+  std::vector<BlockImpl> pack, unpack;
+  std::vector<std::vector<char>> comm_bufs;  // per-rank GetCommBuf storage
+  std::mutex bufs_mu;
+  size_t wire = 0;    // per-rank wire elems for StartComm
+  size_t recvn = 0;   // per-rank result elems of this act's request
+  int dt = DT_FLOAT;
+  size_t global_fm = 0, local_fm = 0, fm_size = 0;
+};
+
+struct PSImpl {
+  uint64_t oph = 0;
+  int idx = 0;
+  OpImpl* op = nullptr;
+  Channel grad_ch, inc_ch;
+  int dt = DT_FLOAT;
+};
+
+struct OpImpl {
+  uint64_t h = 0;
+  DistImpl* dist = nullptr;
+  SessImpl* sess = nullptr;
+  std::string name;
+  int op_type = OT_CC;
+  std::vector<ActImpl*> ins, outs;
+  std::vector<PSImpl*> pss;
+};
+
+struct RegImpl {
+  uint64_t h = 0;
+  SessImpl* sess = nullptr;
+  int op_type = OT_CC;
+  std::string name;
+  int n_in = 0, n_out = 0, n_ps = 0;
+  std::vector<int> in_dt, out_dt, ps_dt;
+};
+
+struct StatsImpl {
+  uint64_t h = 0;
+};
+
+struct SessImpl {
+  uint64_t h = 0;
+  size_t global_mb = 0;
+  std::vector<OpImpl*> ops;
+  StatsImpl* stats = nullptr;
+};
+
+struct EnvState {
+  bool initialized = false;
+  QuantParams quant = {};
+  bool quant_set = false;
+};
+EnvState g_env;
+
+Environment g_env_obj;  // the singleton facade (stateless; state lives above)
+
+/* ---- rank-thread launcher --------------------------------------------- */
+
+}  // namespace
+
+int RunRanks(int argc, char** argv, int (*rankMain)(int, char**),
+             int worldOverride) {
+  if (mlsl_environment_init() != MLSL_TPU_SUCCESS)
+    die("environment init failed");
+  int devs = (int)mlsl_environment_get_process_count();
+  g_world = worldOverride > 0 ? worldOverride : devs;
+  if (g_world > devs) die("worldOverride exceeds device count");
+  g_env.initialized = true;
+  std::atomic<int> rc{0};
+  std::vector<std::thread> threads;
+  threads.reserve(g_world);
+  for (int r = 0; r < g_world; r++) {
+    threads.emplace_back([&, r] {
+      tl_rank = r;
+      int ret = rankMain(argc, argv);
+      if (ret != 0) {
+        int expected = 0;
+        rc.compare_exchange_strong(expected, ret);
+      }
+    });
+  }
+  for (auto& t : threads) t.join();
+  return rc.load();
+}
+
+/* ---- Environment ------------------------------------------------------- */
+
+Environment& Environment::GetEnv() { return g_env_obj; }
+int Environment::GetVersion() {
+  return MLSL_VERSION(MLSL_MAJOR_VERSION, MLSL_MINOR_VERSION);
+}
+void Environment::Configure(const char*) {}
+void Environment::Init(int*, char***) {
+  /* the runtime is brought up once by RunRanks; per-rank Init is bookkeeping */
+  if (tl_rank < 0) die("Environment::Init outside a RunRanks rank thread");
+}
+void Environment::Finalize() {
+  shared_call([] { return (uint64_t)mlsl_environment_finalize(); });
+}
+bool Environment::IsInitialized() { return g_env.initialized; }
+size_t Environment::GetProcessIdx() { return (size_t)tl_rank; }
+size_t Environment::GetProcessCount() { return (size_t)g_world; }
+
+void* Environment::Alloc(size_t size, size_t alignment) {
+  void* p = nullptr;
+  if (alignment < sizeof(void*)) alignment = sizeof(void*);
+  if (posix_memalign(&p, alignment, size ? size : alignment) != 0) return nullptr;
+  return p;
+}
+void Environment::Free(void* ptr) { free(ptr); }
+
+void Environment::SetQuantizationParams(QuantParams* params) {
+  /* The reference dlopens a user library (quant/quant.c:96-133); the TPU
+   * core's codecs are jnp/Pallas callables registered through the Python API
+   * (set_quantization_params). Here we record the request; CT_QUANTIZATION
+   * parameter sets then use the core's built-in int8 block codec with
+   * elem_in_block honored. */
+  if (params != nullptr) {
+    g_env.quant = *params;
+    g_env.quant_set = true;
+  }
+}
+QuantParams* Environment::GetQuantizationParams() {
+  return g_env.quant_set ? &g_env.quant : nullptr;
+}
+
+Distribution* Environment::CreateDistribution(size_t dataPartitions,
+                                              size_t modelPartitions) {
+  uint64_t r = shared_call([&]() -> uint64_t {
+    DistImpl* d = new DistImpl();
+    d->h = mlsl_environment_create_distribution((int64_t)dataPartitions,
+                                                (int64_t)modelPartitions, 1);
+    if (d->h == 0) die("CreateDistribution failed");
+    d->data_parts = dataPartitions;
+    d->model_parts = modelPartitions;
+    return (uint64_t)(uintptr_t)d;
+  });
+  return (Distribution*)(uintptr_t)r;
+}
+
+void Environment::DeleteDistribution(Distribution* distribution) {
+  shared_call([&]() -> uint64_t {
+    DistImpl* d = (DistImpl*)distribution;
+    if (d != nullptr) {
+      mlsl_handle_release(d->h);
+      /* every rank has arrived here (shared_call), so no channel is in use;
+       * outstanding CommReq* from this distribution are invalidated, as the
+       * reference invalidates requests at Finalize */
+      for (auto& kv : d->gen) delete kv.second;
+      delete d;
+    }
+    return 0;
+  });
+}
+
+Session* Environment::CreateSession(PhaseType) {
+  uint64_t r = shared_call([]() -> uint64_t {
+    SessImpl* s = new SessImpl();
+    s->h = mlsl_environment_create_session();
+    if (s->h == 0) die("CreateSession failed");
+    return (uint64_t)(uintptr_t)s;
+  });
+  return (Session*)(uintptr_t)r;
+}
+
+void Environment::DeleteSession(Session* session) {
+  shared_call([&]() -> uint64_t {
+    SessImpl* s = (SessImpl*)session;
+    if (s != nullptr) mlsl_handle_release(s->h);
+    return 0;
+  });
+}
+
+void Environment::Wait(CommReq* req) {
+  if (req == nullptr) return;
+  Channel* ch = (Channel*)req;
+  channel_wait(*ch);
+}
+
+void Environment::Test(CommReq* req, bool* isCompleted) {
+  if (req == nullptr) {
+    *isCompleted = true;
+    return;
+  }
+  Channel* ch = (Channel*)req;
+  channel_test(
+      *ch, [ch] { return mlsl_request_test(ch->c_req); }, isCompleted);
+}
+
+/* ---- Distribution ------------------------------------------------------ */
+
+namespace {
+
+DistImpl* D(Distribution* d) { return (DistImpl*)d; }
+
+size_t group_size(DistImpl* d, GroupType g) {
+  int64_t n = mlsl_distribution_get_process_count(d->h, (mlsl_group_type_t)g);
+  return n > 0 ? (size_t)n : 1;
+}
+
+/* Start a generic collective through the flat C API. The per-round request
+ * handle is captured by the wait closure. */
+CommReq* generic_start(DistImpl* d, const void* src, size_t send_elems,
+                       int dt, int64_t recv_elems, void* user_recv,
+                       std::function<uint64_t(const void*)> issue) {
+  long seq = tl_gen_seq[d]++;
+  Channel& ch = d->gen_channel(seq);
+  Channel* chp = &ch;
+  channel_start(
+      ch, src, send_elems, dt_size(dt), recv_elems, user_recv,
+      [issue, chp](const void* world) {
+        chp->c_req = issue(world);  // written under ch.mu (dispatch path)
+        if (chp->c_req == 0) die("generic collective start failed");
+      },
+      [chp, recv_elems, dt](void* dst) -> int64_t {
+        if (mlsl_request_wait(chp->c_req, dst, recv_elems,
+                              (mlsl_data_type_t)dt) != MLSL_TPU_SUCCESS)
+          die("generic collective wait failed");
+        return recv_elems;
+      });
+  return (CommReq*)&ch;
+}
+
+}  // namespace
+
+size_t Distribution::GetProcessIdx(GroupType groupType) {
+  return (size_t)mlsl_distribution_get_process_idx(
+      D(this)->h, (mlsl_group_type_t)groupType, tl_rank);
+}
+
+size_t Distribution::GetProcessCount(GroupType groupType) {
+  return group_size(D(this), groupType);
+}
+
+CommReq* Distribution::Bcast(void* buffer, size_t count, DataType dataType,
+                             size_t rootIdx, GroupType groupType) {
+  DistImpl* d = D(this);
+  uint64_t h = d->h;
+  return generic_start(
+      d, buffer, count, dataType, (int64_t)count, buffer,
+      [h, count, dataType, rootIdx, groupType](const void* world) {
+        return mlsl_distribution_bcast(h, world, (int64_t)count,
+                                       (mlsl_data_type_t)dataType,
+                                       (int64_t)rootIdx,
+                                       (mlsl_group_type_t)groupType);
+      });
+}
+
+CommReq* Distribution::AllReduce(void* sendBuffer, void* recvBuffer,
+                                 size_t count, DataType dataType,
+                                 ReductionType redType, GroupType groupType) {
+  DistImpl* d = D(this);
+  uint64_t h = d->h;
+  return generic_start(
+      d, sendBuffer, count, dataType, (int64_t)count, recvBuffer,
+      [h, count, dataType, redType, groupType](const void* world) {
+        return mlsl_distribution_all_reduce(h, world, (int64_t)count,
+                                            (mlsl_data_type_t)dataType,
+                                            (mlsl_reduction_t)redType,
+                                            (mlsl_group_type_t)groupType);
+      });
+}
+
+CommReq* Distribution::Reduce(void* sendBuffer, void* recvBuffer, size_t count,
+                              DataType dataType, ReductionType redType,
+                              size_t rootIdx, GroupType groupType) {
+  DistImpl* d = D(this);
+  uint64_t h = d->h;
+  bool is_root = GetProcessIdx(groupType) == rootIdx;
+  return generic_start(
+      d, sendBuffer, count, dataType, (int64_t)count,
+      is_root ? recvBuffer : nullptr,  // MPI: recv meaningful at root only
+      [h, count, dataType, redType, rootIdx, groupType](const void* world) {
+        return mlsl_distribution_reduce(h, world, (int64_t)count,
+                                        (mlsl_data_type_t)dataType,
+                                        (mlsl_reduction_t)redType,
+                                        (int64_t)rootIdx,
+                                        (mlsl_group_type_t)groupType);
+      });
+}
+
+CommReq* Distribution::AllGather(void* sendBuffer, size_t sendCount,
+                                 void* recvBuffer, DataType dataType,
+                                 GroupType groupType) {
+  DistImpl* d = D(this);
+  uint64_t h = d->h;
+  size_t g = group_size(d, groupType);
+  return generic_start(
+      d, sendBuffer, sendCount, dataType, (int64_t)(sendCount * g), recvBuffer,
+      [h, sendCount, dataType, groupType](const void* world) {
+        return mlsl_distribution_all_gather(h, world, (int64_t)sendCount,
+                                            (mlsl_data_type_t)dataType,
+                                            (mlsl_group_type_t)groupType);
+      });
+}
+
+CommReq* Distribution::Gather(void* sendBuffer, size_t sendCount,
+                              void* recvBuffer, DataType dataType,
+                              size_t rootIdx, GroupType groupType) {
+  DistImpl* d = D(this);
+  uint64_t h = d->h;
+  size_t g = group_size(d, groupType);
+  bool is_root = GetProcessIdx(groupType) == rootIdx;
+  return generic_start(
+      d, sendBuffer, sendCount, dataType, (int64_t)(sendCount * g),
+      is_root ? recvBuffer : nullptr,
+      [h, sendCount, dataType, rootIdx, groupType](const void* world) {
+        return mlsl_distribution_gather(h, world, (int64_t)sendCount,
+                                        (mlsl_data_type_t)dataType,
+                                        (int64_t)rootIdx,
+                                        (mlsl_group_type_t)groupType);
+      });
+}
+
+CommReq* Distribution::Scatter(void* sendBuffer, void* recvBuffer,
+                               size_t recvCount, DataType dataType,
+                               size_t rootIdx, GroupType groupType) {
+  DistImpl* d = D(this);
+  uint64_t h = d->h;
+  size_t g = group_size(d, groupType);
+  size_t send_elems = recvCount * g;  // send meaningful at root only
+  return generic_start(
+      d, sendBuffer, send_elems, dataType, (int64_t)recvCount, recvBuffer,
+      [h, send_elems, dataType, rootIdx, groupType](const void* world) {
+        return mlsl_distribution_scatter(h, world, (int64_t)send_elems,
+                                         (mlsl_data_type_t)dataType,
+                                         (int64_t)rootIdx,
+                                         (mlsl_group_type_t)groupType);
+      });
+}
+
+CommReq* Distribution::AlltoAll(void* sendBuffer, size_t sendCount,
+                                void* recvBuffer, DataType dataType,
+                                GroupType groupType) {
+  DistImpl* d = D(this);
+  uint64_t h = d->h;
+  size_t g = group_size(d, groupType);
+  size_t total = sendCount * g;
+  return generic_start(
+      d, sendBuffer, total, dataType, (int64_t)total, recvBuffer,
+      [h, total, dataType, groupType](const void* world) {
+        return mlsl_distribution_all_to_all(h, world, (int64_t)total,
+                                            (mlsl_data_type_t)dataType,
+                                            (mlsl_group_type_t)groupType);
+      });
+}
+
+CommReq* Distribution::ReduceScatter(void* sendBuffer, void* recvBuffer,
+                                     size_t recvCount, DataType dataType,
+                                     ReductionType redType,
+                                     GroupType groupType) {
+  DistImpl* d = D(this);
+  uint64_t h = d->h;
+  size_t g = group_size(d, groupType);
+  size_t send_elems = recvCount * g;
+  return generic_start(
+      d, sendBuffer, send_elems, dataType, (int64_t)recvCount, recvBuffer,
+      [h, send_elems, dataType, redType, groupType](const void* world) {
+        return mlsl_distribution_reduce_scatter(h, world, (int64_t)send_elems,
+                                                (mlsl_data_type_t)dataType,
+                                                (mlsl_reduction_t)redType,
+                                                (mlsl_group_type_t)groupType);
+      });
+}
+
+void Distribution::Barrier(GroupType groupType) {
+  DistImpl* d = D(this);
+  uint64_t h = d->h;
+  shared_call([h, groupType]() -> uint64_t {
+    mlsl_distribution_barrier(h, (mlsl_group_type_t)groupType);
+    return 0;
+  });
+}
+
+/* ---- OperationRegInfo -------------------------------------------------- */
+
+namespace {
+RegImpl* R(OperationRegInfo* r) { return (RegImpl*)r; }
+}  // namespace
+
+void OperationRegInfo::SetName(const char* name) {
+  std::string n = name != nullptr ? name : "";
+  shared_call([this, n]() -> uint64_t {
+    R(this)->name = n;
+    return 0;
+  });
+}
+
+size_t OperationRegInfo::AddInput(size_t featureMapCount, size_t featureMapSize,
+                                  DataType dataType) {
+  return (size_t)shared_call([&]() -> uint64_t {
+    RegImpl* r = R(this);
+    int64_t idx = mlsl_operation_reg_info_add_input(
+        r->h, (int64_t)featureMapCount, (int64_t)featureMapSize,
+        (mlsl_data_type_t)dataType);
+    if (idx < 0) die("AddInput failed");
+    r->n_in++;
+    r->in_dt.push_back(dataType);
+    return (uint64_t)idx;
+  });
+}
+
+size_t OperationRegInfo::AddOutput(size_t featureMapCount,
+                                   size_t featureMapSize, DataType dataType) {
+  return (size_t)shared_call([&]() -> uint64_t {
+    RegImpl* r = R(this);
+    int64_t idx = mlsl_operation_reg_info_add_output(
+        r->h, (int64_t)featureMapCount, (int64_t)featureMapSize,
+        (mlsl_data_type_t)dataType);
+    if (idx < 0) die("AddOutput failed");
+    r->n_out++;
+    r->out_dt.push_back(dataType);
+    return (uint64_t)idx;
+  });
+}
+
+size_t OperationRegInfo::AddParameterSet(size_t kernelCount, size_t kernelSize,
+                                         DataType dataType,
+                                         bool distributedUpdate,
+                                         CompressionType compressType) {
+  return (size_t)shared_call([&]() -> uint64_t {
+    RegImpl* r = R(this);
+    int64_t idx = mlsl_operation_reg_info_add_parameter_set(
+        r->h, (int64_t)kernelCount, (int64_t)kernelSize,
+        (mlsl_data_type_t)dataType, distributedUpdate ? 1 : 0,
+        (mlsl_compression_t)compressType);
+    if (idx < 0) die("AddParameterSet failed");
+    r->n_ps++;
+    r->ps_dt.push_back(dataType);
+    return (uint64_t)idx;
+  });
+}
+
+void OperationRegInfo::Validate(Distribution*) {}
+
+/* ---- Session ----------------------------------------------------------- */
+
+namespace {
+SessImpl* S(Session* s) { return (SessImpl*)s; }
+}  // namespace
+
+void Session::SetGlobalMinibatchSize(size_t globalMinibatchSize) {
+  shared_call([&]() -> uint64_t {
+    SessImpl* s = S(this);
+    if (mlsl_session_set_global_minibatch_size(
+            s->h, (int64_t)globalMinibatchSize) != MLSL_TPU_SUCCESS)
+      die("SetGlobalMinibatchSize failed");
+    s->global_mb = globalMinibatchSize;
+    return 0;
+  });
+}
+
+size_t Session::GetGlobalMinibatchSize() { return S(this)->global_mb; }
+PhaseType Session::GetPhaseType() { return PT_TRAIN; }
+
+OperationRegInfo* Session::CreateOperationRegInfo(OpType opType) {
+  uint64_t r = shared_call([&]() -> uint64_t {
+    RegImpl* reg = new RegImpl();
+    reg->h = mlsl_session_create_operation_reg_info(S(this)->h,
+                                                    (mlsl_op_type_t)opType);
+    if (reg->h == 0) die("CreateOperationRegInfo failed");
+    reg->sess = S(this);
+    reg->op_type = opType;
+    return (uint64_t)(uintptr_t)reg;
+  });
+  return (OperationRegInfo*)(uintptr_t)r;
+}
+
+void Session::DeleteOperationRegInfo(OperationRegInfo* info) {
+  shared_call([&]() -> uint64_t {
+    RegImpl* r = R(info);
+    if (r != nullptr) mlsl_handle_release(r->h);
+    return 0;
+  });
+}
+
+size_t Session::AddOperation(OperationRegInfo* info, Distribution* dist) {
+  return (size_t)shared_call([&]() -> uint64_t {
+    SessImpl* s = S(this);
+    RegImpl* reg = R(info);
+    DistImpl* d = (DistImpl*)dist;
+    uint64_t oph = mlsl_session_add_operation(s->h, reg->h,
+                                              d != nullptr ? d->h : 0);
+    if (oph == 0) die("AddOperation failed");
+    OpImpl* op = new OpImpl();
+    op->h = oph;
+    op->dist = d;
+    op->sess = s;
+    op->name = reg->name;
+    op->op_type = reg->op_type;
+    for (int i = 0; i < reg->n_in; i++) {
+      ActImpl* a = new ActImpl();
+      a->h = mlsl_operation_get_input(oph, i);
+      if (a->h == 0) die("GetInput failed");
+      a->op = op;
+      a->is_input = true;
+      a->dt = reg->in_dt[i];
+      a->comm_bufs.resize(g_world);
+      /* shapes are fixed at operation registration; wire layout at Commit */
+      a->global_fm = (size_t)mlsl_activation_get_global_fm_count(a->h);
+      a->local_fm = (size_t)mlsl_activation_get_local_fm_count(a->h);
+      a->fm_size = (size_t)mlsl_activation_get_fm_size(a->h);
+      op->ins.push_back(a);
+    }
+    for (int i = 0; i < reg->n_out; i++) {
+      ActImpl* a = new ActImpl();
+      a->h = mlsl_operation_get_output(oph, i);
+      if (a->h == 0) die("GetOutput failed");
+      a->op = op;
+      a->is_input = false;
+      a->dt = reg->out_dt[i];
+      a->comm_bufs.resize(g_world);
+      a->global_fm = (size_t)mlsl_activation_get_global_fm_count(a->h);
+      a->local_fm = (size_t)mlsl_activation_get_local_fm_count(a->h);
+      a->fm_size = (size_t)mlsl_activation_get_fm_size(a->h);
+      op->outs.push_back(a);
+    }
+    for (int i = 0; i < reg->n_ps; i++) {
+      PSImpl* p = new PSImpl();
+      p->oph = oph;
+      p->idx = i;
+      p->op = op;
+      p->dt = reg->ps_dt[i];
+      op->pss.push_back(p);
+    }
+    s->ops.push_back(op);
+    return (uint64_t)(s->ops.size() - 1);
+  });
+}
+
+void Session::RemoveOperations() {
+  shared_call([&]() -> uint64_t {
+    S(this)->ops.clear();  // handles released with the session
+    return 0;
+  });
+}
+
+size_t Session::GetOperationCount() { return S(this)->ops.size(); }
+
+Operation* Session::GetOperation(size_t idx) {
+  SessImpl* s = S(this);
+  return idx < s->ops.size() ? (Operation*)s->ops[idx] : nullptr;
+}
+
+void Session::Commit() {
+  shared_call([&]() -> uint64_t {
+    SessImpl* s = S(this);
+    if (mlsl_session_commit(s->h) != MLSL_TPU_SUCCESS) die("Commit failed");
+    /* post-commit: snapshot the per-edge wire layouts for every activation */
+    for (OpImpl* op : s->ops) {
+      std::vector<ActImpl*> acts = op->ins;
+      acts.insert(acts.end(), op->outs.begin(), op->outs.end());
+      for (ActImpl* a : acts) {
+        a->wire = (size_t)mlsl_activation_get_wire_count(a->h);
+        int64_t rn = mlsl_activation_get_recv_count(a->h);
+        a->recvn = rn > 0 ? (size_t)rn : 0;
+        int64_t np = mlsl_activation_get_pack_block_count(a->h);
+        for (int64_t i = 0; i < np; i++) {
+          BlockImpl b;
+          b.mb_off = (size_t)mlsl_activation_get_pack_block(a->h, i, 0);
+          b.mb_cnt = (size_t)mlsl_activation_get_pack_block(a->h, i, 1);
+          b.fm_off = (size_t)mlsl_activation_get_pack_block(a->h, i, 2);
+          b.fm_cnt = (size_t)mlsl_activation_get_pack_block(a->h, i, 3);
+          b.fm_size = (size_t)mlsl_activation_get_pack_block(a->h, i, 4);
+          b.buf_off = (size_t)mlsl_activation_get_pack_block(a->h, i, 5);
+          b.dt = a->dt;
+          a->pack.push_back(b);
+        }
+        int64_t nu = mlsl_activation_get_unpack_block_count(a->h);
+        for (int64_t i = 0; i < nu; i++) {
+          BlockImpl b;
+          b.mb_off = (size_t)mlsl_activation_get_unpack_block(a->h, i, 0);
+          b.mb_cnt = (size_t)mlsl_activation_get_unpack_block(a->h, i, 1);
+          b.fm_off = (size_t)mlsl_activation_get_unpack_block(a->h, i, 2);
+          b.fm_cnt = (size_t)mlsl_activation_get_unpack_block(a->h, i, 3);
+          b.fm_size = (size_t)mlsl_activation_get_unpack_block(a->h, i, 4);
+          b.buf_off = (size_t)mlsl_activation_get_unpack_block(a->h, i, 5);
+          b.dt = a->dt;
+          a->unpack.push_back(b);
+        }
+      }
+    }
+    return 0;
+  });
+}
+
+Statistics* Session::GetStats() {
+  uint64_t r = shared_call([&]() -> uint64_t {
+    SessImpl* s = S(this);
+    if (s->stats == nullptr) {
+      StatsImpl* st = new StatsImpl();
+      st->h = mlsl_session_get_stats(s->h);
+      if (st->h == 0) die("GetStats failed");
+      s->stats = st;
+    }
+    return (uint64_t)(uintptr_t)s->stats;
+  });
+  return (Statistics*)(uintptr_t)r;
+}
+
+/* ---- Operation --------------------------------------------------------- */
+
+namespace {
+OpImpl* O(Operation* o) { return (OpImpl*)o; }
+}  // namespace
+
+void Operation::SetDistribution(Distribution* dist) {
+  O(this)->dist = (DistImpl*)dist;
+}
+Distribution* Operation::GetDistribution() {
+  return (Distribution*)O(this)->dist;
+}
+Session* Operation::GetSession() { return (Session*)O(this)->sess; }
+OpType Operation::GetOpType() { return (OpType)O(this)->op_type; }
+const char* Operation::GetName() { return O(this)->name.c_str(); }
+
+void Operation::SetPrev(Operation* prev, size_t actIdx, size_t prevOpActIdx) {
+  shared_call([&]() -> uint64_t {
+    OpImpl* cur = O(this);
+    OpImpl* p = O(prev);
+    if (mlsl_operation_set_prev(cur->h, p->h, (int64_t)actIdx,
+                                (int64_t)prevOpActIdx) != MLSL_TPU_SUCCESS)
+      die("SetPrev failed");
+    cur->ins[actIdx]->peer = p->outs[prevOpActIdx];
+    p->outs[prevOpActIdx]->peer = cur->ins[actIdx];
+    return 0;
+  });
+}
+
+void Operation::SetNext(Operation* next, size_t actIdx, size_t nextOpActIdx) {
+  shared_call([&]() -> uint64_t {
+    OpImpl* cur = O(this);
+    OpImpl* n = O(next);
+    if (mlsl_operation_set_next(cur->h, n->h, (int64_t)actIdx,
+                                (int64_t)nextOpActIdx) != MLSL_TPU_SUCCESS)
+      die("SetNext failed");
+    cur->outs[actIdx]->peer = n->ins[nextOpActIdx];
+    n->ins[nextOpActIdx]->peer = cur->outs[actIdx];
+    return 0;
+  });
+}
+
+size_t Operation::GetGlobalMinibatchSize() {
+  return (size_t)mlsl_operation_get_global_minibatch_size(O(this)->h);
+}
+size_t Operation::GetLocalMinibatchSize() {
+  return (size_t)mlsl_operation_get_local_minibatch_size(O(this)->h);
+}
+size_t Operation::GetGlobalMinibatchOffset() {
+  OpImpl* op = O(this);
+  size_t data_idx = (size_t)mlsl_distribution_get_process_idx(
+      op->dist->h, MLSL_GT_DATA, tl_rank);
+  return GetLocalMinibatchSize() * data_idx;
+}
+
+size_t Operation::GetInputCount() { return O(this)->ins.size(); }
+Activation* Operation::GetInput(size_t idx) {
+  OpImpl* op = O(this);
+  return idx < op->ins.size() ? (Activation*)op->ins[idx] : nullptr;
+}
+size_t Operation::GetOutputCount() { return O(this)->outs.size(); }
+Activation* Operation::GetOutput(size_t idx) {
+  OpImpl* op = O(this);
+  return idx < op->outs.size() ? (Activation*)op->outs[idx] : nullptr;
+}
+bool Operation::HasParameterSets() { return !O(this)->pss.empty(); }
+size_t Operation::GetParameterSetCount() { return O(this)->pss.size(); }
+ParameterSet* Operation::GetParameterSet(size_t idx) {
+  OpImpl* op = O(this);
+  return idx < op->pss.size() ? (ParameterSet*)op->pss[idx] : nullptr;
+}
+
+/* ---- CommBlockInfo ----------------------------------------------------- */
+
+namespace {
+BlockImpl* B(CommBlockInfo* b) { return (BlockImpl*)b; }
+}  // namespace
+
+size_t CommBlockInfo::GetMbOffset() { return B(this)->mb_off; }
+size_t CommBlockInfo::GetMbCount() { return B(this)->mb_cnt; }
+size_t CommBlockInfo::GetFmOffset() { return B(this)->fm_off; }
+size_t CommBlockInfo::GetFmCount() { return B(this)->fm_cnt; }
+size_t CommBlockInfo::GetFmSize() { return B(this)->fm_size; }
+DataType CommBlockInfo::GetDataType() { return (DataType)B(this)->dt; }
+size_t CommBlockInfo::GetBufOffset() { return B(this)->buf_off; }
+
+/* ---- Activation -------------------------------------------------------- */
+
+namespace {
+ActImpl* A(Activation* a) { return (ActImpl*)a; }
+}  // namespace
+
+size_t Activation::GetGlobalFmCount() { return A(this)->global_fm; }
+size_t Activation::GetLocalFmCount() { return A(this)->local_fm; }
+size_t Activation::GetFmSize() { return A(this)->fm_size; }
+DataType Activation::GetDataType() { return (DataType)A(this)->dt; }
+
+size_t Activation::GetGlobalFmOffset() {
+  ActImpl* a = A(this);
+  int64_t model_idx = mlsl_distribution_get_process_idx(
+      a->op->dist->h, MLSL_GT_MODEL, tl_rank);
+  return (size_t)mlsl_activation_get_global_fm_offset(a->h, model_idx);
+}
+
+size_t Activation::GetPackBlockCount() { return A(this)->pack.size(); }
+size_t Activation::GetUnpackBlockCount() { return A(this)->unpack.size(); }
+CommBlockInfo* Activation::GetPackBlock(size_t idx) {
+  ActImpl* a = A(this);
+  return idx < a->pack.size() ? (CommBlockInfo*)&a->pack[idx] : nullptr;
+}
+CommBlockInfo* Activation::GetUnpackBlock(size_t idx) {
+  ActImpl* a = A(this);
+  return idx < a->unpack.size() ? (CommBlockInfo*)&a->unpack[idx] : nullptr;
+}
+
+size_t Activation::GetCommBufSize() {
+  ActImpl* a = A(this);
+  return a->wire * dt_size(a->dt);
+}
+
+void* Activation::GetCommBuf() {
+  ActImpl* a = A(this);
+  if (a->wire == 0) return nullptr;
+  std::lock_guard<std::mutex> lk(a->bufs_mu);
+  std::vector<char>& b = a->comm_bufs[tl_rank];
+  if (b.empty()) b.resize(a->wire * dt_size(a->dt));
+  return b.data();
+}
+
+void Activation::StartComm(void* buf) {
+  ActImpl* a = A(this);
+  if (a->wire == 0) return;  // no comm on this edge (reference: empty request)
+  uint64_t my_h = a->h;
+  uint64_t peer_h = a->peer != nullptr ? a->peer->h : 0;
+  int dt = a->dt;
+  channel_start(
+      a->ch, buf, a->wire, dt_size(dt), (int64_t)a->recvn, nullptr,
+      [my_h, dt](const void* world) {
+        if (mlsl_activation_start_comm(my_h, world, (mlsl_data_type_t)dt) !=
+            MLSL_TPU_SUCCESS)
+          die("StartComm failed");
+      },
+      [peer_h, dt](void* dst) -> int64_t {
+        /* the PEER owns the wait side (reference src/mlsl_impl.cpp:377-380) */
+        int64_t n = mlsl_activation_wait_comm(peer_h, dst,
+                                              (mlsl_data_type_t)dt);
+        if (n < 0) die("WaitComm failed");
+        return n;
+      });
+}
+
+void* Activation::WaitComm() {
+  ActImpl* a = A(this);
+  ActImpl* started = a->peer;  // waits the peer's transfer
+  if (started == nullptr || started->wire == 0) return nullptr;
+  return channel_wait(started->ch);
+}
+
+/* ---- ParameterSet ------------------------------------------------------ */
+
+namespace {
+PSImpl* P(ParameterSet* p) { return (PSImpl*)p; }
+
+int64_t ps_q(PSImpl* p, int what) {
+  switch (what) {
+    case 0: return mlsl_parameter_set_get_global_kernel_count(p->oph, p->idx);
+    case 1: return mlsl_parameter_set_get_local_kernel_count(p->oph, p->idx);
+    case 2: return mlsl_parameter_set_get_owned_kernel_count(p->oph, p->idx);
+    case 3: return mlsl_parameter_set_get_kernel_size(p->oph, p->idx);
+    default: return mlsl_parameter_set_is_distributed_update(p->oph, p->idx);
+  }
+}
+}  // namespace
+
+size_t ParameterSet::GetGlobalKernelCount() { return (size_t)ps_q(P(this), 0); }
+size_t ParameterSet::GetLocalKernelCount() { return (size_t)ps_q(P(this), 1); }
+size_t ParameterSet::GetOwnedKernelCount() { return (size_t)ps_q(P(this), 2); }
+size_t ParameterSet::GetKernelSize() { return (size_t)ps_q(P(this), 3); }
+bool ParameterSet::IsDistributedUpdate() { return ps_q(P(this), 4) != 0; }
+DataType ParameterSet::GetDataType() { return (DataType)P(this)->dt; }
+
+size_t ParameterSet::GetGlobalKernelOffset() {
+  PSImpl* p = P(this);
+  int64_t model_idx = mlsl_distribution_get_process_idx(
+      p->op->dist->h, MLSL_GT_MODEL, tl_rank);
+  return GetLocalKernelCount() * (size_t)model_idx;
+}
+
+size_t ParameterSet::GetOwnedKernelOffset() {
+  PSImpl* p = P(this);
+  int64_t data_idx = mlsl_distribution_get_process_idx(
+      p->op->dist->h, MLSL_GT_DATA, tl_rank);
+  return (size_t)mlsl_parameter_set_get_owned_kernel_offset(p->oph, p->idx,
+                                                            data_idx);
+}
+
+void ParameterSet::StartGradientComm(void* buf) {
+  PSImpl* p = P(this);
+  size_t local = GetLocalKernelCount() * GetKernelSize();
+  size_t owned = GetOwnedKernelCount() * GetKernelSize();
+  int64_t recvn =
+      (int64_t)(IsDistributedUpdate() ? owned : local);  // rs vs allreduce
+  uint64_t oph = p->oph;
+  int idx = p->idx, dt = p->dt;
+  channel_start(
+      p->grad_ch, buf, local, dt_size(dt), recvn, nullptr,
+      [oph, idx, dt](const void* world) {
+        if (mlsl_parameter_set_start_gradient_comm(
+                oph, idx, world, (mlsl_data_type_t)dt) != MLSL_TPU_SUCCESS)
+          die("StartGradientComm failed");
+      },
+      [oph, idx, dt](void* dst) -> int64_t {
+        int64_t n = mlsl_parameter_set_wait_gradient_comm(
+            oph, idx, dst, (mlsl_data_type_t)dt);
+        if (n < 0) die("WaitGradientComm failed");
+        return n;
+      });
+}
+
+void* ParameterSet::WaitGradientComm() { return channel_wait(P(this)->grad_ch); }
+
+void* ParameterSet::TestGradientComm(bool* isCompleted) {
+  PSImpl* p = P(this);
+  uint64_t oph = p->oph;
+  int idx = p->idx;
+  return channel_test(
+      p->grad_ch,
+      [oph, idx] { return mlsl_parameter_set_test_gradient_comm(oph, idx); },
+      isCompleted);
+}
+
+void ParameterSet::StartIncrementComm(void* buf) {
+  PSImpl* p = P(this);
+  if (!IsDistributedUpdate()) {
+    /* reference: the increment request is empty without distributed update —
+     * Start/Wait are no-ops (src/mlsl_impl.cpp:388-444) */
+    return;
+  }
+  size_t ksize = GetKernelSize();
+  size_t owned = GetOwnedKernelCount() * ksize;
+  size_t local = GetLocalKernelCount() * ksize;
+  size_t esz = dt_size(p->dt);
+  /* the caller passes the FULL local parameter buffer; this rank contributes
+   * its owned shard and the gathered result lands back in the full buffer
+   * (in-place AllGather, reference mlsl_test.cpp:521-526) */
+  const char* shard = (const char*)buf + GetOwnedKernelOffset() * ksize * esz;
+  uint64_t oph = p->oph;
+  int idx = p->idx, dt = p->dt;
+  channel_start(
+      p->inc_ch, shard, owned, esz, (int64_t)local, buf,
+      [oph, idx, dt](const void* world) {
+        if (mlsl_parameter_set_start_increment_comm(
+                oph, idx, world, (mlsl_data_type_t)dt) != MLSL_TPU_SUCCESS)
+          die("StartIncrementComm failed");
+      },
+      [oph, idx, dt](void* dst) -> int64_t {
+        int64_t n = mlsl_parameter_set_wait_increment_comm(
+            oph, idx, dst, (mlsl_data_type_t)dt);
+        if (n < 0) die("WaitIncrementComm failed");
+        return n;
+      });
+}
+
+void* ParameterSet::WaitIncrementComm() {
+  PSImpl* p = P(this);
+  if (!IsDistributedUpdate()) return nullptr;
+  return channel_wait(p->inc_ch);
+}
+
+/* ---- Statistics -------------------------------------------------------- */
+
+namespace {
+StatsImpl* ST(Statistics* s) { return (StatsImpl*)s; }
+}  // namespace
+
+void Statistics::Start() {
+  shared_call([&]() -> uint64_t { return mlsl_statistics_start(ST(this)->h); });
+}
+void Statistics::Stop() {
+  shared_call([&]() -> uint64_t { return mlsl_statistics_stop(ST(this)->h); });
+}
+void Statistics::Reset() {
+  shared_call([&]() -> uint64_t { return mlsl_statistics_reset(ST(this)->h); });
+}
+bool Statistics::IsStarted() {
+  return mlsl_statistics_is_started(ST(this)->h) == 1;
+}
+bool Statistics::IsEnabled() {
+  return mlsl_statistics_is_enabled(ST(this)->h) == 1;
+}
+void Statistics::Print() {
+  shared_call([&]() -> uint64_t { return mlsl_statistics_print(ST(this)->h); });
+}
+unsigned long long Statistics::GetIsolationCommCycles(size_t opIdx) {
+  return (unsigned long long)mlsl_statistics_get_isolation_comm_cycles(
+      ST(this)->h, (int64_t)opIdx);
+}
+size_t Statistics::GetCommSize(size_t opIdx) {
+  return (size_t)mlsl_statistics_get_comm_size(ST(this)->h, (int64_t)opIdx);
+}
+unsigned long long Statistics::GetCommCycles(size_t opIdx) {
+  return (unsigned long long)mlsl_statistics_get_comm_cycles(ST(this)->h,
+                                                             (int64_t)opIdx);
+}
+unsigned long long Statistics::GetComputeCycles(size_t opIdx) {
+  return (unsigned long long)mlsl_statistics_get_compute_cycles(ST(this)->h,
+                                                                (int64_t)opIdx);
+}
+unsigned long long Statistics::GetTotalIsolationCommCycles() {
+  return (unsigned long long)mlsl_statistics_get_total_isolation_comm_cycles(
+      ST(this)->h);
+}
+size_t Statistics::GetTotalCommSize() {
+  return (size_t)mlsl_statistics_get_total_comm_size(ST(this)->h);
+}
+unsigned long long Statistics::GetTotalCommCycles() {
+  return (unsigned long long)mlsl_statistics_get_total_comm_cycles(ST(this)->h);
+}
+unsigned long long Statistics::GetTotalComputeCycles() {
+  return (unsigned long long)mlsl_statistics_get_total_compute_cycles(
+      ST(this)->h);
+}
+
+}  // namespace MLSL

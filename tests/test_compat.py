@@ -1,0 +1,55 @@
+"""Builds and runs the MLSL-compatible C++ surface (include/mlsl.hpp) with the
+ported reference correctness program (native/compat_test.cpp) over the
+reference's own test matrix: group_count x dist_update x user_buf x use_test
+(reference tests/examples/mlsl_test/Makefile:56-105, mpiexec replaced by the
+rank-thread launcher MLSL::RunRanks)."""
+
+import os
+import shutil
+import subprocess
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+NATIVE = os.path.join(REPO, "native")
+
+pytestmark = pytest.mark.skipif(shutil.which("g++") is None,
+                                reason="no C++ toolchain")
+
+
+@pytest.fixture(scope="module")
+def compat_binary():
+    build = subprocess.run(
+        ["make", "-s", "compat_test"], cwd=NATIVE, capture_output=True,
+        text=True, timeout=300,
+    )
+    assert build.returncode == 0, build.stderr
+    return os.path.join(NATIVE, "compat_test")
+
+
+def _run(binary, group_count, dist_update, user_buf, use_test):
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    env["MLSL_TPU_PLATFORM"] = "cpu"
+    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=8"
+    run = subprocess.run(
+        [binary, str(group_count), str(dist_update), str(user_buf),
+         str(use_test)],
+        capture_output=True, text=True, timeout=600, env=env,
+    )
+    assert run.returncode == 0, f"stdout:\n{run.stdout}\nstderr:\n{run.stderr}"
+    assert "compat_test: PASSED" in run.stdout
+    return run.stdout
+
+
+@pytest.mark.parametrize("group_count", [1, 2, 4])
+@pytest.mark.parametrize("dist_update", [0, 1])
+def test_compat_matrix(compat_binary, group_count, dist_update):
+    out = _run(compat_binary, group_count, dist_update, user_buf=1, use_test=0)
+    assert f"dist={8 // group_count}x{group_count}" in out
+
+
+def test_compat_test_driven_completion(compat_binary):
+    """The reference's USE_TEST mode: Update polls TestGradientComm until
+    completion instead of blocking in WaitGradientComm."""
+    _run(compat_binary, group_count=2, dist_update=1, user_buf=0, use_test=1)
