@@ -79,6 +79,13 @@ def device_sync(tree):
 MEASURED_PATH = os.path.join(REPO_ROOT, "BENCH_MEASURED.json")
 
 
+def measured_path() -> str:
+    """BENCH_MEASURED.json location; MLSL_BENCH_MEASURED_PATH overrides (tests
+    redirect to a tmp file so exercising the capture pipeline end-to-end never
+    pollutes the repo-root evidence file)."""
+    return os.environ.get("MLSL_BENCH_MEASURED_PATH", MEASURED_PATH)
+
+
 def git_sha() -> str:
     try:
         return subprocess.run(
@@ -95,21 +102,22 @@ def append_measurement(record: dict) -> None:
     and benchmarks/capture.py so the schema has exactly one writer."""
     import json
 
+    path = measured_path()
     data = {"captures": []}
-    if os.path.exists(MEASURED_PATH):
+    if os.path.exists(path):
         try:
-            with open(MEASURED_PATH) as f:
+            with open(path) as f:
                 data = json.load(f)
         except Exception:
             pass
     caps = data.setdefault("captures", [])
     caps[:] = [c for c in caps if c.get("run_id") != record.get("run_id")]
     caps.append(record)
-    tmp = MEASURED_PATH + ".tmp"
+    tmp = path + ".tmp"
     with open(tmp, "w") as f:
         json.dump(data, f, indent=1)
         f.write("\n")
-    os.replace(tmp, MEASURED_PATH)
+    os.replace(tmp, path)
 
 
 _RTT_CACHE = {}

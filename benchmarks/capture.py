@@ -24,7 +24,6 @@ import sys
 import time
 
 REPO = os.path.join(os.path.dirname(os.path.abspath(__file__)), "..")
-OUT = os.path.join(REPO, "BENCH_MEASURED.json")
 
 def probe(timeout: float = 90.0):
     """Returns device_kind string if the tunnel answers, else None."""
@@ -76,23 +75,28 @@ def run_step(name, cmd, timeout, env=None):
 
 
 sys.path.insert(0, REPO)
-from benchmarks._common import append_measurement, git_sha  # noqa: E402
+from benchmarks._common import (  # noqa: E402
+    PROBE_SRC, append_measurement, git_sha, measured_path,
+)
 
 
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--once", action="store_true",
                     help="single probe; exit 3 if the tunnel is dead")
-    ap.add_argument("--suite", choices=["full", "quick"], default="full")
+    ap.add_argument("--suite", choices=["full", "quick", "smoke"], default="full",
+                    help="smoke = trimmed bench.py --quick (CI: proves the "
+                         "probe->run->persist pipeline on the CPU backend)")
     ap.add_argument("--poll-sleep", type=float, default=180.0)
     ap.add_argument("--max-wait-hours", type=float, default=11.0)
+    ap.add_argument("--probe-timeout", type=float, default=90.0)
     args = ap.parse_args()
 
     deadline = time.time() + args.max_wait_hours * 3600
     attempt = 0
     while True:
         attempt += 1
-        kind = probe()
+        kind = probe(timeout=args.probe_timeout)
         if kind:
             break
         print(f"capture: probe {attempt} dead tunnel "
@@ -110,7 +114,11 @@ def main():
     # capture.py writes the record itself; stop bench.py double-recording
     env["MLSL_BENCH_NO_PERSIST"] = "1"
 
-    steps = [("bench", [sys.executable, "bench.py"], 3000)]
+    if args.suite == "smoke":
+        steps = [("bench", [sys.executable, "bench.py", "--quick",
+                            "--iters", "2", "--warmup", "1"], 900)]
+    else:
+        steps = [("bench", [sys.executable, "bench.py"], 3000)]
     if args.suite == "full":
         steps += [
             ("kernels_on_chip",
@@ -136,7 +144,7 @@ def main():
         append_measurement(dict(record, partial=(name != steps[-1][0])))
 
     ok = all(s["rc"] == 0 for s in record["steps"])
-    print(f"capture: done ok={ok}; appended to {OUT}", flush=True)
+    print(f"capture: done ok={ok}; appended to {measured_path()}", flush=True)
     sys.exit(0 if ok else 1)
 
 
