@@ -192,6 +192,27 @@ def main():
     # adjacent blocks, which drift cannot skew).
     fw_best = min(fw_blocks)
 
+    # Overlap quantification (the point of the async Start/Wait engine —
+    # reference eplib newest-first allreduce, eplib/allreduce_pr.c:76-79):
+    # isolation-replay each grad collective, then account a few UN-TIMED steps
+    # and report the fraction of pure-comm time hidden behind compute. None on
+    # a single device (groups degenerate, no comm to overlap).
+    overlap = None
+    try:
+        st = sess_pl.get_stats()
+        if not st._isolation_slot_ns:  # MLSL_STATS=1 already replayed at commit
+            st.collect_isolation_stats()
+        st.reset()  # drop compile/warmup/timed-loop history: account ONLY these steps
+        st.start()
+        for _ in range(3):
+            trainer_pl.step(fw_batch)
+        _sync(trainer_pl.params)
+        st.stop()
+        overlap = st.get_overlap_fraction()
+        st.print_()
+    except Exception as e:
+        print(f"bench: overlap report skipped ({e})", file=sys.stderr)
+
     # Achieved TFLOP/s and MFU for the framework step. FLOPs come from XLA's own
     # cost model on the compiled baseline step (identical math to the framework
     # step); peak from the device kind.
@@ -228,6 +249,7 @@ def main():
         "best_ms": round(fw_best, 3),
         "per_layer_ms": round(pl_ms, 3),
         "per_layer_vs_fused": round(fw_ms / pl_ms, 4),
+        "overlap_fraction": round(overlap, 4) if overlap is not None else None,
         "tflops": round(tflops, 3) if tflops else None,
         "mfu": round(mfu, 4) if mfu else None,
         "transformer_tok_s": round(tfm_tok_s) if tfm_tok_s else None,

@@ -34,13 +34,14 @@ STATS_OUTPUT_FILE = "mlsl_stats.log"
 
 
 class _Slot:
-    __slots__ = ("bytes", "comm_ns", "comp_ns", "events")
+    __slots__ = ("bytes", "comm_ns", "comp_ns", "events", "starts")
 
     def __init__(self):
         self.bytes = 0
         self.comm_ns = 0
         self.comp_ns = 0
         self.events = 0
+        self.starts = 0
 
 
 def _entity_key(entity, is_param: bool, is_increment: bool) -> Tuple:
@@ -59,6 +60,8 @@ class Statistics:
         self._slots: Dict[Tuple[int, Tuple], _Slot] = {}
         self._isolation_ns: Dict[int, int] = {}   # op_idx -> per-iteration comm ns
         self._isolation_bytes: Dict[int, int] = {}
+        # (op_idx, entity_key) -> per-iteration comm ns, for the overlap report
+        self._isolation_slot_ns: Dict[Tuple[int, Tuple], int] = {}
 
     # -- lifecycle ---------------------------------------------------------
 
@@ -121,6 +124,7 @@ class Statistics:
         else:
             slot.comp_ns += delta
         if action == "start":
+            slot.starts += 1
             req = _entity_request(entity, is_param, is_increment)
             if req is not None:
                 slot.bytes += req.desc.payload_bytes()
@@ -133,12 +137,61 @@ class Statistics:
         for op in self.session.operations:
             total_ns = 0
             total_bytes = 0
-            for req in _op_requests(op):
+            for key, req in _op_request_slots(op):
                 ns, nbytes = isolation_time_request(req)
                 total_ns += ns
                 total_bytes += nbytes
+                self._isolation_slot_ns[(op.op_idx, key)] = ns
             self._isolation_ns[op.op_idx] = total_ns
             self._isolation_bytes[op.op_idx] = total_bytes
+
+    # -- overlap quantification --------------------------------------------
+
+    def overlap_report(self) -> dict:
+        """Hidden vs exposed communication time — how much comm actually hides
+        behind compute, the entire point of the async Start/Wait engine
+        (reference: eplib's newest-first allreduce exists to maximize this,
+        eplib/allreduce_pr.c:76-79; the comp/comm attribution intent is
+        src/mlsl_impl_stats.cpp:564-668).
+
+        Per (op, entity) slot that was replayed in isolation AND started online:
+          true comm time  = isolation ns/iter x observed Start count
+          exposed time    = online comm ns (host blocked inside Start/Wait/Test)
+          hidden time     = max(0, true - exposed)
+          overlap_fraction = hidden / true
+        Requires collect_isolation_stats() (run at Commit when stats are enabled,
+        or callable explicitly) plus at least one accounted step."""
+        ops: Dict[str, dict] = {}
+        tot_iso = tot_exposed = 0
+        for (op_idx, key), iso_per_iter in self._isolation_slot_ns.items():
+            slot = self._slots.get((op_idx, key))
+            if slot is None or slot.starts == 0 or iso_per_iter <= 0:
+                continue
+            iso = iso_per_iter * slot.starts
+            exposed = slot.comm_ns
+            name = self.session.operations[op_idx].name
+            ent = ops.setdefault(name, {"iso_ns": 0, "exposed_ns": 0})
+            ent["iso_ns"] += iso
+            ent["exposed_ns"] += exposed
+            tot_iso += iso
+            tot_exposed += exposed
+        for ent in ops.values():
+            ent["hidden_ns"] = max(0, ent["iso_ns"] - ent["exposed_ns"])
+            ent["overlap_fraction"] = ent["hidden_ns"] / ent["iso_ns"]
+        total = {
+            "iso_ns": tot_iso,
+            "exposed_ns": tot_exposed,
+            "hidden_ns": max(0, tot_iso - tot_exposed),
+            "overlap_fraction": (
+                max(0, tot_iso - tot_exposed) / tot_iso if tot_iso > 0 else None
+            ),
+        }
+        return {"ops": ops, "total": total}
+
+    def get_overlap_fraction(self) -> Optional[float]:
+        """Session-total fraction of pure-comm time hidden behind compute
+        (None until isolation stats and at least one accounted step exist)."""
+        return self.overlap_report()["total"]["overlap_fraction"]
 
     # -- queries (reference include/mlsl.hpp:680-725) ----------------------
 
@@ -189,6 +242,21 @@ class Statistics:
                 f"{self._isolation_bytes.get(op_idx, 0) / 1024.0:>12.1f} "
                 f"{ns / 1e3 / mb:>14.2f} {'-':>14} {'-':>8}"
             )
+        rep = self.overlap_report()
+        if rep["total"]["overlap_fraction"] is not None:
+            lines.append(
+                f"{'OVERLAP':<16} {'TOTAL':<8} hidden "
+                f"{rep['total']['hidden_ns'] / 1e3:>10.1f} Kns / iso "
+                f"{rep['total']['iso_ns'] / 1e3:>10.1f} Kns = "
+                f"{rep['total']['overlap_fraction']:.3f}"
+            )
+            for name, ent in sorted(rep["ops"].items()):
+                lines.append(
+                    f"{name:<16} {'OVERLAP':<8} hidden "
+                    f"{ent['hidden_ns'] / 1e3:>10.1f} Kns / iso "
+                    f"{ent['iso_ns'] / 1e3:>10.1f} Kns = "
+                    f"{ent['overlap_fraction']:.3f}"
+                )
         text = "\n".join(lines) + "\n"
         try:
             with open(path, "a") as f:
@@ -223,6 +291,8 @@ class Statistics:
     GetTotalCommSize = get_total_comm_size
     GetTotalCommCycles = get_total_comm_cycles
     GetTotalComputeCycles = get_total_compute_cycles
+    OverlapReport = overlap_report
+    GetOverlapFraction = get_overlap_fraction
 
 
 # -- helpers -----------------------------------------------------------------
@@ -234,17 +304,20 @@ def _entity_request(entity, is_param: bool, is_increment: bool):
     return entity.comm_req
 
 
-def _op_requests(op) -> List:
-    reqs = []
+def _op_request_slots(op) -> List[Tuple[Tuple, object]]:
+    """(entity_key, request) pairs for every registered comm of one operation,
+    keyed the same way as the online-accounting slots so the isolation replay
+    and the live Start/Wait attribution line up per entity."""
+    out = []
     for act in op.inputs + op.outputs:
         if act.comm_req is not None:
-            reqs.append(act.comm_req)
+            out.append((("IA" if act.is_input else "OA", act.act_index), act.comm_req))
     for ps in op.parameter_sets:
         if ps.grad_req is not None:
-            reqs.append(ps.grad_req)
+            out.append((("GRAD", ps.param_index), ps.grad_req))
         if ps.inc_req is not None:
-            reqs.append(ps.inc_req)
-    return reqs
+            out.append((("INC", ps.param_index), ps.inc_req))
+    return out
 
 
 def isolation_time_request(req) -> Tuple[int, int]:
