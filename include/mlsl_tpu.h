@@ -49,6 +49,12 @@ mlsl_handle_t mlsl_environment_create_distribution(int64_t data_parts,
                                                    int64_t model_parts,
                                                    int64_t seq_parts);
 mlsl_handle_t mlsl_environment_create_session(void);
+/* Register codec params (reference SetQuantizationParams). lib_path (may be
+ * NULL) selects a dlopen'd codec honoring the reference's symbol contract;
+ * load failures return MLSL_TPU_FAILURE (see mlsl_last_error()). */
+int mlsl_environment_set_quantization_params(
+    const char* lib_path, const char* quant_name, const char* dequant_name,
+    const char* reduce_name, int64_t block_size, int64_t elem_in_block);
 
 /* ---- distribution collectives ---- */
 int64_t mlsl_distribution_get_process_count(mlsl_handle_t dist,

@@ -69,6 +69,27 @@ def env_create_session() -> int:
     return _put(Environment.get_env().create_session())
 
 
+def env_set_quantization_params(
+    lib_path, quant_name, dequant_name, reduce_name,
+    block_size: int, elem_in_block: int,
+) -> int:
+    """Register codec parameters (reference src/mlsl.cpp:798). A lib_path is
+    honored via the dlopen/ctypes trampoline (comm/codec.py); load failures
+    raise and surface as MLSL_TPU_FAILURE with the message in
+    mlsl_last_error()."""
+    from mlsl_tpu.types import QuantParams
+
+    Environment.get_env().set_quantization_params(QuantParams(
+        block_size=int(block_size) if block_size else 256,
+        elem_in_block=int(elem_in_block) if elem_in_block else 256,
+        lib_path=lib_path or None,
+        quant_buffer_func_name=quant_name or None,
+        dequant_buffer_func_name=dequant_name or None,
+        reduce_sum_func_name=reduce_name or None,
+    ))
+    return 0
+
+
 # ---- buffers: address <-> numpy ----
 
 def _read_world_buffer(dist, addr: int, count: int, data_type: int):

@@ -65,6 +65,9 @@ class Config:
     # --- compression ---
     quant_block_elems: int = 256
     topk_ratio: float = 0.01       # MLSL_TOPK_RATIO: fraction of elements kept
+    # user-pluggable codec (comm/codec.py CustomCodec), registered through
+    # Environment.set_quantization_params; None = built-in Pallas int8 kernels
+    custom_codec: object = None
 
     # --- accepted-for-parity no-ops (MPI/shm specific) ---
     server_affinity: str = ""       # MLSL_SERVER_AFFINITY

@@ -87,6 +87,9 @@ class Environment:
         self.devices = tuple(devices) if devices is not None else tuple(jax.devices())
         self._initialized = True
         self._init_pid = os.getpid()
+        if self.quant_params is not None:
+            # a pre-init SetQuantizationParams is applied now that config exists
+            self.set_quantization_params(self.quant_params)
         self._dump_config()
         return self
 
@@ -244,9 +247,39 @@ class Environment:
     # -- quantization (reference src/mlsl.cpp:798) ------------------------
 
     def set_quantization_params(self, params: QuantParams) -> None:
+        """Select the codec for CT_QUANTIZATION collectives (reference
+        src/mlsl.cpp:798 -> quant_load, quant/quant.c:96-133). Callable fields
+        register a jittable user codec; lib_path dlopens the reference's library
+        contract (failing loudly if it cannot be honored); otherwise the
+        built-in Pallas int8 kernels are used with the given block geometry.
+
+        Before init() the request is recorded and applied at init time (the
+        reference likewise defers: quant params submitted pre-Init reach the
+        servers on EPLIB_init). State mutates only after a codec loads, so a
+        failed lib_path leaves the previous registration fully active."""
+        from mlsl_tpu.comm import codec as codec_mod
+        from mlsl_tpu.log import mlsl_assert
+
+        codec = None
+        if getattr(params, "compress_fn", None) is not None:
+            mlsl_assert(
+                getattr(params, "decompress_fn", None) is not None,
+                "compress_fn requires decompress_fn",
+            )
+            codec = codec_mod.CustomCodec(
+                compress=params.compress_fn,
+                decompress=params.decompress_fn,
+                reduce=getattr(params, "reduce_sum_fn", None),
+            )
+        elif params.lib_path:
+            # raises MLSLError on open/resolve failure — never silently ignored
+            codec = codec_mod.load_library_codec(params)
+
         self.quant_params = params
-        if self.config is not None and params.elem_in_block:
-            self.config.quant_block_elems = int(params.elem_in_block)
+        if self.config is not None:
+            if params.elem_in_block:
+                self.config.quant_block_elems = int(params.elem_in_block)
+            self.config.custom_codec = codec
 
     def get_quantization_params(self) -> Optional[QuantParams]:
         return self.quant_params

@@ -123,14 +123,24 @@ class QuantParams:
     """Quantization configuration.
 
     The reference (include/mlsl.hpp:162-171) names a dlopen'd library providing
-    compress/decompress/reduce_sum; here the built-in Pallas kernels implement the same
-    int8-block + error-feedback semantics (reference quant/quant.c:153-211), so only the
-    block geometry is configurable.
+    compress/decompress/reduce_sum. Three forms are honored here:
+
+    - default: the built-in Pallas int8-block + error-feedback kernels (reference
+      quant/quant.c:153-211 semantics) with the block geometry below;
+    - ``compress_fn``/``decompress_fn`` (+ optional ``reduce_sum_fn``): jittable
+      user callables traced into the compiled ring collective — the TPU-native
+      form of a pluggable codec (see comm/codec.py for the contract);
+    - ``lib_path`` + the three symbol names: the reference's exact dlopen
+      contract, loaded via ctypes and bridged with host callbacks.
     """
 
     block_size: int = 256        # bytes per quantized block (scale + int8 payload)
     elem_in_block: int = 256     # elements quantized per block (one shared scale)
-    lib_path: str | None = None  # accepted for API parity; ignored (kernels are built in)
+    lib_path: str | None = None  # dlopen'd codec library (reference quant/quant.c:96-133)
     quant_buffer_func_name: str | None = None
     dequant_buffer_func_name: str | None = None
     reduce_sum_func_name: str | None = None
+    # jittable-callable codec (TPU-native plug-in form; see comm/codec.py)
+    compress_fn: object = None       # compress(f32[n]) -> payload pytree
+    decompress_fn: object = None     # decompress(payload, n) -> f32[n]
+    reduce_sum_fn: object = None     # optional (payload, payload) -> payload

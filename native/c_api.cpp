@@ -165,6 +165,32 @@ mlsl_handle_t mlsl_environment_create_session(void) {
   return (mlsl_handle_t)call_i("env_create_session", {}, 0);
 }
 
+int mlsl_environment_set_quantization_params(
+    const char* lib_path, const char* quant_name, const char* dequant_name,
+    const char* reduce_name, int64_t block_size, int64_t elem_in_block) {
+  std::call_once(g_init_flag, interpreter_init);
+  if (g_shim == nullptr) return MLSL_TPU_FAILURE;
+  PyGILState_STATE gil = PyGILState_Ensure();
+  int rc = MLSL_TPU_FAILURE;
+  /* "z" maps NULL -> None, so absent names reach the core as defaults */
+  PyObject* res = PyObject_CallMethod(
+      g_shim, "env_set_quantization_params", "zzzzLL", lib_path, quant_name,
+      dequant_name, reduce_name, (long long)block_size,
+      (long long)elem_in_block);
+  if (res != nullptr) {
+    rc = (int)PyLong_AsLongLong(res);
+    if (PyErr_Occurred()) {
+      record_error_locked_gil();
+      rc = MLSL_TPU_FAILURE;
+    }
+    Py_DECREF(res);
+  } else {
+    record_error_locked_gil();
+  }
+  PyGILState_Release(gil);
+  return rc;
+}
+
 int64_t mlsl_distribution_get_process_count(mlsl_handle_t dist,
                                             mlsl_group_type_t group) {
   return call_i("dist_process_count", {(int64_t)dist, (int64_t)group});

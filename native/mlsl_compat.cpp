@@ -425,15 +425,21 @@ void* Environment::Alloc(size_t size, size_t alignment) {
 void Environment::Free(void* ptr) { free(ptr); }
 
 void Environment::SetQuantizationParams(QuantParams* params) {
-  /* The reference dlopens a user library (quant/quant.c:96-133); the TPU
-   * core's codecs are jnp/Pallas callables registered through the Python API
-   * (set_quantization_params). Here we record the request; CT_QUANTIZATION
-   * parameter sets then use the core's built-in int8 block codec with
-   * elem_in_block honored. */
-  if (params != nullptr) {
-    g_env.quant = *params;
-    g_env.quant_set = true;
-  }
+  /* Forward the full request — including lib_path — to the core (reference
+   * src/mlsl.cpp:798 -> quant_load, quant/quant.c:96-133). The core dlopens
+   * the named library via its ctypes trampoline; a codec that cannot be
+   * honored fails LOUDLY here, exactly like the reference's ASSERT-on-load. */
+  if (params == nullptr) return;
+  g_env.quant = *params;
+  g_env.quant_set = true;
+  uint64_t rc = shared_call([&]() -> uint64_t {
+    return (uint64_t)(int64_t)mlsl_environment_set_quantization_params(
+        params->lib_path, params->quant_buffer_func_name,
+        params->dequant_buffer_func_name, params->reduce_sum_func_name,
+        (int64_t)params->block_size, (int64_t)params->elem_in_block);
+  });
+  if ((int64_t)rc != MLSL_TPU_SUCCESS)
+    die("SetQuantizationParams failed (lib_path codec could not be loaded)");
 }
 QuantParams* Environment::GetQuantizationParams() {
   return g_env.quant_set ? &g_env.quant : nullptr;

@@ -1,0 +1,61 @@
+/* Sample user compression codec implementing the reference's dlopen contract
+ * (signatures: /root/reference/quant/quant.c:57-65; loaded by quant_load
+ * :96-133). Used by tests/test_codec.py to exercise the lib_path plug-in path
+ * end-to-end, and as a template for user codecs.
+ *
+ * Codec: float16 truncation. Block geometry: elem_in_block elements per block,
+ * block_size = 2 * elem_in_block bytes (the f16 payload). Error feedback: the
+ * caller-supplied diff buffer is added before truncation and receives the new
+ * residual (dl_comp semantics).
+ *
+ * Build:  gcc -shared -fPIC -O2 -o libsample_codec.so sample_codec.c
+ */
+
+#include <stddef.h>
+#include <stdint.h>
+#include <string.h>
+
+typedef _Float16 f16;
+
+/* int quant(src, dst, count, diff, src_data_type, comp_ratio, method) */
+int sample_compress(void* src_buffer, void* dst_buffer, size_t count,
+                    void* diff, int src_data_type, size_t comp_ratio,
+                    int method) {
+  (void)src_data_type; (void)comp_ratio; (void)method;
+  const float* src = (const float*)src_buffer;
+  float* d = (float*)diff;
+  f16* dst = (f16*)dst_buffer;
+  for (size_t i = 0; i < count; i++) {
+    float v = src[i] + (d ? d[i] : 0.0f);
+    f16 t = (f16)v;
+    dst[i] = t;
+    if (d) d[i] = v - (float)t;
+  }
+  return 0;
+}
+
+/* int dequant(src, dst, count) */
+int sample_decompress(void* src_buffer, void* dst_buffer, size_t count) {
+  const f16* src = (const f16*)src_buffer;
+  float* dst = (float*)dst_buffer;
+  for (size_t i = 0; i < count; i++) dst[i] = (float)src[i];
+  return 0;
+}
+
+/* int reduce_sum(in, inout, block_count): accumulate compressed blocks.
+ * Element count = block_count * elem_in_block; since both buffers are flat f16
+ * payloads the block geometry only fixes the byte span per block, so we derive
+ * the element count from the caller's framework contract: blockCount blocks of
+ * ELEM elements. ELEM is baked at compile time to keep the ABI exact. */
+#ifndef SAMPLE_ELEM_IN_BLOCK
+#define SAMPLE_ELEM_IN_BLOCK 128
+#endif
+
+int sample_reduce_sum(const void* in_buffer, void* inout_buffer,
+                      size_t block_count) {
+  const f16* in = (const f16*)in_buffer;
+  f16* io = (f16*)inout_buffer;
+  size_t n = block_count * SAMPLE_ELEM_IN_BLOCK;
+  for (size_t i = 0; i < n; i++) io[i] = (f16)((float)in[i] + (float)io[i]);
+  return 0;
+}

@@ -299,6 +299,16 @@ int main(void) {
     free(wires); free(arecv); free(bsend); free(brecv); free(incs); free(irecv);
   }
 
+  /* quantization params: defaults accepted; a bogus lib_path must FAIL
+   * loudly, not be silently swallowed (reference quant_load ASSERTs). */
+  CHECK(mlsl_environment_set_quantization_params(NULL, NULL, NULL, NULL,
+                                                 256, 256) == 0,
+        "quant params defaults");
+  CHECK(mlsl_environment_set_quantization_params(
+            "/nonexistent/libcodec.so", "c", "d", "r", 256, 256) != 0,
+        "bogus codec lib must fail");
+  printf("quantization params OK\n");
+
   CHECK(mlsl_distribution_barrier(dist, MLSL_GT_GLOBAL) == 0, "barrier");
   CHECK(mlsl_environment_finalize() == 0, "finalize");
   printf("C API TEST PASSED\n");

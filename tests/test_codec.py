@@ -1,0 +1,262 @@
+"""User-pluggable compression codec tests (reference quant/quant.c:96-133).
+
+Covers both plug-in forms registered via Environment.set_quantization_params:
+jittable Python callables (the TPU-native form) and a dlopen'd shared library
+implementing the reference's exact symbol contract, bridged with host callbacks.
+"""
+
+import os
+import subprocess
+
+import numpy as np
+import pytest
+import jax.numpy as jnp
+
+from mlsl_tpu.log import MLSLError
+from mlsl_tpu.types import (
+    CompressionType, DataType, GroupType, QuantParams, ReductionType,
+)
+
+REPO = os.path.join(os.path.dirname(os.path.abspath(__file__)), "..")
+
+
+def _allreduce_req(env, dist, gt, n):
+    from mlsl_tpu.comm.request import CommDesc, CommRequest
+
+    req = CommRequest(
+        CommDesc(
+            "allreduce", dist._group(gt), n, DataType.FLOAT,
+            op=ReductionType.SUM, compression=CompressionType.QUANTIZATION,
+        ),
+        env.dispatcher,
+    )
+    req.setup()
+    return req
+
+
+def _run(env, dist, req, vals, n):
+    buf = dist.make_buffer(lambda p: vals[p], n)
+    req.start(buf)
+    return req.wait()
+
+
+def test_python_codec_identity_is_exact(env):
+    """A lossless user codec must reproduce the exact sum (round-trip through
+    the compressed ring wire)."""
+    n = 1024
+    params = QuantParams(
+        compress_fn=lambda x: x,
+        decompress_fn=lambda p, n: p,
+    )
+    env.set_quantization_params(params)
+    assert env.config.custom_codec is not None
+    dist = env.create_distribution(8, 1)
+    rng = np.random.default_rng(0)
+    vals = {p: rng.normal(size=n).astype(np.float32) for p in range(8)}
+    req = _allreduce_req(env, dist, GroupType.DATA, n)
+    out = _run(env, dist, req, vals, n)
+    want = np.sum([vals[p] for p in range(8)], axis=0)
+    got = np.asarray(dist.local_part(out, 0))
+    np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-5)
+
+
+def test_python_codec_lossy_with_reduce_and_feedback(env):
+    """A lossy f16 codec with a compressed-domain reduce_sum: result close to
+    exact, error-feedback residual carried on the request."""
+    n = 2048
+
+    params = QuantParams(
+        compress_fn=lambda x: x.astype(jnp.float16),
+        decompress_fn=lambda p, n: p.astype(jnp.float32),
+        reduce_sum_fn=lambda a, b: a + b,  # f16-domain accumulation
+    )
+    env.set_quantization_params(params)
+    dist = env.create_distribution(8, 1)
+    rng = np.random.default_rng(1)
+    vals = {p: (rng.normal(size=n) * 5.0).astype(np.float32) for p in range(8)}
+    want = np.sum([vals[p] for p in range(8)], axis=0)
+    req = _allreduce_req(env, dist, GroupType.DATA, n)
+    for _ in range(2):  # second run exercises the carried residual
+        out = _run(env, dist, req, vals, n)
+    got = np.asarray(dist.local_part(out, 0))
+    err = np.abs(got - want) / (np.abs(want) + 1e-3)
+    assert np.median(err) < 0.01, np.median(err)
+    assert req._err is not None
+    assert float(jnp.abs(req._err).sum()) > 0.0  # lossy -> nonzero residual
+
+
+def test_python_codec_reduce_scatter(env):
+    n = 4096
+    env.set_quantization_params(QuantParams(
+        compress_fn=lambda x: x.astype(jnp.float16),
+        decompress_fn=lambda p, n: p.astype(jnp.float32),
+    ))
+    dist = env.create_distribution(8, 1)
+    from mlsl_tpu.comm.request import CommDesc, CommRequest
+
+    req = CommRequest(
+        CommDesc(
+            "reduce_scatter", dist._group(GroupType.DATA), n, DataType.FLOAT,
+            op=ReductionType.SUM, recv_count=n // 8,
+            compression=CompressionType.QUANTIZATION,
+        ),
+        env.dispatcher,
+    )
+    req.setup()
+    rng = np.random.default_rng(2)
+    vals = {p: rng.normal(size=n).astype(np.float32) for p in range(8)}
+    out = _run(env, dist, req, vals, n)
+    want = np.sum([vals[p] for p in range(8)], axis=0)
+    for p in range(8):
+        got = np.asarray(dist.local_part(out, p))
+        np.testing.assert_allclose(
+            got, want[p * (n // 8):(p + 1) * (n // 8)], rtol=0.02, atol=0.05
+        )
+
+
+def test_codec_through_parameter_set_grad_path(env):
+    """The codec must ride the CT_QUANTIZATION ParameterSet gradient path (the
+    reference's MPI_QUANT_OP allreduce, src/comm_ep.cpp:946-950)."""
+    from mlsl_tpu.types import OpType
+
+    env.set_quantization_params(QuantParams(
+        compress_fn=lambda x: x.astype(jnp.float16),
+        decompress_fn=lambda p, n: p.astype(jnp.float32),
+    ))
+    dist = env.create_distribution(8, 1)
+    s = env.create_session()
+    s.set_global_minibatch_size(8)
+    r = s.create_operation_reg_info(OpType.CC)
+    r.add_input(8, 4)
+    r.add_output(8, 4)
+    r.add_parameter_set(512, 1, compression_type=CompressionType.QUANTIZATION)
+    op = s.get_operation(s.add_operation(r, dist))
+    s.commit()
+    ps = op.get_parameter_set(0)
+    n = 512
+    buf = dist.make_buffer(lambda p: np.full(n, p + 1.0, np.float32), n)
+    ps.start_gradient_comm(buf)
+    out = ps.wait_gradient_comm()
+    got = np.asarray(dist.local_part(out, 0))
+    np.testing.assert_allclose(got, np.full(n, 36.0), rtol=0.01)
+
+
+def test_pre_init_registration_applied_at_init():
+    """SetQuantizationParams before Init must not be dropped: the codec is
+    applied when init() builds the config (reference: pre-Init quant params
+    reach the servers on EPLIB_init)."""
+    from mlsl_tpu.core.environment import Environment
+
+    e = Environment.get_env()
+    assert not e._initialized
+    e.set_quantization_params(QuantParams(
+        compress_fn=lambda x: x, decompress_fn=lambda p, n: p,
+    ))
+    e.init()
+    try:
+        assert e.config.custom_codec is not None
+    finally:
+        e.finalize()
+
+
+def test_failed_load_preserves_previous_codec(env):
+    env.set_quantization_params(QuantParams(
+        compress_fn=lambda x: x, decompress_fn=lambda p, n: p,
+    ))
+    good = env.config.custom_codec
+    good_params = env.get_quantization_params()
+    with pytest.raises(MLSLError):
+        env.set_quantization_params(QuantParams(
+            lib_path="/nonexistent/libcodec.so", elem_in_block=17,
+            quant_buffer_func_name="c", dequant_buffer_func_name="d",
+            reduce_sum_func_name="r",
+        ))
+    # nothing mutated: previous registration fully active
+    assert env.config.custom_codec is good
+    assert env.get_quantization_params() is good_params
+    assert env.config.quant_block_elems != 17
+
+
+def test_chunked_large_allreduce_with_custom_codec(env):
+    """A custom-codec allreduce above the large-message threshold must split
+    into independent per-chunk programs (the reference's >128 MiB split)."""
+    env.config.large_msg_size_mb = 1  # 1 MiB threshold for the test
+    env.config.large_msg_chunks = 4
+    env.set_quantization_params(QuantParams(
+        compress_fn=lambda x: x.astype(jnp.float16),
+        decompress_fn=lambda p, n: p.astype(jnp.float32),
+    ))
+    n = 1 << 19  # 2 MiB of f32 > threshold
+    dist = env.create_distribution(8, 1)
+    req = _allreduce_req(env, dist, GroupType.DATA, n)
+    assert req._quant_fns is not None and len(req._quant_fns) == 4
+    rng = np.random.default_rng(4)
+    vals = {p: rng.normal(size=n).astype(np.float32) for p in range(8)}
+    out = _run(env, dist, req, vals, n)
+    want = np.sum([vals[p] for p in range(8)], axis=0)
+    got = np.asarray(dist.local_part(out, 0))
+    err = np.abs(got - want) / (np.abs(want) + 1e-3)
+    assert np.median(err) < 0.01, np.median(err)
+
+
+def test_unset_restores_builtin(env):
+    env.set_quantization_params(QuantParams(
+        compress_fn=lambda x: x, decompress_fn=lambda p, n: p,
+    ))
+    assert env.config.custom_codec is not None
+    env.set_quantization_params(QuantParams())  # back to built-in kernels
+    assert env.config.custom_codec is None
+
+
+def test_lib_path_bogus_fails_loudly(env):
+    with pytest.raises(MLSLError, match="can't be opened"):
+        env.set_quantization_params(QuantParams(
+            lib_path="/nonexistent/libcodec.so",
+            quant_buffer_func_name="c", dequant_buffer_func_name="d",
+            reduce_sum_func_name="r",
+        ))
+
+
+def test_lib_path_missing_symbol_fails_loudly(env, tmp_path):
+    so = _build_sample_codec(tmp_path)
+    with pytest.raises(MLSLError, match="can't be loaded"):
+        env.set_quantization_params(QuantParams(
+            lib_path=so, quant_buffer_func_name="no_such_symbol",
+            dequant_buffer_func_name="sample_decompress",
+            reduce_sum_func_name="sample_reduce_sum",
+        ))
+
+
+def _build_sample_codec(tmp_path) -> str:
+    src = os.path.join(REPO, "native", "sample_codec.c")
+    so = str(tmp_path / "libsample_codec.so")
+    subprocess.run(
+        ["gcc", "-shared", "-fPIC", "-O2", "-o", so, src], check=True,
+        capture_output=True,
+    )
+    return so
+
+
+def test_library_codec_end_to_end(env, tmp_path):
+    """The reference's full dlopen contract: library + three symbols, f16
+    truncation codec, compressed-domain reduce, error feedback — allreduce
+    close to exact through the ring."""
+    so = _build_sample_codec(tmp_path)
+    env.set_quantization_params(QuantParams(
+        lib_path=so,
+        quant_buffer_func_name="sample_compress",
+        dequant_buffer_func_name="sample_decompress",
+        reduce_sum_func_name="sample_reduce_sum",
+        elem_in_block=128, block_size=256,  # 128 elems -> 256 B of f16
+    ))
+    assert env.config.custom_codec is not None
+    n = 1024
+    dist = env.create_distribution(8, 1)
+    rng = np.random.default_rng(3)
+    vals = {p: (rng.normal(size=n) * 3.0).astype(np.float32) for p in range(8)}
+    req = _allreduce_req(env, dist, GroupType.DATA, n)
+    out = _run(env, dist, req, vals, n)
+    want = np.sum([vals[p] for p in range(8)], axis=0)
+    got = np.asarray(dist.local_part(out, 0))
+    err = np.abs(got - want) / (np.abs(want) + 1e-3)
+    assert np.median(err) < 0.01, np.median(err)
