@@ -39,19 +39,24 @@ from mlsl_tpu.log import MLSLError, mlsl_assert
 from mlsl_tpu.comm.mesh import ProcessGroup
 
 
-@dataclasses.dataclass(frozen=True)
+@dataclasses.dataclass(frozen=True, eq=False)
 class CustomCodec:
     """A pluggable codec: ``compress(f32[n]) -> payload`` (any pytree of arrays
     with shapes determined by n), ``decompress(payload, n) -> f32[n]``, and an
     optional compressed-domain ``reduce(a_payload, b_payload) -> payload`` (the
     reference's reduce_sum custom MPI op). Without ``reduce``, ring hops
     decompress-add — numerically identical to what dl_comp-style reduce_sum does
-    internally."""
+    internally.
+
+    ``_programs`` caches the compiled collectives ON the codec instance, so
+    replacing a registration (config.custom_codec reassigned) drops the old
+    codec's traced executables with it — no process-lifetime growth."""
 
     compress: Callable
     decompress: Callable
     reduce: Optional[Callable] = None
     name: str = "custom"
+    _programs: dict = dataclasses.field(default_factory=dict, repr=False)
 
 
 # -- library (dlopen) codecs -------------------------------------------------
@@ -168,11 +173,9 @@ def load_library_codec(params) -> CustomCodec:
 # -- the codec collective ----------------------------------------------------
 
 
-def _to_chunks(x, G, rc, chunk):
-    """(n,) -> (G, chunk): logical slice j at the start of padded chunk j (ring
-    chunk ownership == MPI slice placement, as in quant_ring)."""
-    xp = jnp.pad(x, (0, G * rc - x.shape[0]))
-    return jnp.pad(xp.reshape(G, rc), ((0, 0), (0, chunk - rc)))
+# chunk placement shared with the built-in int8 ring — ONE copy of the
+# ring-ownership math (slice j at the start of padded chunk j)
+from mlsl_tpu.comm.quant_ring import _to_chunks  # noqa: E402
 
 
 def _entry(codec, chunks, err2d, chunk):
@@ -231,15 +234,6 @@ def _ring_body(x, err, *, axis, G, rc, chunk, count, mode, codec):
     return out[:, :rc].reshape(-1)[:count], new_err
 
 
-# Compiled programs are cached PER CODEC via a weak key: when a registration is
-# replaced (config.custom_codec reassigned) and the old codec is dropped, its
-# traced ring programs are collected with it — a module-global dict keyed by
-# codec identity would pin every codec's executables for the process lifetime.
-import weakref
-
-_cache: "weakref.WeakKeyDictionary" = weakref.WeakKeyDictionary()
-
-
 def build_custom_collective(
     kind: str, group: ProcessGroup, count: int, codec: CustomCodec
 ) -> Tuple[Callable, int]:
@@ -270,7 +264,7 @@ def build_custom_collective(
     chunk = rc
     err_len = g * chunk
 
-    per_codec = _cache.setdefault(codec, {})
+    per_codec = codec._programs
     key = (kind, _group_key(group), count)
     fn = per_codec.get(key)
     if fn is not None:

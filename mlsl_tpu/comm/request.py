@@ -129,51 +129,33 @@ class CommRequest:
             "allreduce",
             "reduce_scatter",
         ):
-            codec = getattr(self.dispatcher.config, "custom_codec", None)
-            if codec is not None:
-                # user-pluggable codec (reference dlopen contract,
-                # quant/quant.c:96-133) — single program, framework-owned
-                # error feedback, compressed ring wire
-                from mlsl_tpu.comm import codec as codec_mod
-
-                mlsl_assert(
-                    d.op in (None, ReductionType.SUM),
-                    "custom codec collectives support SUM only (got %s)",
-                    d.op,
-                )
-                _check_recv_count(d)
-                chunks = self._plan_chunks(compressed_ok=True)
-                if chunks is not None and d.kind == "allreduce":
-                    # large allreduce: independent per-chunk programs (each
-                    # with its own error feedback), same incremental
-                    # completion as the built-in quant path
-                    self._quant_fns = []
-                    self._err_lens = []
-                    for sl in chunks:
-                        fn, el = codec_mod.build_custom_collective(
-                            d.kind, d.group, sl.stop - sl.start, codec
-                        )
-                        self._quant_fns.append(fn)
-                        self._err_lens.append(el)
-                    self._chunk_slices = chunks
-                else:
-                    self._quant_fn, self._err_len = (
-                        codec_mod.build_custom_collective(
-                            d.kind, d.group, d.count, codec
-                        )
-                    )
-                    self._chunk_slices = [slice(None)]
-                self.is_setup = True
-                return
-            from mlsl_tpu.comm import quant_ring
-
             mlsl_assert(
                 d.op in (None, ReductionType.SUM),
                 "quantized collectives support SUM only (got %s)",
                 d.op,
             )
             _check_recv_count(d)
-            block = self.dispatcher.config.quant_block_elems
+            codec = getattr(self.dispatcher.config, "custom_codec", None)
+            if codec is not None:
+                # user-pluggable codec (reference dlopen contract,
+                # quant/quant.c:96-133): compressed ring wire, framework-owned
+                # error feedback
+                from mlsl_tpu.comm import codec as codec_mod
+
+                def build(n):
+                    return codec_mod.build_custom_collective(
+                        d.kind, d.group, n, codec
+                    )
+            else:
+                from mlsl_tpu.comm import quant_ring
+
+                block = self.dispatcher.config.quant_block_elems
+
+                def build(n):
+                    return quant_ring.build_quantized_collective(
+                        d.kind, d.group, n, block
+                    )
+
             chunks = self._plan_chunks(compressed_ok=True)
             if chunks is not None and d.kind == "allreduce":
                 # large quantized allreduce: independent per-chunk ring programs,
@@ -181,16 +163,12 @@ class CommRequest:
                 self._quant_fns = []
                 self._err_lens = []
                 for sl in chunks:
-                    fn, el = quant_ring.build_quantized_collective(
-                        d.kind, d.group, sl.stop - sl.start, block
-                    )
+                    fn, el = build(sl.stop - sl.start)
                     self._quant_fns.append(fn)
                     self._err_lens.append(el)
                 self._chunk_slices = chunks
             else:
-                self._quant_fn, self._err_len = quant_ring.build_quantized_collective(
-                    d.kind, d.group, d.count, block
-                )
+                self._quant_fn, self._err_len = build(d.count)
                 self._chunk_slices = [slice(None)]
             self.is_setup = True
             return

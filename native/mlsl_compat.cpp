@@ -428,17 +428,22 @@ void Environment::SetQuantizationParams(QuantParams* params) {
   /* Forward the full request — including lib_path — to the core (reference
    * src/mlsl.cpp:798 -> quant_load, quant/quant.c:96-133). The core dlopens
    * the named library via its ctypes trampoline; a codec that cannot be
-   * honored fails LOUDLY here, exactly like the reference's ASSERT-on-load. */
+   * honored fails LOUDLY here, exactly like the reference's ASSERT-on-load.
+   *
+   * Deliberately NOT a shared_call rendezvous: in the reference this call is
+   * process-local (each rank dlopens independently), so ported programs may
+   * call it from any subset of ranks at rank-dependent points. The core's
+   * registration is global and idempotent; a mutex serializes racing ranks. */
   if (params == nullptr) return;
+  static std::mutex quant_mu;
+  std::lock_guard<std::mutex> lk(quant_mu);
   g_env.quant = *params;
   g_env.quant_set = true;
-  uint64_t rc = shared_call([&]() -> uint64_t {
-    return (uint64_t)(int64_t)mlsl_environment_set_quantization_params(
-        params->lib_path, params->quant_buffer_func_name,
-        params->dequant_buffer_func_name, params->reduce_sum_func_name,
-        (int64_t)params->block_size, (int64_t)params->elem_in_block);
-  });
-  if ((int64_t)rc != MLSL_TPU_SUCCESS)
+  int rc = mlsl_environment_set_quantization_params(
+      params->lib_path, params->quant_buffer_func_name,
+      params->dequant_buffer_func_name, params->reduce_sum_func_name,
+      (int64_t)params->block_size, (int64_t)params->elem_in_block);
+  if (rc != MLSL_TPU_SUCCESS)
     die("SetQuantizationParams failed (lib_path codec could not be loaded)");
 }
 QuantParams* Environment::GetQuantizationParams() {
