@@ -258,12 +258,21 @@ class HybridTrainer:
                  distributed_update: bool = False,
                  compression=None,
                  devices=None,
-                 optimizer=None):
+                 optimizer=None,
+                 donate_params: bool = True):
         """optimizer: optional optax.GradientTransformation; state lives per
         layer over each rank's flat local (TP-sharded) parameter vector, or the
         owned gradient shard under distributed_update (ZeRO-1). Elementwise/
         shard-local transforms only (adam, momentum, ...); params-consuming
-        transforms see the flat local param vector on the plain path."""
+        transforms see the flat local param vector on the plain path.
+
+        donate_params: the fused no-comm step donates the parameter (and
+        optimizer-state) buffers to XLA so the update is in-place in HBM —
+        after step() returns, any EXTERNAL reference to the previous
+        ``trainer.params`` tree points at deleted buffers (reading it raises).
+        Pass donate_params=False to keep old param trees readable (e.g. EMA
+        snapshots, debugging diffs) at the cost of double-buffering the
+        weights."""
         self.env = env
         self.cfg = cfg
         self.dp, self.sp, self.tp = dp, sp, tp
@@ -279,6 +288,7 @@ class HybridTrainer:
             "optimizer.as_optax() to HybridTrainer (plain path only)",
         )
         self.optimizer = optimizer
+        self.donate_params = bool(donate_params)
         self.dist = env.create_distribution(
             dp, tp, seq_parts=sp, devices=devices
         )
@@ -565,7 +575,9 @@ class HybridTrainer:
                 out_specs=(_BUF_SPEC, specs),
                 check=False,
             )
-            return jax.jit(sm, donate_argnums=(0,))
+            return jax.jit(
+                sm, donate_argnums=(0,) if self.donate_params else ()
+            )
 
         def body(params, states, tokens, labels):
             (_, loss), grads = jax.value_and_grad(scaled_loss, has_aux=True)(
@@ -597,7 +609,9 @@ class HybridTrainer:
             out_specs=(_BUF_SPEC, specs, state_specs),
             check=False,
         )
-        return jax.jit(sm, donate_argnums=(0, 1))
+        return jax.jit(
+            sm, donate_argnums=(0, 1) if self.donate_params else ()
+        )
 
     def _build_opt_update_fn(self):
         """optax path: each layer's optimization variable is the rank's flat

@@ -203,14 +203,31 @@ def build_adafactor_layout(
 
 def _shard_ids(topo, layout, data_size: int):
     """Distributed int32/float32 buffers holding each rank's owned slice of the
-    per-element index vectors (grad-group rank r owns contiguous chunk r)."""
+    per-element index vectors (grad-group rank r owns contiguous chunk r).
+
+    Layout contract: the ownership chunks are laid out along the DATA axis only
+    — replica/seq/model must be degenerate. Under seq>1 the owned chunk would
+    have to follow the grad group (data x seq); under model>1 the per-leaf
+    id vectors themselves differ per model shard. Both need a per-(axis-coord)
+    layout this function does not build, so reject loudly instead of sharding
+    ids onto the wrong ranks."""
+    from mlsl_tpu.log import mlsl_assert
+
     grid = topo.grid_shape
+    r, d, s, m = grid
+    mlsl_assert(
+        r == 1 and s == 1 and m == 1 and d == data_size,
+        "ShardedAdafactor's factored-stats layout supports a pure data-parallel "
+        "grid (replica=seq=model=1); got grid (%d,%d,%d,%d) with data_size=%d. "
+        "Use optimizer.as_optax() for hybrid grids.",
+        r, d, s, m, data_size,
+    )
     k = layout["row_ids"].shape[0] // data_size
 
     def buf(vec):
         per_rank = vec.reshape(data_size, k)
-        # grid is (replica, data, seq, model) with replica=seq=model=1 for the
-        # data-parallel trainer; the data axis indexes the owned chunk.
+        # grid is (replica, data, seq, model); the data axis indexes the owned
+        # chunk (guard above pins the other axes to 1).
         global_arr = per_rank.reshape(1, data_size, 1, 1, k)
         return topo.shard_buffer(np.ascontiguousarray(global_arr))
 

@@ -366,6 +366,7 @@ struct EnvState {
   bool quant_set = false;
 };
 EnvState g_env;
+std::mutex g_quant_mu;  // serializes quant-params set/get across rank threads
 
 Environment g_env_obj;  // the singleton facade (stateless; state lives above)
 
@@ -435,8 +436,7 @@ void Environment::SetQuantizationParams(QuantParams* params) {
    * call it from any subset of ranks at rank-dependent points. The core's
    * registration is global and idempotent; a mutex serializes racing ranks. */
   if (params == nullptr) return;
-  static std::mutex quant_mu;
-  std::lock_guard<std::mutex> lk(quant_mu);
+  std::lock_guard<std::mutex> lk(g_quant_mu);
   g_env.quant = *params;
   g_env.quant_set = true;
   int rc = mlsl_environment_set_quantization_params(
@@ -447,6 +447,8 @@ void Environment::SetQuantizationParams(QuantParams* params) {
     die("SetQuantizationParams failed (lib_path codec could not be loaded)");
 }
 QuantParams* Environment::GetQuantizationParams() {
+  /* same mutex as the setter: racing ranks must not see a torn copy */
+  std::lock_guard<std::mutex> lk(g_quant_mu);
   return g_env.quant_set ? &g_env.quant : nullptr;
 }
 

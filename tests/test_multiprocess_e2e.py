@@ -150,7 +150,8 @@ assert checked_fwd == 8 and checked_bwd == 8 and checked_upd == 8, (
 print(f"proc {pid} phase loop OK", flush=True)
 
 # ---- trimmed training matrix: {model_parts} x {dist_update} ----
-for mp in (1, 2):
+# mp=8 makes data_parts==1, keeping the no-comm (wait returns None) branch live
+for mp in (1, 2, 8):
     for du in (False, True):
         dmx = env.create_distribution(8 // mp, mp)
         sm, o1, o2 = build_net(dmx, distributed_update=du)

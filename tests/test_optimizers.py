@@ -558,3 +558,28 @@ def test_hybrid_rejects_sharded_adafactor(env):
                                    head_dim=8, n_blocks=1, seq_len=8),
             dp=2, sp=1, tp=2, optimizer=_af_cfg(),
         )
+
+
+def test_sharded_adafactor_rejects_hybrid_grid(env):
+    """The factored-stats ownership layout shards id vectors along the data
+    axis only (ADVICE r2). DataParallelTrainer already rejects hybrid grids at
+    construction; the optim-layer guard must also fire for direct callers."""
+    import numpy as np
+    import pytest as _pytest
+
+    from mlsl_tpu import optim
+    from mlsl_tpu.log import MLSLError
+
+    dist = env.create_distribution(4, 2)  # model axis > 1
+    with _pytest.raises(MLSLError, match="pure data-parallel"):
+        optim._shard_ids(
+            dist.topology, {"row_ids": np.zeros(8, np.int32)}, data_size=4
+        )
+    # and the trainer front door stays closed too
+    sess = env.create_session()
+    sess.set_global_minibatch_size(BATCH)
+    with _pytest.raises(MLSLError, match="model=seq=1"):
+        DataParallelTrainer(
+            env, dist, sess, mlp_init(jax.random.PRNGKey(0)), loss_fn, LAYERS,
+            get_layer, distributed_update=True, optimizer=_af_cfg(),
+        )

@@ -190,3 +190,28 @@ def test_bf16_config_runs_on_cpu_mesh(env):
     st, sl = tr.shard_tokens(toks, np.roll(toks, -1, axis=1))
     loss = tr.step(st, sl)
     assert np.isfinite(float(np.asarray(loss))), loss
+
+
+def test_donate_params_escape(env):
+    """donate_params=False keeps previous param trees readable after a fused
+    step (EMA/debug snapshots); default donation still trains to the oracle.
+    (ADVICE r2: the donation contract must be optional and documented.)"""
+    toks, labels = _data(2)
+
+    tr = tfm.HybridTrainer(env, CFG, 1, 1, 1, batch=2, lr=0.5,
+                           devices=env.devices[:1], donate_params=False)
+    assert tr._fused_fn is not None  # the no-comm fused path is what donates
+    old_leaf = jax.tree.leaves(tr.params)[0]
+    st, sl_ = tr.shard_tokens(toks, labels)
+    tr.step(st, sl_)
+    np.asarray(old_leaf)  # must still be readable: not donated
+
+    tr2 = tfm.HybridTrainer(env, CFG, 1, 1, 1, batch=2, lr=0.5,
+                            devices=env.devices[:1])  # default: donate
+    assert tr2.donate_params
+    st2, sl2 = tr2.shard_tokens(toks, labels)
+    for _ in range(2):
+        tr2.step(st2, sl2)
+    ref_params = tfm.init_params(jax.random.PRNGKey(0), CFG)
+    ref_params, _ = _oracle_steps(ref_params, toks, labels, 0.5, 2)
+    _assert_params_close(tr2, ref_params)
