@@ -375,11 +375,16 @@ def _make_subgroup_body(kind: str, groups: Tuple[Tuple[int, ...], ...], *,
 
 # ---------------------------------------------------------------------------
 # Ragged color groups: world-gather + padded member tables. XLA replica groups
-# must be rectangular, so unequal MPI_Comm_split partitions fall back to the
-# gather+mask emulation. Outputs whose length depends on the group size
-# (allgather/gather) are padded to the max group size with zeros; kinds whose
-# per-rank buffer sizes would themselves be ragged (scatter/reduce_scatter/
-# alltoall(v)) are rejected — SPMD buffers are rank-uniform.
+# must be rectangular, so unequal MPI_Comm_split partitions
+# (reference src/comm_ep.cpp:1821-1827) fall back to the gather+mask emulation
+# with a PADDED buffer contract: every rank's buffer is laid out for Gmax (the
+# largest color group's size) members. Outputs whose length depends on the
+# group size (allgather/gather) pad absent members with zeros; scatter/
+# reduce_scatter segments beyond a group's g*recv_count are ignored; alltoall
+# blocks from absent positions arrive as zeros. Only alltoallv is rejected:
+# its count matrix already expresses per-pair raggedness, so ragged
+# partitions are spelled with v-counts on an equal-size group instead
+# (docs/DESIGN.md "Ragged color groups").
 # ---------------------------------------------------------------------------
 
 
@@ -401,14 +406,24 @@ def _ragged_tables(group: ProcessGroup):
 
 
 def _make_ragged_body(kind: str, group: ProcessGroup, *, op=None, root=None,
-                      pairs=None, **_):
+                      pairs=None, recv_count=None, send_count=None, **_):
+    if kind == "alltoallv":
+        mlsl_assert(
+            False,
+            "alltoallv is not supported on unequal-sized color groups: its "
+            "count matrix already expresses per-pair raggedness — spell the "
+            "exchange with zero counts on an equal-size group instead "
+            "(rationale in docs/DESIGN.md, 'Ragged color groups')",
+        )
     mlsl_assert(
-        kind in ("allreduce", "reduce", "bcast", "allgather", "gather", "sendrecv"),
-        "%s is not supported on unequal-sized color groups (per-rank buffer sizes "
-        "would be ragged, but SPMD buffers are rank-uniform)", kind,
+        kind in ("allreduce", "reduce", "bcast", "allgather", "gather",
+                 "sendrecv", "scatter", "reduce_scatter", "alltoall"),
+        "%s is not supported on unequal-sized color groups (per-rank result "
+        "sizes would be ragged, but SPMD buffers are rank-uniform)", kind,
     )
     member_np, valid_np, pos_np, gsz_np = _ragged_tables(group)
     sizes = _axis_sizes(group.topology.mesh)
+    gmax = int(group.size)
     if root is not None:
         mlsl_assert(
             root < int(gsz_np.min()),
@@ -420,6 +435,11 @@ def _make_ragged_body(kind: str, group: ProcessGroup, *, op=None, root=None,
             max(max(int(s), int(d)) for s, d in pairs) < int(gsz_np.min()),
             "sendrecv pair member index out of range for the smallest group",
         )
+    if kind in ("scatter", "reduce_scatter"):
+        mlsl_assert(recv_count is not None,
+                    "%s on color groups needs recv_count", kind)
+    if kind == "alltoall":
+        mlsl_assert(send_count is not None, "alltoall needs send_count")
 
     def body(x):
         full = _gather_group(x, ALL_AXES)                       # (W, n)
@@ -428,7 +448,8 @@ def _make_ragged_body(kind: str, group: ProcessGroup, *, op=None, root=None,
         valid = jnp.take(jnp.asarray(valid_np), me, axis=0)     # (Gmax,)
         vals = jnp.take(full, members, axis=0)                  # (Gmax, n)
         vmask = valid[:, None]
-        if kind in ("allreduce", "reduce"):
+
+        def masked_reduce():
             if op == ReductionType.MIN:
                 neutral = jnp.full_like(vals, _dtype_max(vals.dtype))
             elif op == ReductionType.MAX:
@@ -436,6 +457,9 @@ def _make_ragged_body(kind: str, group: ProcessGroup, *, op=None, root=None,
             else:
                 neutral = jnp.zeros_like(vals)
             return _reduce_local(jnp.where(vmask, vals, neutral), op)
+
+        if kind in ("allreduce", "reduce"):
+            return masked_reduce()
         if kind == "bcast":
             return vals[root]
         if kind in ("allgather", "gather"):
@@ -447,6 +471,30 @@ def _make_ragged_body(kind: str, group: ProcessGroup, *, op=None, root=None,
             for s, d in pairs:
                 out = jnp.where(mypos == d, vals[int(s)], out)
             return out
+        # Padded buffer contract for the remaining kinds (the allgather
+        # precedent, with Gmax = the LARGEST color group): every rank's buffer
+        # is laid out for Gmax members; a group of size g < Gmax uses member
+        # positions < g, and segments belonging to absent positions are
+        # ignored (scatter/reduce_scatter) or zero (alltoall receive side).
+        mypos = jnp.take(jnp.asarray(pos_np), me)
+        if kind == "scatter":
+            # root's buffer = Gmax blocks of recv_count; member at position i
+            # receives block i
+            return lax.dynamic_slice_in_dim(
+                vals[root], mypos * recv_count, recv_count, axis=0
+            )
+        if kind == "reduce_scatter":
+            # group sum (buffer = Gmax*recv_count), member i gets chunk i;
+            # chunks beyond g*recv_count are not delivered to anyone
+            return lax.dynamic_slice_in_dim(
+                masked_reduce(), mypos * recv_count, recv_count, axis=0
+            )
+        if kind == "alltoall":
+            # sender j's buffer = Gmax blocks; I receive each member's block
+            # at my position; blocks from absent positions arrive as zeros
+            blocks = vals.reshape(gmax, gmax, send_count)
+            mine = lax.dynamic_index_in_dim(blocks, mypos, axis=1, keepdims=False)
+            return jnp.where(vmask, mine, jnp.zeros_like(mine)).reshape(-1)
         raise NotImplementedError(kind)  # pragma: no cover - guarded above
 
     return body
@@ -529,7 +577,9 @@ def build_collective(kind: str, group: ProcessGroup, dtype, **kw) -> Callable:
             _cache[key] = fn
             return fn
         body = _make_ragged_body(
-            kind, group, op=kw.get("op"), root=kw.get("root"), pairs=kw.get("pairs")
+            kind, group, op=kw.get("op"), root=kw.get("root"),
+            pairs=kw.get("pairs"), recv_count=kw.get("recv_count"),
+            send_count=kw.get("send_count"),
         )
     elif kind in ("alltoall", "sendrecv") and len(group.axes) > 1:
         # multi-axis groups have no single named axis for the native op; compile

@@ -211,8 +211,6 @@ def _shard_ids(topo, layout, data_size: int):
     id vectors themselves differ per model shard. Both need a per-(axis-coord)
     layout this function does not build, so reject loudly instead of sharding
     ids onto the wrong ranks."""
-    from mlsl_tpu.log import mlsl_assert
-
     grid = topo.grid_shape
     r, d, s, m = grid
     mlsl_assert(

@@ -447,9 +447,15 @@ void Environment::SetQuantizationParams(QuantParams* params) {
     die("SetQuantizationParams failed (lib_path codec could not be loaded)");
 }
 QuantParams* Environment::GetQuantizationParams() {
-  /* same mutex as the setter: racing ranks must not see a torn copy */
+  /* Copy under the setter's mutex into a thread-local, so the caller's reads
+   * through the returned pointer cannot race a concurrent registration (the
+   * reference's signature forces returning a pointer; a pointer into g_env
+   * would be torn-readable after unlock). */
+  static thread_local QuantParams copy;
   std::lock_guard<std::mutex> lk(g_quant_mu);
-  return g_env.quant_set ? &g_env.quant : nullptr;
+  if (!g_env.quant_set) return nullptr;
+  copy = g_env.quant;
+  return &copy;
 }
 
 Distribution* Environment::CreateDistribution(size_t dataPartitions,

@@ -383,11 +383,55 @@ def test_ragged_color_groups(env):
         blocks += [np.zeros(N, dtype=np.float32)] * (5 - len(blocks))
         np.testing.assert_allclose(dist.local_part(gout, p), np.concatenate(blocks))
 
-    # ragged-incompatible kinds are rejected loudly
+    # scatter: root's buffer = Gmax blocks of rc; member at position i gets
+    # block i (segments past a group's member count are ignored)
+    rc = 4
+    sout = env.wait(
+        dist.scatter(fill(dist, rc * 5), rc, DataType.FLOAT, 1, GroupType.DATA)
+    )
+    for p in range(8):
+        rootv = host(members[p][1], rc * 5)
+        my = members[p].index(p)
+        np.testing.assert_allclose(
+            dist.local_part(sout, p), rootv[my * rc:(my + 1) * rc]
+        )
+
+    # reduce_scatter: group sum of the Gmax*rc buffer, member i gets chunk i
+    rsout = env.wait(
+        dist.reduce_scatter(
+            fill(dist, rc * 5), rc, DataType.FLOAT, ReductionType.SUM,
+            GroupType.DATA,
+        )
+    )
+    for p in range(8):
+        summed = sum(host(q, rc * 5) for q in members[p])
+        my = members[p].index(p)
+        np.testing.assert_allclose(
+            dist.local_part(rsout, p), summed[my * rc:(my + 1) * rc], rtol=1e-6
+        )
+
+    # alltoall: Gmax blocks per sender; receivers see absent positions as zeros
+    b = 3
+    aout = env.wait(
+        dist.all_to_all(fill(dist, b * 5), b, DataType.FLOAT, GroupType.DATA)
+    )
+    for p in range(8):
+        my = members[p].index(p)
+        blocks = [host(q, b * 5)[my * b:(my + 1) * b] for q in members[p]]
+        blocks += [np.zeros(b, np.float32)] * (5 - len(blocks))
+        np.testing.assert_allclose(
+            dist.local_part(aout, p), np.concatenate(blocks)
+        )
+
+    # alltoallv stays rejected: its count matrix already expresses raggedness
+    # (docs/DESIGN.md "Ragged color groups")
     from mlsl_tpu.log import MLSLError
 
     with pytest.raises(MLSLError):
-        env.wait(dist.all_to_all(fill(dist, 40), 5, DataType.FLOAT, GroupType.DATA))
+        env.wait(dist.all_to_allv(
+            fill(dist, 40), [8] * 5, None, None, None, DataType.FLOAT,
+            GroupType.DATA,
+        ))
 
     # the operation graph's minibatch partitioning assumes uniform group sizes:
     # a ragged distribution must be rejected at add_operation, not silently
