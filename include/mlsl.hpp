@@ -168,6 +168,19 @@ namespace MLSL
                         DataType dataType, size_t rootIdx, GroupType groupType);
         CommReq* AllGather(void* sendBuffer, size_t sendCount, void* recvBuffer,
                            DataType dataType, GroupType groupType);
+        /* recvCounts: size_t[group_size], identical on every rank (reference
+         * include/mlsl.hpp:470) */
+        CommReq* AllGatherv(void* sendBuffer, size_t sendCount,
+                            void* recvBuffer, size_t* recvCounts,
+                            DataType dataType, GroupType groupType);
+        /* rank-uniform count/offset arrays of size_t[group_size] (reference
+         * include/mlsl.hpp:432); NULL offsets = packed layout; the receive
+         * buffer is sized per the MPI contract (this rank's total receive
+         * extent) — member j receives sendCounts[j] elements from each peer */
+        CommReq* AlltoAllv(void* sendBuffer, size_t* sendCounts,
+                           size_t* sendOffsets, void* recvBuffer,
+                           size_t* recvCounts, size_t* recvOffsets,
+                           DataType dataType, GroupType groupType);
         CommReq* Scatter(void* sendBuffer, void* recvBuffer, size_t recvCount,
                          DataType dataType, size_t rootIdx, GroupType groupType);
         CommReq* ReduceScatter(void* sendBuffer, void* recvBuffer,

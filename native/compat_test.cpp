@@ -23,11 +23,13 @@
  * Usage: compat_test GROUP_COUNT [DIST_UPDATE] [USER_BUF] [USE_TEST]
  */
 
+#include <chrono>
 #include <cmath>
 #include <cstdio>
 #include <cstdlib>
 #include <cstring>
 #include <string>
+#include <thread>
 #include <vector>
 
 #include "../include/mlsl.hpp"
@@ -309,6 +311,27 @@ class TestLayer {
   bool bwd_unpacked_ = false;
 };
 
+/* Deliberate rendezvous mismatch: rank 0 issues a collective the other ranks
+ * never join. The watchdog (MLSL_COMPAT_WATCHDOG_S) must abort with a
+ * per-rank diagnostic instead of hanging — the compat analog of MPI dying
+ * loudly on divergent collective order. */
+int rank_main_mismatch(int argc, char** argv) {
+  Environment& env = Environment::GetEnv();
+  env.Init(&argc, &argv);
+  size_t world = env.GetProcessCount();
+  size_t rank = env.GetProcessIdx();
+  Distribution* dist = env.CreateDistribution(world, 1);
+  if (rank == 0) {
+    std::vector<float> buf(16, 1.0f);
+    CommReq* req = dist->AllReduce(buf.data(), buf.data(), 16, DT_FLOAT,
+                                   RT_SUM, GT_GLOBAL);
+    env.Wait(req);  // unreachable: the watchdog aborts in the rendezvous
+  } else {
+    std::this_thread::sleep_for(std::chrono::seconds(60));
+  }
+  return 0;
+}
+
 int rank_main(int argc, char** argv) {
   Environment& env = Environment::GetEnv();
   CHECK(MLSL_MAJOR(Environment::GetVersion()) == MLSL_MAJOR_VERSION,
@@ -371,6 +394,34 @@ int rank_main(int argc, char** argv) {
   stats->Stop();
   if (stats->IsEnabled()) stats->Print();
 
+  /* v-collectives through the drop-in surface (reference mlsl.hpp:432,470):
+   * AllGatherv with per-position counts; oracle = concatenation over the
+   * global group of each member's (rank*100 + k) fill. */
+  {
+    std::vector<size_t> counts(world);
+    size_t total = 0;
+    for (size_t i = 0; i < world; i++) {
+      counts[i] = 2 + (i % 3);
+      total += counts[i];
+    }
+    size_t mine = counts[rank];
+    std::vector<float> send(mine), recv(total, -1.0f);
+    for (size_t k = 0; k < mine; k++) send[k] = (float)(rank * 100 + k);
+    CommReq* vreq = dist->AllGatherv(send.data(), mine, recv.data(),
+                                     counts.data(), DT_FLOAT, GT_GLOBAL);
+    env.Wait(vreq);
+    /* a second Wait on the completed request must be a harmless no-op
+     * (MPI semantics; previously a use-after-free) */
+    env.Wait(vreq);
+    size_t off = 0;
+    for (size_t i = 0; i < world; i++) {
+      for (size_t k = 0; k < counts[i]; k++)
+        CHECK(recv[off + k] == (float)(i * 100 + k), "AllGatherv payload");
+      off += counts[i];
+    }
+    if (rank == 0) std::printf("compat_test: AllGatherv OK\n");
+  }
+
   for (TestLayer* l : layers) delete l;
   env.DeleteSession(session);
   env.DeleteDistribution(dist);
@@ -402,6 +453,8 @@ int main(int argc, char** argv) {
         "usage: compat_test GROUP_COUNT [DIST_UPDATE] [USER_BUF] [USE_TEST]\n");
     return 0;
   }
+  if (std::strcmp(argv[1], "mismatch") == 0)
+    return MLSL::RunRanks(argc, argv, rank_main_mismatch);
   cfg.group_count = (size_t)std::atoi(argv[1]);
   if (cfg.group_count < 1) cfg.group_count = 1;
   if (argc > 2) cfg.dist_update = std::atoi(argv[2]) != 0;

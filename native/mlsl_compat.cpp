@@ -33,7 +33,9 @@
 #include "../include/mlsl.hpp"
 #include "../include/mlsl_tpu.h"
 
+#include <algorithm>
 #include <atomic>
+#include <chrono>
 #include <condition_variable>
 #include <cstdint>
 #include <cstdlib>
@@ -46,6 +48,7 @@
 #include <string>
 #include <thread>
 #include <unordered_map>
+#include <unordered_set>
 #include <vector>
 
 namespace MLSL {
@@ -63,6 +66,18 @@ size_t dt_size(int dt) { return dt == DT_DOUBLE ? 8 : dt == DT_BYTE ? 1 : 4; }
 }
 
 /* ---- shared_call: execute fn exactly once across the world ------------- */
+
+/* Rendezvous watchdog limit (MLSL_COMPAT_WATCHDOG_S, default 180, 0
+ * disables): shared by the construction-phase slots below and the comm
+ * channels — a ported program whose ranks diverge from congruent call order
+ * must die with a diagnostic, not hang (the reference dies loudly via MPI). */
+long watchdog_secs() {
+  static const long v = [] {
+    const char* e = std::getenv("MLSL_COMPAT_WATCHDOG_S");
+    return e != nullptr ? std::atol(e) : 180L;
+  }();
+  return v;
+}
 
 struct SharedSlot {
   std::mutex mu;
@@ -85,7 +100,8 @@ SharedSlot& slot_at(size_t i) {
 /* All ranks arrive (in matched program order); the last arrival runs fn; all
  * ranks observe the result. Construction-phase rendezvous. */
 uint64_t shared_call(const std::function<uint64_t()>& fn) {
-  SharedSlot& s = slot_at(tl_shared_seq++);
+  size_t idx = tl_shared_seq++;
+  SharedSlot& s = slot_at(idx);
   std::unique_lock<std::mutex> lk(s.mu);
   s.arrived++;
   if (s.arrived == g_world) {
@@ -93,7 +109,21 @@ uint64_t shared_call(const std::function<uint64_t()>& fn) {
     s.done = true;
     s.cv.notify_all();
   } else {
-    s.cv.wait(lk, [&] { return s.done; });
+    const long limit = watchdog_secs();
+    auto deadline = std::chrono::steady_clock::now() +
+                    std::chrono::seconds(limit > 0 ? limit : 0);
+    while (!s.done) {
+      if (limit <= 0) {
+        s.cv.wait(lk);
+        continue;
+      }
+      if (s.cv.wait_until(lk, deadline) == std::cv_status::timeout && !s.done)
+        die("rendezvous watchdog: rank " + std::to_string(tl_rank) +
+            " stuck in construction-phase call #" + std::to_string(idx) +
+            " (arrived=" + std::to_string(s.arrived) + "/" +
+            std::to_string(g_world) +
+            ") — ranks issued API calls in divergent order");
+    }
   }
   return s.result;
 }
@@ -103,7 +133,27 @@ uint64_t shared_call(const std::function<uint64_t()>& fn) {
 struct DistImpl;
 std::atomic<uint64_t> g_channel_ids{1};
 
+/* Live-channel registry: Environment::Wait/Test receive raw CommReq*
+ * pointers; a pointer whose channel was reclaimed must be treated as a
+ * completed request (MPI no-op), not dereferenced. Channels register on
+ * construction and deregister on destruction. */
+std::unordered_set<const void*> g_live_channels;
+std::mutex g_live_mu;
+
+bool channel_live(const void* p) {
+  std::lock_guard<std::mutex> lk(g_live_mu);
+  return g_live_channels.count(p) != 0;
+}
+
 struct Channel {
+  Channel() {
+    std::lock_guard<std::mutex> lk(g_live_mu);
+    g_live_channels.insert(this);
+  }
+  ~Channel() {
+    std::lock_guard<std::mutex> lk(g_live_mu);
+    g_live_channels.erase(this);
+  }
   const uint64_t id = g_channel_ids.fetch_add(1);  // stable key across reuse
   std::mutex mu;
   std::condition_variable cv;
@@ -111,13 +161,18 @@ struct Channel {
   long dispatched_rounds = 0;
   long completed_rounds = 0;
   bool waiting = false;  // one thread at a time executes the global wait
-  /* one-shot (generic collective) channels are reclaimed after every rank
-   * consumed their single round — a training loop issuing Distribution
-   * collectives per step must not accumulate staging buffers */
+  /* one-shot (generic collective) channels are reclaimed (deleted) after
+   * every rank consumed their single round — a training loop issuing
+   * Distribution collectives per step must not accumulate channels. A second
+   * Environment::Wait/Test on the completed CommReq* (a legal MPI no-op) is
+   * made safe by the live-channel registry above, not by keeping the
+   * object. */
   bool one_shot = false;
   int consumed = 0;
   DistImpl* owner = nullptr;
   long seq = -1;
+  /* per-rank Start/Wait counts for the rendezvous watchdog's diagnostic */
+  std::vector<long> started_by, waited_by;
 
   /* recv/user state is round-parity double-buffered: the FIRST depositor of
    * round N+1 resets slot (N+1)&1 while a lagging rank may still be reading
@@ -129,6 +184,10 @@ struct Channel {
   std::vector<char> recv_buf[2];         // round-parity double buffer
   int64_t recv_n[2] = {0, 0};            // per-rank elems actually received
   std::vector<void*> user_ptr[2];        // per-rank in-place write-back target
+  std::vector<int64_t> user_cap[2];      // per-rank write-back cap (-1 = all):
+                                         // ragged v-collectives stage padded
+                                         // rows but must not overrun an
+                                         // MPI-sized user buffer
   uint64_t c_req = 0;                    // generic request handle (if any)
   size_t esize = 4;
 
@@ -146,30 +205,78 @@ thread_local std::unordered_map<uint64_t, TLCounts> tl_counts;
 
 void reclaim_one_shot(Channel& ch);  // defined after DistImpl
 
+/* On timeout in a channel rendezvous, abort with per-rank Start/Wait counts
+ * so the diverging rank is identifiable. */
+[[noreturn]] void watchdog_abort(Channel& ch, const char* where, long round) {
+  std::string msg = "rendezvous watchdog: rank " + std::to_string(tl_rank) +
+                    " stuck in " + where + " on channel " +
+                    std::to_string(ch.id) + " round " + std::to_string(round) +
+                    " (arrived=" + std::to_string(ch.arrived) + "/" +
+                    std::to_string(g_world) +
+                    ", dispatched=" + std::to_string(ch.dispatched_rounds) +
+                    ", completed=" + std::to_string(ch.completed_rounds) +
+                    "; per-rank started/waited:";
+  for (int r = 0; r < g_world; r++) {
+    long s = r < (int)ch.started_by.size() ? ch.started_by[r] : 0;
+    long w = r < (int)ch.waited_by.size() ? ch.waited_by[r] : 0;
+    msg += " " + std::to_string(r) + ":" + std::to_string(s) + "/" +
+           std::to_string(w);
+  }
+  msg += ") — ranks issued collectives in divergent order";
+  die(msg);
+}
+
+/* cv.wait with the watchdog: caller holds lk; pred checked under the lock. */
+template <typename Pred>
+void watched_wait(Channel& ch, std::unique_lock<std::mutex>& lk,
+                  const char* where, long round, Pred pred) {
+  const long limit = watchdog_secs();
+  auto deadline = std::chrono::steady_clock::now() +
+                  std::chrono::seconds(limit > 0 ? limit : 0);
+  while (!pred()) {
+    if (limit <= 0) {
+      ch.cv.wait(lk);
+      continue;
+    }
+    if (ch.cv.wait_until(lk, deadline) == std::cv_status::timeout && !pred())
+      watchdog_abort(ch, where, round);
+  }
+}
+
 /* Deposit this rank's send data (src may be null: no payload, e.g. non-root
  * scatter) and this rank's write-back pointer; the last depositor issues the
- * collective. recv_elems sizes the result staging buffer (upper bound). */
+ * collective. recv_elems sizes the result staging buffer (upper bound).
+ * src_elems (default: elems) is how many elements THIS rank actually copies
+ * into its (world, elems) staging slot — v-collectives deposit ragged counts
+ * into uniform slots. */
 void channel_start(Channel& ch, const void* src, size_t elems,
                    size_t esize, int64_t recv_elems, void* user_ptr,
                    std::function<void(const void*)> start_fn,
-                   std::function<int64_t(void*)> wait_fn) {
+                   std::function<int64_t(void*)> wait_fn,
+                   int64_t src_elems = -1, int64_t user_elems = -1) {
   TLCounts& tl = tl_counts[ch.id];
   std::unique_lock<std::mutex> lk(ch.mu);
   long round = tl.started;
   tl.started++;
+  if (ch.started_by.empty()) ch.started_by.assign(g_world, 0);
+  if (ch.waited_by.empty()) ch.waited_by.assign(g_world, 0);
+  ch.started_by[tl_rank] = tl.started;
   if (ch.arrived == 0) {
     ch.send_buf.assign((size_t)g_world * elems * esize, 0);
     ch.user_ptr[round & 1].assign(g_world, nullptr);
+    ch.user_cap[round & 1].assign(g_world, -1);
     ch.esize = esize;
     ch.start_fn = std::move(start_fn);
     ch.wait_fn = std::move(wait_fn);
     ch.recv_buf[round & 1].assign(
         (size_t)g_world * (recv_elems > 0 ? (size_t)recv_elems : 1) * esize, 0);
   }
-  if (src != nullptr && elems > 0)
+  size_t copy_elems = src_elems >= 0 ? (size_t)src_elems : elems;
+  if (src != nullptr && copy_elems > 0)
     std::memcpy(ch.send_buf.data() + (size_t)tl_rank * elems * esize, src,
-                elems * esize);
+                copy_elems * esize);
   ch.user_ptr[round & 1][tl_rank] = user_ptr;
+  ch.user_cap[round & 1][tl_rank] = user_elems;
   ch.arrived++;
   if (ch.arrived == g_world) {
     ch.arrived = 0;
@@ -177,7 +284,8 @@ void channel_start(Channel& ch, const void* src, size_t elems,
     ch.dispatched_rounds = round + 1;
     ch.cv.notify_all();
   } else {
-    ch.cv.wait(lk, [&] { return ch.dispatched_rounds > round; });
+    watched_wait(ch, lk, "Start (waiting for all ranks to arrive)", round,
+                 [&] { return ch.dispatched_rounds > round; });
   }
 }
 
@@ -190,6 +298,7 @@ void* channel_wait(Channel& ch) {
   long round = tl.waited;
   tl.waited++;
   std::unique_lock<std::mutex> lk(ch.mu);
+  if (!ch.waited_by.empty()) ch.waited_by[tl_rank] = tl.waited;
   while (ch.completed_rounds <= round) {
     if (!ch.waiting) {
       ch.waiting = true;
@@ -203,18 +312,27 @@ void* channel_wait(Channel& ch) {
       ch.waiting = false;
       ch.cv.notify_all();
     } else {
-      ch.cv.wait(lk);
+      // another rank's thread is executing the global wait; the watchdog
+      // still applies — if THAT thread is itself stuck in a rendezvous the
+      // completion never comes
+      watched_wait(ch, lk, "Wait (waiting for round completion)", round,
+                   [&] { return ch.completed_rounds > round || !ch.waiting; });
     }
   }
   int64_t n = ch.recv_n[round & 1];
   char* mine = nullptr;
   void* up = nullptr;
+  int64_t cap = -1;
   if (n > 0) {
     mine = ch.recv_buf[round & 1].data() + (size_t)tl_rank * n * ch.esize;
     up = ch.user_ptr[round & 1][tl_rank];
+    cap = ch.user_cap[round & 1][tl_rank];
   }
   lk.unlock();
-  if (up != nullptr) std::memcpy(up, mine, (size_t)n * ch.esize);
+  if (up != nullptr) {
+    int64_t ncopy = (cap >= 0 && cap < n) ? cap : n;
+    std::memcpy(up, mine, (size_t)ncopy * ch.esize);
+  }
   if (ch.one_shot) {
     /* consume accounting LAST — for one-shot channels the rank that brings
      * consumed to world reclaims the channel, so every other rank must have
@@ -304,7 +422,7 @@ void reclaim_one_shot(Channel& ch) {
     auto it = owner->gen.find(ch.seq);
     if (it != owner->gen.end() && it->second == &ch) owner->gen.erase(it);
   }
-  delete &ch;
+  delete &ch;  // a later Wait/Test on this pointer is caught by the registry
 }
 
 struct ActImpl {
@@ -508,6 +626,7 @@ void Environment::DeleteSession(Session* session) {
 void Environment::Wait(CommReq* req) {
   if (req == nullptr) return;
   Channel* ch = (Channel*)req;
+  if (!channel_live(ch)) return;  // completed + reclaimed: MPI no-op
   channel_wait(*ch);
 }
 
@@ -517,6 +636,10 @@ void Environment::Test(CommReq* req, bool* isCompleted) {
     return;
   }
   Channel* ch = (Channel*)req;
+  if (!channel_live(ch)) {  // completed + reclaimed: MPI no-op
+    *isCompleted = true;
+    return;
+  }
   channel_test(
       *ch, [ch] { return mlsl_request_test(ch->c_req); }, isCompleted);
 }
@@ -536,7 +659,8 @@ size_t group_size(DistImpl* d, GroupType g) {
  * handle is captured by the wait closure. */
 CommReq* generic_start(DistImpl* d, const void* src, size_t send_elems,
                        int dt, int64_t recv_elems, void* user_recv,
-                       std::function<uint64_t(const void*)> issue) {
+                       std::function<uint64_t(const void*)> issue,
+                       int64_t src_elems = -1, int64_t user_elems = -1) {
   long seq = tl_gen_seq[d]++;
   Channel& ch = d->gen_channel(seq);
   Channel* chp = &ch;
@@ -551,7 +675,8 @@ CommReq* generic_start(DistImpl* d, const void* src, size_t send_elems,
                               (mlsl_data_type_t)dt) != MLSL_TPU_SUCCESS)
           die("generic collective wait failed");
         return recv_elems;
-      });
+      },
+      src_elems, user_elems);
   return (CommReq*)&ch;
 }
 
@@ -628,6 +753,99 @@ CommReq* Distribution::AllGather(void* sendBuffer, size_t sendCount,
       });
 }
 
+CommReq* Distribution::AllGatherv(void* sendBuffer, size_t sendCount,
+                                  void* recvBuffer, size_t* recvCounts,
+                                  DataType dataType, GroupType groupType) {
+  /* reference include/mlsl.hpp:470: recvCounts[group_size], identical on
+   * every rank (MPI same-counts-everywhere mode); rank at group position i
+   * sends sendCount == recvCounts[i] elements; every rank receives the
+   * sum(recvCounts)-element concatenation. */
+  DistImpl* d = D(this);
+  uint64_t h = d->h;
+  size_t g = group_size(d, groupType);
+  std::vector<int64_t> counts(g);
+  int64_t maxc = 0, total = 0;
+  for (size_t j = 0; j < g; j++) {
+    counts[j] = (int64_t)recvCounts[j];
+    if (counts[j] > maxc) maxc = counts[j];
+    total += counts[j];
+  }
+  if ((int64_t)sendCount != counts[GetProcessIdx(groupType)])
+    die("AllGatherv: sendCount does not match recvCounts[myIdx]");
+  /* uniform staging slots of maxc elements; this rank deposits sendCount */
+  return generic_start(
+      d, sendBuffer, (size_t)maxc, dataType, total, recvBuffer,
+      [h, maxc, counts, dataType, groupType](const void* world) {
+        return mlsl_distribution_all_gatherv(h, world, maxc, counts.data(),
+                                             (mlsl_data_type_t)dataType,
+                                             (mlsl_group_type_t)groupType);
+      },
+      (int64_t)sendCount);
+}
+
+CommReq* Distribution::AlltoAllv(void* sendBuffer, size_t* sendCounts,
+                                 size_t* sendOffsets, void* recvBuffer,
+                                 size_t* recvCounts, size_t* recvOffsets,
+                                 DataType dataType, GroupType groupType) {
+  /* reference include/mlsl.hpp:432, in the rank-uniform (1-D, same arrays on
+   * every rank) mode the core's static-matrix emulation supports: member j
+   * receives sendCounts[j] from every peer. recvCounts is accepted for
+   * signature parity; MPI requires it to equal the transposed send counts, so
+   * it carries no independent information — the engine derives the receive
+   * geometry from sendCounts (R = S^T) and validates that invariant. The
+   * engine's staging rows are padded to max(sendCounts), so the write-back
+   * into the caller's buffer is capped at THIS rank's MPI-sized receive
+   * extent — a ported program's recvBuffer sized per the reference contract
+   * is never overrun. */
+  (void)recvCounts;
+  DistImpl* d = D(this);
+  uint64_t h = d->h;
+  size_t g = group_size(d, groupType);
+  std::vector<int64_t> sc(g), soff, roff;
+  int64_t send_len = 0, maxc = 0;
+  for (size_t j = 0; j < g; j++) {
+    sc[j] = (int64_t)sendCounts[j];
+    if (sc[j] > maxc) maxc = sc[j];
+  }
+  if (sendOffsets != nullptr) {
+    soff.resize(g);
+    for (size_t j = 0; j < g; j++) {
+      soff[j] = (int64_t)sendOffsets[j];
+      send_len = std::max(send_len, soff[j] + sc[j]);
+    }
+  } else {
+    for (size_t j = 0; j < g; j++) send_len += sc[j];
+  }
+  /* recv_len is the engine's PADDED staging extent (uniform across ranks);
+   * my_recv is THIS rank's MPI-sized receive extent — the write-back cap, so
+   * a recvBuffer sized per the reference contract is never overrun. */
+  int64_t mine = sc[GetProcessIdx(groupType)];
+  int64_t recv_len, my_recv;
+  if (recvOffsets != nullptr) {
+    roff.resize(g);
+    int64_t maxoff = 0;
+    for (size_t j = 0; j < g; j++) {
+      roff[j] = (int64_t)recvOffsets[j];
+      maxoff = std::max(maxoff, roff[j]);
+    }
+    recv_len = maxoff + maxc;
+    my_recv = maxoff + mine;
+  } else {
+    recv_len = (int64_t)g * maxc;  // packed rows padded to the max count
+    my_recv = (int64_t)g * mine;   // my packed rows are the contiguous prefix
+  }
+  return generic_start(
+      d, sendBuffer, (size_t)send_len, dataType, recv_len, recvBuffer,
+      [h, send_len, sc, soff, roff, dataType, groupType](const void* world) {
+        return mlsl_distribution_all_to_allv(
+            h, world, send_len, sc.data(),
+            soff.empty() ? nullptr : soff.data(),
+            roff.empty() ? nullptr : roff.data(), (mlsl_data_type_t)dataType,
+            (mlsl_group_type_t)groupType);
+      },
+      -1, my_recv);
+}
+
 CommReq* Distribution::Gather(void* sendBuffer, size_t sendCount,
                               void* recvBuffer, DataType dataType,
                               size_t rootIdx, GroupType groupType) {
@@ -652,9 +870,14 @@ CommReq* Distribution::Scatter(void* sendBuffer, void* recvBuffer,
   DistImpl* d = D(this);
   uint64_t h = d->h;
   size_t g = group_size(d, groupType);
-  size_t send_elems = recvCount * g;  // send meaningful at root only
+  size_t send_elems = recvCount * g;
+  /* MPI: the send buffer is significant at root ONLY — a non-root rank may
+   * pass a small or uninitialized pointer, so its staging memcpy must be
+   * skipped (null src), not read send_elems from it. */
+  bool is_root = GetProcessIdx(groupType) == rootIdx;
   return generic_start(
-      d, sendBuffer, send_elems, dataType, (int64_t)recvCount, recvBuffer,
+      d, is_root ? sendBuffer : nullptr, send_elems, dataType,
+      (int64_t)recvCount, recvBuffer,
       [h, send_elems, dataType, rootIdx, groupType](const void* world) {
         return mlsl_distribution_scatter(h, world, (int64_t)send_elems,
                                          (mlsl_data_type_t)dataType,

@@ -53,3 +53,29 @@ def test_compat_test_driven_completion(compat_binary):
     """The reference's USE_TEST mode: Update polls TestGradientComm until
     completion instead of blocking in WaitGradientComm."""
     _run(compat_binary, group_count=2, dist_update=1, user_buf=0, use_test=1)
+
+
+def test_compat_v_collectives(compat_binary):
+    """AllGatherv through the drop-in surface (reference mlsl.hpp:470), plus a
+    double Wait on the completed request (must be a no-op, not a
+    use-after-free)."""
+    out = _run(compat_binary, group_count=2, dist_update=0, user_buf=0,
+               use_test=0)
+    assert "compat_test: AllGatherv OK" in out
+
+
+def test_compat_watchdog_on_divergent_ranks(compat_binary):
+    """A rank issuing a collective the others never join must die with a
+    per-rank diagnostic (the reference dies loudly via MPI), not hang."""
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    env["MLSL_TPU_PLATFORM"] = "cpu"
+    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=8"
+    env["MLSL_COMPAT_WATCHDOG_S"] = "3"
+    run = subprocess.run(
+        [compat_binary, "mismatch"], capture_output=True, text=True,
+        timeout=60, env=env,
+    )
+    assert run.returncode != 0
+    assert "rendezvous watchdog" in run.stderr
+    assert "0:1/0" in run.stderr  # rank 0 started, nobody else arrived
