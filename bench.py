@@ -25,6 +25,9 @@ def main():
     ap.add_argument("--quick", action="store_true", help="tiny shapes (CI/CPU)")
     ap.add_argument("--iters", type=int, default=54)
     ap.add_argument("--warmup", type=int, default=6)
+    ap.add_argument("--batch", type=int, default=None,
+                    help="global minibatch (default 128 full / 8 quick); on "
+                         "device OOM the bench re-launches itself at half")
     args = ap.parse_args()
 
     # Probe the backend in a subprocess first: a dead accelerator tunnel hangs
@@ -91,9 +94,11 @@ def main():
     from mlsl_tpu.models.train import DataParallelTrainer
 
     if args.quick:
-        batch, hw, classes = 8, 64, 10
+        batch, hw, classes = args.batch or 8, 64, 10
     else:
-        batch, hw, classes = 32, 224, 1000
+        # batch >= 128: the MXU wants large batched matmuls; 32 left the chip
+        # latency-bound (MFU 0.13). OOM falls back by re-exec (see below).
+        batch, hw, classes = args.batch or 128, 224, 1000
 
     n_dev = len(jax.devices())
     env = mlsl.Environment.get_env().init()
@@ -166,9 +171,20 @@ def main():
 
     # warm up all compiled programs, then measure in ALTERNATING blocks so slow
     # machine/tunnel drift hits all sides equally; medians of per-block means.
-    run_fw(args.warmup)
-    run_raw(args.warmup)
-    run_pl(args.warmup)
+    try:
+        run_fw(args.warmup)
+        run_raw(args.warmup)
+        run_pl(args.warmup)
+    except Exception as e:
+        if not args.quick and batch > 32 and _is_oom(e):
+            half = batch // 2
+            print(f"bench: batch {batch} does not fit on this device; "
+                  f"relaunching at {half}", file=sys.stderr)
+            argv = _argv_without_batch(sys.argv[1:])
+            os.execv(sys.executable, [sys.executable,
+                                      os.path.abspath(__file__),
+                                      *argv, "--batch", str(half)])
+        raise
     # The tunneled device has multi-ms launch jitter; many short alternating
     # blocks + medians keep a bad draw from skewing any one side.
     n_blocks = min(9, max(1, args.iters))
@@ -191,6 +207,37 @@ def main():
     # estimate of the chip's capability (ratios still come from medians of
     # adjacent blocks, which drift cannot skew).
     fw_best = min(fw_blocks)
+
+    # Input-pipeline throughput: AsyncLoader prefetch feeding the framework
+    # trainer with fresh batches each step (the reference's endpoint-server
+    # file-IO offload streaming into shm while the trainer computes) — the
+    # steady-state number a real training job sees, input pipeline included.
+    pipe_ms = None
+    loader = None
+    try:
+        from mlsl_tpu.data import AsyncLoader, synthetic_source
+
+        loader = AsyncLoader(
+            synthetic_source(batch, (hw, hw, 3), classes, seed=1),
+            lambda bx, by: trainer.shard_batch(bx, by), depth=3,
+        )
+        it = iter(loader)
+        for _ in range(2):
+            trainer.step(next(it))
+        _sync(trainer.params)
+        n_pipe = max(6, args.iters // 3)
+        t0 = time.perf_counter()
+        for _ in range(n_pipe):
+            trainer.step(next(it))
+        _sync(trainer.params)
+        pipe_ms = (time.perf_counter() - t0) / n_pipe * 1e3
+    except Exception as e:
+        print(f"bench: pipeline measurement skipped ({e})", file=sys.stderr)
+    finally:
+        if loader is not None:
+            # the prefetch thread must not keep issuing transfers under the
+            # overlap measurement below
+            loader.close()
 
     # Overlap quantification (the point of the async Start/Wait engine —
     # reference eplib newest-first allreduce, eplib/allreduce_pr.c:76-79):
@@ -250,6 +297,9 @@ def main():
         "per_layer_ms": round(pl_ms, 3),
         "per_layer_vs_fused": round(fw_ms / pl_ms, 4),
         "overlap_fraction": round(overlap, 4) if overlap is not None else None,
+        "batch": batch,
+        "pipeline_step_ms": round(pipe_ms, 3) if pipe_ms is not None else None,
+        "images_per_s": round(batch / (pipe_ms / 1e3)) if pipe_ms else None,
         "tflops": round(tflops, 3) if tflops else None,
         "mfu": round(mfu, 4) if mfu else None,
         "transformer_tok_s": round(tfm_tok_s) if tfm_tok_s else None,
@@ -259,6 +309,28 @@ def main():
     print(json.dumps(result))
     if not args.quick:  # --quick CPU runs are smoke tests, not evidence
         _persist_measurement(result)
+
+
+def _is_oom(e: BaseException) -> bool:
+    s = str(e)
+    return ("RESOURCE_EXHAUSTED" in s or "Out of memory" in s
+            or "out of memory" in s or "OOM" in s)
+
+
+def _argv_without_batch(argv):
+    """Drop any existing --batch/--batch=N so the re-exec's value wins."""
+    out, skip = [], False
+    for a in argv:
+        if skip:
+            skip = False
+            continue
+        if a == "--batch":
+            skip = True
+            continue
+        if a.startswith("--batch="):
+            continue
+        out.append(a)
+    return out
 
 
 def _persist_measurement(result):

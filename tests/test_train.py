@@ -226,3 +226,30 @@ def test_shard_batch_local_single_process(env):
     ga, gb = tr.shard_batch(x, y), tr.shard_batch_local(x, y)
     np.testing.assert_array_equal(np.asarray(ga[0]), np.asarray(gb[0]))
     np.testing.assert_array_equal(np.asarray(ga[1]), np.asarray(gb[1]))
+
+
+def test_bn_fused_matches_two_pass_oracle():
+    """The one-pass fused BN (single activation read, folded per-channel
+    affine) must match the classic two-pass f32 normalization — exactly in
+    f32, within bf16 rounding in bf16."""
+    from mlsl_tpu.models import resnet
+
+    rng = np.random.default_rng(0)
+    for dtype, tol in ((jnp.float32, 1e-5), (jnp.bfloat16, 4e-2)):
+        x = jnp.asarray(
+            (rng.normal(size=(8, 6, 6, 16)) * 3 + 1).astype(np.float32)
+        ).astype(dtype)
+        p = {
+            "scale": jnp.asarray(rng.uniform(0.5, 2, 16).astype(np.float32)),
+            "bias": jnp.asarray(rng.normal(size=16).astype(np.float32)),
+        }
+        got = resnet._bn(x, p)
+        assert got.dtype == x.dtype
+        xf = np.asarray(x, np.float32)
+        mean = xf.mean((0, 1, 2))
+        var = xf.var((0, 1, 2))
+        want = (xf - mean) / np.sqrt(var + 1e-5) * np.asarray(p["scale"]) \
+            + np.asarray(p["bias"])
+        np.testing.assert_allclose(
+            np.asarray(got, np.float32), want, atol=tol, rtol=tol
+        )
