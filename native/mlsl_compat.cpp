@@ -133,26 +133,28 @@ uint64_t shared_call(const std::function<uint64_t()>& fn) {
 struct DistImpl;
 std::atomic<uint64_t> g_channel_ids{1};
 
-/* Live-channel registry: Environment::Wait/Test receive raw CommReq*
- * pointers; a pointer whose channel was reclaimed must be treated as a
- * completed request (MPI no-op), not dereferenced. Channels register on
- * construction and deregister on destruction. */
-std::unordered_set<const void*> g_live_channels;
+/* Live-channel registry BY ID: one-shot channels are deleted once every rank
+ * consumed them, but the CommReq handles handed to callers (GenReq below)
+ * outlive them and must be able to tell "my channel is gone" apart from a
+ * recycled allocation at the same address (ABA). Ids are monotonic and never
+ * reused. Channels register on construction, deregister on destruction. */
+std::unordered_map<uint64_t, struct Channel*> g_live_by_id;
 std::mutex g_live_mu;
 
-bool channel_live(const void* p) {
+Channel* channel_by_id(uint64_t id) {
   std::lock_guard<std::mutex> lk(g_live_mu);
-  return g_live_channels.count(p) != 0;
+  auto it = g_live_by_id.find(id);
+  return it == g_live_by_id.end() ? nullptr : it->second;
 }
 
 struct Channel {
   Channel() {
     std::lock_guard<std::mutex> lk(g_live_mu);
-    g_live_channels.insert(this);
+    g_live_by_id.emplace(id, this);
   }
   ~Channel() {
     std::lock_guard<std::mutex> lk(g_live_mu);
-    g_live_channels.erase(this);
+    g_live_by_id.erase(id);
   }
   const uint64_t id = g_channel_ids.fetch_add(1);  // stable key across reuse
   std::mutex mu;
@@ -188,6 +190,10 @@ struct Channel {
                                          // ragged v-collectives stage padded
                                          // rows but must not overrun an
                                          // MPI-sized user buffer
+  /* per-rank custom write-back (user_ptr, staging slice): offset-mode
+   * v-collectives copy only their valid blocks, leaving the gap bytes MPI
+   * guarantees untouched */
+  std::vector<std::function<void(void*, const char*)>> user_wb[2];
   uint64_t c_req = 0;                    // generic request handle (if any)
   size_t esize = 4;
 
@@ -253,7 +259,8 @@ void channel_start(Channel& ch, const void* src, size_t elems,
                    size_t esize, int64_t recv_elems, void* user_ptr,
                    std::function<void(const void*)> start_fn,
                    std::function<int64_t(void*)> wait_fn,
-                   int64_t src_elems = -1, int64_t user_elems = -1) {
+                   int64_t src_elems = -1, int64_t user_elems = -1,
+                   std::function<void(void*, const char*)> writer = nullptr) {
   TLCounts& tl = tl_counts[ch.id];
   std::unique_lock<std::mutex> lk(ch.mu);
   long round = tl.started;
@@ -265,6 +272,7 @@ void channel_start(Channel& ch, const void* src, size_t elems,
     ch.send_buf.assign((size_t)g_world * elems * esize, 0);
     ch.user_ptr[round & 1].assign(g_world, nullptr);
     ch.user_cap[round & 1].assign(g_world, -1);
+    ch.user_wb[round & 1].assign(g_world, nullptr);
     ch.esize = esize;
     ch.start_fn = std::move(start_fn);
     ch.wait_fn = std::move(wait_fn);
@@ -277,6 +285,7 @@ void channel_start(Channel& ch, const void* src, size_t elems,
                 copy_elems * esize);
   ch.user_ptr[round & 1][tl_rank] = user_ptr;
   ch.user_cap[round & 1][tl_rank] = user_elems;
+  ch.user_wb[round & 1][tl_rank] = std::move(writer);
   ch.arrived++;
   if (ch.arrived == g_world) {
     ch.arrived = 0;
@@ -323,15 +332,21 @@ void* channel_wait(Channel& ch) {
   char* mine = nullptr;
   void* up = nullptr;
   int64_t cap = -1;
+  std::function<void(void*, const char*)> wb;
   if (n > 0) {
     mine = ch.recv_buf[round & 1].data() + (size_t)tl_rank * n * ch.esize;
     up = ch.user_ptr[round & 1][tl_rank];
     cap = ch.user_cap[round & 1][tl_rank];
+    wb = ch.user_wb[round & 1][tl_rank];
   }
   lk.unlock();
   if (up != nullptr) {
-    int64_t ncopy = (cap >= 0 && cap < n) ? cap : n;
-    std::memcpy(up, mine, (size_t)ncopy * ch.esize);
+    if (wb) {
+      wb(up, mine);
+    } else {
+      int64_t ncopy = (cap >= 0 && cap < n) ? cap : n;
+      std::memcpy(up, mine, (size_t)ncopy * ch.esize);
+    }
   }
   if (ch.one_shot) {
     /* consume accounting LAST — for one-shot channels the rank that brings
@@ -394,12 +409,34 @@ struct BlockImpl {
 struct SessImpl;
 struct OpImpl;
 
+/* The CommReq* returned for a generic collective. A tiny stable handle that
+ * OUTLIVES its (one-shot, reclaimed-on-consume) channel: it resolves the
+ * channel by never-reused id, and tracks per-rank consumption so a second
+ * Wait/Test is an MPI no-op without ever dereferencing channel memory —
+ * immune to both address reuse (ABA) and reclaim races. Freed at
+ * DeleteDistribution. */
+struct GenReq {
+  uint64_t chan_id = 0;
+  /* per-rank consumption flags; each slot is written by its own rank and read
+   * cross-thread by the pruner, hence atomic */
+  std::vector<std::atomic<char>> consumed;
+  explicit GenReq(uint64_t id) : chan_id(id), consumed(g_world) {
+    for (auto& c : consumed) c.store(0, std::memory_order_relaxed);
+  }
+};
+
+/* Fully-consumed handles older than this many generic collectives are pruned
+ * (re-Waiting a request this stale is outside even MPI's semantics — the
+ * reference frees requests on the FIRST Wait). */
+constexpr long GEN_REQ_WINDOW = 1024;
+
 struct DistImpl {
   uint64_t h = 0;
   size_t data_parts = 0, model_parts = 0;
   /* generic-collective channels, keyed by per-rank call sequence (congruent
    * program order makes the k-th call on every rank the same collective) */
   std::map<long, Channel*> gen;
+  std::map<long, GenReq*> gen_reqs;  // handles live until DeleteDistribution
   std::mutex gen_mu;
   Channel& gen_channel(long seq) {
     std::lock_guard<std::mutex> lk(gen_mu);
@@ -411,6 +448,23 @@ struct DistImpl {
       c->seq = seq;
     }
     return *c;
+  }
+  GenReq& gen_req(long seq, uint64_t chan_id) {
+    std::lock_guard<std::mutex> lk(gen_mu);
+    /* prune fully-consumed handles outside the re-Wait window so a long
+     * training loop's map stays bounded (~100 KB) */
+    while (!gen_reqs.empty() && gen_reqs.begin()->first + GEN_REQ_WINDOW < seq) {
+      GenReq* old = gen_reqs.begin()->second;
+      bool all = true;
+      for (auto& c : old->consumed)
+        if (!c.load(std::memory_order_relaxed)) { all = false; break; }
+      if (!all) break;
+      delete old;
+      gen_reqs.erase(gen_reqs.begin());
+    }
+    GenReq*& r = gen_reqs[seq];
+    if (r == nullptr) r = new GenReq(chan_id);
+    return *r;
   }
 };
 thread_local std::unordered_map<const void*, long> tl_gen_seq;
@@ -599,6 +653,7 @@ void Environment::DeleteDistribution(Distribution* distribution) {
        * outstanding CommReq* from this distribution are invalidated, as the
        * reference invalidates requests at Finalize */
       for (auto& kv : d->gen) delete kv.second;
+      for (auto& kv : d->gen_reqs) delete kv.second;
       delete d;
     }
     return 0;
@@ -625,9 +680,16 @@ void Environment::DeleteSession(Session* session) {
 
 void Environment::Wait(CommReq* req) {
   if (req == nullptr) return;
-  Channel* ch = (Channel*)req;
-  if (!channel_live(ch)) return;  // completed + reclaimed: MPI no-op
+  GenReq* r = (GenReq*)req;
+  if (r->consumed[tl_rank].load(std::memory_order_acquire))
+    return;  // MPI no-op on a completed request
+  /* this rank has NOT consumed its round, so the one-shot channel cannot
+   * have been reclaimed (reclaim requires all ranks consumed) — the id
+   * lookup is race-free, not a check-then-use on raw memory */
+  Channel* ch = channel_by_id(r->chan_id);
+  if (ch == nullptr) return;  // defensive: invalidated by DeleteDistribution
   channel_wait(*ch);
+  r->consumed[tl_rank].store(1, std::memory_order_release);
 }
 
 void Environment::Test(CommReq* req, bool* isCompleted) {
@@ -635,13 +697,19 @@ void Environment::Test(CommReq* req, bool* isCompleted) {
     *isCompleted = true;
     return;
   }
-  Channel* ch = (Channel*)req;
-  if (!channel_live(ch)) {  // completed + reclaimed: MPI no-op
+  GenReq* r = (GenReq*)req;
+  if (r->consumed[tl_rank].load(std::memory_order_acquire)) {
+    *isCompleted = true;  // MPI no-op on a completed request
+    return;
+  }
+  Channel* ch = channel_by_id(r->chan_id);
+  if (ch == nullptr) {
     *isCompleted = true;
     return;
   }
   channel_test(
       *ch, [ch] { return mlsl_request_test(ch->c_req); }, isCompleted);
+  if (*isCompleted) r->consumed[tl_rank].store(1, std::memory_order_release);
 }
 
 /* ---- Distribution ------------------------------------------------------ */
@@ -660,7 +728,8 @@ size_t group_size(DistImpl* d, GroupType g) {
 CommReq* generic_start(DistImpl* d, const void* src, size_t send_elems,
                        int dt, int64_t recv_elems, void* user_recv,
                        std::function<uint64_t(const void*)> issue,
-                       int64_t src_elems = -1, int64_t user_elems = -1) {
+                       int64_t src_elems = -1, int64_t user_elems = -1,
+                       std::function<void(void*, const char*)> writer = nullptr) {
   long seq = tl_gen_seq[d]++;
   Channel& ch = d->gen_channel(seq);
   Channel* chp = &ch;
@@ -676,8 +745,8 @@ CommReq* generic_start(DistImpl* d, const void* src, size_t send_elems,
           die("generic collective wait failed");
         return recv_elems;
       },
-      src_elems, user_elems);
-  return (CommReq*)&ch;
+      src_elems, user_elems, std::move(writer));
+  return (CommReq*)&d->gen_req(seq, ch.id);
 }
 
 }  // namespace
@@ -821,6 +890,8 @@ CommReq* Distribution::AlltoAllv(void* sendBuffer, size_t* sendCounts,
    * a recvBuffer sized per the reference contract is never overrun. */
   int64_t mine = sc[GetProcessIdx(groupType)];
   int64_t recv_len, my_recv;
+  std::function<void(void*, const char*)> writer;  // offset mode only
+  size_t esz = dt_size(dataType);
   if (recvOffsets != nullptr) {
     roff.resize(g);
     int64_t maxoff = 0;
@@ -830,6 +901,14 @@ CommReq* Distribution::AlltoAllv(void* sendBuffer, size_t* sendCounts,
     }
     recv_len = maxoff + maxc;
     my_recv = maxoff + mine;
+    /* block-accurate write-back: copy ONLY the valid block from each peer
+     * (staging rows sit at the same roff[j]); gap bytes between blocks are
+     * left untouched, as MPI guarantees */
+    writer = [roff, mine, esz](void* up, const char* src) {
+      for (int64_t o : roff)
+        std::memcpy((char*)up + (size_t)o * esz, src + (size_t)o * esz,
+                    (size_t)mine * esz);
+    };
   } else {
     recv_len = (int64_t)g * maxc;  // packed rows padded to the max count
     my_recv = (int64_t)g * mine;   // my packed rows are the contiguous prefix
@@ -843,7 +922,7 @@ CommReq* Distribution::AlltoAllv(void* sendBuffer, size_t* sendCounts,
             roff.empty() ? nullptr : roff.data(), (mlsl_data_type_t)dataType,
             (mlsl_group_type_t)groupType);
       },
-      -1, my_recv);
+      -1, my_recv, std::move(writer));
 }
 
 CommReq* Distribution::Gather(void* sendBuffer, size_t sendCount,
