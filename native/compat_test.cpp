@@ -336,6 +336,7 @@ int rank_main(int argc, char** argv) {
   Environment& env = Environment::GetEnv();
   CHECK(MLSL_MAJOR(Environment::GetVersion()) == MLSL_MAJOR_VERSION,
         "API version mismatch");
+  env.Configure("color=0");  // homogeneous colors: validated full-world no-op
   env.Init(&argc, &argv);
 
   size_t world = env.GetProcessCount();

@@ -417,18 +417,21 @@ struct OpImpl;
  * DeleteDistribution. */
 struct GenReq {
   uint64_t chan_id = 0;
-  /* per-rank consumption flags; each slot is written by its own rank and read
-   * cross-thread by the pruner, hence atomic */
+  /* per-rank consumption flags; each slot written by its own rank, read
+   * cross-rank only through that rank's own Wait/Test, hence atomic */
   std::vector<std::atomic<char>> consumed;
   explicit GenReq(uint64_t id) : chan_id(id), consumed(g_world) {
     for (auto& c : consumed) c.store(0, std::memory_order_relaxed);
   }
 };
 
-/* Fully-consumed handles older than this many generic collectives are pruned
- * (re-Waiting a request this stale is outside even MPI's semantics — the
- * reference frees requests on the FIRST Wait). */
-constexpr long GEN_REQ_WINDOW = 1024;
+/* Handles retired by DeleteDistribution; freed at Finalize. Keeping them
+ * alive for the Environment's lifetime makes Wait/Test on ANY handle issued
+ * since Init memory-safe (~150 B per generic collective — graph-edge comms
+ * use cached per-edge requests, so generic handles are rare). The reference
+ * instead frees requests on first Wait and UBs on any reuse. */
+std::vector<GenReq*> g_retired_reqs;
+std::mutex g_retired_mu;
 
 struct DistImpl {
   uint64_t h = 0;
@@ -451,17 +454,6 @@ struct DistImpl {
   }
   GenReq& gen_req(long seq, uint64_t chan_id) {
     std::lock_guard<std::mutex> lk(gen_mu);
-    /* prune fully-consumed handles outside the re-Wait window so a long
-     * training loop's map stays bounded (~100 KB) */
-    while (!gen_reqs.empty() && gen_reqs.begin()->first + GEN_REQ_WINDOW < seq) {
-      GenReq* old = gen_reqs.begin()->second;
-      bool all = true;
-      for (auto& c : old->consumed)
-        if (!c.load(std::memory_order_relaxed)) { all = false; break; }
-      if (!all) break;
-      delete old;
-      gen_reqs.erase(gen_reqs.begin());
-    }
     GenReq*& r = gen_reqs[seq];
     if (r == nullptr) r = new GenReq(chan_id);
     return *r;
@@ -577,13 +569,51 @@ Environment& Environment::GetEnv() { return g_env_obj; }
 int Environment::GetVersion() {
   return MLSL_VERSION(MLSL_MAJOR_VERSION, MLSL_MINOR_VERSION);
 }
-void Environment::Configure(const char*) {}
+namespace {
+std::vector<long> g_cfg_colors;
+std::mutex g_cfg_mu;
+}  // namespace
+
+void Environment::Configure(const char* config) {
+  /* Reference semantics (src/mlsl.cpp:620-647): ranks sharing a color form
+   * the new global group — i.e. heterogeneous colors split the world into
+   * independent MLSL instances. The single-controller compat runtime serves
+   * exactly ONE world, so the homogeneous case (all ranks same color — the
+   * common "restrict to my job's ranks" usage) is a validated no-op and
+   * heterogeneous colors fail loudly instead of being silently ignored. */
+  if (config == nullptr) return;
+  if (tl_rank < 0) die("Environment::Configure outside a RunRanks rank thread");
+  std::string s(config);
+  size_t eq = s.find("color=");
+  if (eq == std::string::npos)
+    die("Configure: unsupported configuration string '" + s + "'");
+  long color = std::atol(s.c_str() + eq + 6);
+  {
+    std::lock_guard<std::mutex> lk(g_cfg_mu);
+    if (g_cfg_colors.empty()) g_cfg_colors.assign(g_world, 0);
+    g_cfg_colors[tl_rank] = color;
+  }
+  shared_call([&]() -> uint64_t {
+    std::lock_guard<std::mutex> lk(g_cfg_mu);
+    for (long c : g_cfg_colors)
+      if (c != g_cfg_colors[0])
+        die("Configure: per-color sub-worlds are not supported by the "
+            "single-controller compat runtime; all ranks must pass the same "
+            "color");
+    return 0;
+  });
+}
 void Environment::Init(int*, char***) {
   /* the runtime is brought up once by RunRanks; per-rank Init is bookkeeping */
   if (tl_rank < 0) die("Environment::Init outside a RunRanks rank thread");
 }
 void Environment::Finalize() {
-  shared_call([] { return (uint64_t)mlsl_environment_finalize(); });
+  shared_call([] {
+    std::lock_guard<std::mutex> lk(g_retired_mu);
+    for (GenReq* r : g_retired_reqs) delete r;
+    g_retired_reqs.clear();
+    return (uint64_t)mlsl_environment_finalize();
+  });
 }
 bool Environment::IsInitialized() { return g_env.initialized; }
 size_t Environment::GetProcessIdx() { return (size_t)tl_rank; }
@@ -649,11 +679,15 @@ void Environment::DeleteDistribution(Distribution* distribution) {
     DistImpl* d = (DistImpl*)distribution;
     if (d != nullptr) {
       mlsl_handle_release(d->h);
-      /* every rank has arrived here (shared_call), so no channel is in use;
-       * outstanding CommReq* from this distribution are invalidated, as the
-       * reference invalidates requests at Finalize */
+      /* every rank has arrived here (shared_call), so no channel is in use.
+       * Handles are RETIRED, not freed: a Wait/Test on a request outstanding
+       * across DeleteDistribution stays a memory-safe no-op (its channel id
+       * resolves to nothing); Finalize reclaims the retired handles. */
       for (auto& kv : d->gen) delete kv.second;
-      for (auto& kv : d->gen_reqs) delete kv.second;
+      {
+        std::lock_guard<std::mutex> lk(g_retired_mu);
+        for (auto& kv : d->gen_reqs) g_retired_reqs.push_back(kv.second);
+      }
       delete d;
     }
     return 0;
@@ -861,12 +895,12 @@ CommReq* Distribution::AlltoAllv(void* sendBuffer, size_t* sendCounts,
    * receives sendCounts[j] from every peer. recvCounts is accepted for
    * signature parity; MPI requires it to equal the transposed send counts, so
    * it carries no independent information — the engine derives the receive
-   * geometry from sendCounts (R = S^T) and validates that invariant. The
-   * engine's staging rows are padded to max(sendCounts), so the write-back
-   * into the caller's buffer is capped at THIS rank's MPI-sized receive
-   * extent — a ported program's recvBuffer sized per the reference contract
-   * is never overrun. */
-  (void)recvCounts;
+   * geometry from sendCounts (R = S^T), and a recvCounts that violates the
+   * invariant dies here instead of silently receiving the wrong geometry.
+   * The engine's staging rows are padded to max(sendCounts), so the
+   * write-back into the caller's buffer is capped at THIS rank's MPI-sized
+   * receive extent — a ported program's recvBuffer sized per the reference
+   * contract is never overrun. */
   DistImpl* d = D(this);
   uint64_t h = d->h;
   size_t g = group_size(d, groupType);
@@ -889,6 +923,15 @@ CommReq* Distribution::AlltoAllv(void* sendBuffer, size_t* sendCounts,
    * my_recv is THIS rank's MPI-sized receive extent — the write-back cap, so
    * a recvBuffer sized per the reference contract is never overrun. */
   int64_t mine = sc[GetProcessIdx(groupType)];
+  if (recvCounts != nullptr) {
+    /* MPI invariant in rank-uniform mode: I receive sendCounts[myIdx] from
+     * every peer, so every recvCounts entry must equal it */
+    for (size_t j = 0; j < g; j++)
+      if ((int64_t)recvCounts[j] != mine)
+        die("AlltoAllv: recvCounts[" + std::to_string(j) + "] = " +
+            std::to_string(recvCounts[j]) + " violates R = S^T (expected " +
+            std::to_string(mine) + " = sendCounts[myIdx])");
+  }
   int64_t recv_len, my_recv;
   std::function<void(void*, const char*)> writer;  // offset mode only
   size_t esz = dt_size(dataType);
