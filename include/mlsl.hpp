@@ -268,6 +268,11 @@ namespace MLSL
         static Environment& GetEnv();
         static int GetVersion();
         void Configure(const char* config = NULL);
+        /* each rank passes ITS colors; ranks sharing a dataColor/modelColor
+         * form that group (reference :864; unequal partitions follow the
+         * padded ragged-group contract, docs/DESIGN.md) */
+        Distribution* CreateDistributionWithColors(int dataColor,
+                                                   int modelColor);
         void Init(int* argc, char** argv[]);
         void Finalize();
         bool IsInitialized();

@@ -49,6 +49,10 @@ mlsl_handle_t mlsl_environment_create_distribution(int64_t data_parts,
                                                    int64_t model_parts,
                                                    int64_t seq_parts);
 mlsl_handle_t mlsl_environment_create_session(void);
+/* Color-defined groups (reference CreateDistributionWithColors): int64[n]
+ * per-rank color vectors; ranks sharing a color form that group. */
+mlsl_handle_t mlsl_environment_create_distribution_with_colors(
+    const int64_t* data_colors, const int64_t* model_colors, int64_t n);
 /* Register codec params (reference SetQuantizationParams). lib_path (may be
  * NULL) selects a dlopen'd codec honoring the reference's symbol contract;
  * load failures return MLSL_TPU_FAILURE (see mlsl_last_error()). */

@@ -65,6 +65,19 @@ def env_create_distribution(data_parts: int, model_parts: int, seq_parts: int) -
     return _put(env.create_distribution(data_parts, model_parts, seq_parts=seq_parts))
 
 
+def env_create_distribution_with_colors(
+    data_addr: int, model_addr: int, n: int
+) -> int:
+    """Color-defined process groups (reference CreateDistributionWithColors,
+    include/mlsl.hpp:864): int64[n] per-rank color vectors at the given
+    addresses; ranks sharing a data/model color form that group (unequal
+    partitions ride the padded ragged-group contract)."""
+    data = tuple(int(c) for c in _read_i64_array(data_addr, int(n)))
+    model = tuple(int(c) for c in _read_i64_array(model_addr, int(n)))
+    env = Environment.get_env()
+    return _put(env.create_distribution_with_colors(data, model))
+
+
 def env_create_session() -> int:
     return _put(Environment.get_env().create_session())
 

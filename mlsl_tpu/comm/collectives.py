@@ -113,8 +113,10 @@ def _body_allreduce(x, *, axes, sizes, op, **_):
 
 
 def _body_reduce(x, *, axes, sizes, op, root, **_):
-    # MPI semantics: result meaningful only at root. Returning the reduction on every
-    # member is a strict superset and lets XLA use the same allreduce lowering.
+    # MPI semantics: result meaningful only at root. Returning the reduction on
+    # every member is a strict superset AND the faster program on a ring
+    # interconnect — rooted trees cost more link-bytes than the pipelined
+    # psum (hop-count argument: docs/DESIGN.md "Rooted collectives").
     return _preduce(x, axes, op)
 
 

@@ -165,6 +165,13 @@ mlsl_handle_t mlsl_environment_create_session(void) {
   return (mlsl_handle_t)call_i("env_create_session", {}, 0);
 }
 
+mlsl_handle_t mlsl_environment_create_distribution_with_colors(
+    const int64_t* data_colors, const int64_t* model_colors, int64_t n) {
+  return (mlsl_handle_t)call_i(
+      "env_create_distribution_with_colors",
+      {(int64_t)(intptr_t)data_colors, (int64_t)(intptr_t)model_colors, n}, 0);
+}
+
 int mlsl_environment_set_quantization_params(
     const char* lib_path, const char* quant_name, const char* dequant_name,
     const char* reduce_name, int64_t block_size, int64_t elem_in_block) {

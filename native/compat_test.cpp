@@ -423,6 +423,25 @@ int rank_main(int argc, char** argv) {
     if (rank == 0) std::printf("compat_test: AllGatherv OK\n");
   }
 
+  /* color-defined distribution (reference mlsl.hpp:864): unequal data groups
+   * {ranks 0..2} and {ranks 3..}, allreduce summed within each group */
+  if (world >= 4) {
+    int my_color = rank < 3 ? 0 : 1;
+    Distribution* cdist = env.CreateDistributionWithColors(my_color, 0);
+    size_t gsz = rank < 3 ? 3 : world - 3;
+    std::vector<float> v(8, (float)(rank + 1));
+    CommReq* cr = cdist->AllReduce(v.data(), v.data(), 8, DT_FLOAT, RT_SUM,
+                                   GT_DATA);
+    env.Wait(cr);
+    float want = 0.0f;
+    for (size_t q = (rank < 3 ? 0 : 3); q < (rank < 3 ? 3 : world); q++)
+      want += (float)(q + 1);
+    for (float x : v) CHECK(x == want, "colored allreduce payload");
+    CHECK(cdist->GetProcessCount(GT_DATA) >= gsz, "colored group size");
+    env.DeleteDistribution(cdist);
+    if (rank == 0) std::printf("compat_test: colored distribution OK\n");
+  }
+
   for (TestLayer* l : layers) delete l;
   env.DeleteSession(session);
   env.DeleteDistribution(dist);

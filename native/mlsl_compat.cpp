@@ -570,6 +570,19 @@ int Environment::GetVersion() {
   return MLSL_VERSION(MLSL_MAJOR_VERSION, MLSL_MINOR_VERSION);
 }
 namespace {
+/* gather one value per rank thread ahead of a shared_call: every rank stores
+ * its slot before arriving at the barrier, so the last arriver sees the
+ * complete vector. Dies outside a RunRanks rank thread. */
+template <typename T>
+void gather_per_rank(std::vector<T>& vec, std::mutex& mu, T value,
+                     const char* what) {
+  if (tl_rank < 0)
+    die(std::string(what) + " outside a RunRanks rank thread");
+  std::lock_guard<std::mutex> lk(mu);
+  if (vec.empty()) vec.assign(g_world, T());
+  vec[tl_rank] = value;
+}
+
 std::vector<long> g_cfg_colors;
 std::mutex g_cfg_mu;
 }  // namespace
@@ -582,17 +595,12 @@ void Environment::Configure(const char* config) {
    * common "restrict to my job's ranks" usage) is a validated no-op and
    * heterogeneous colors fail loudly instead of being silently ignored. */
   if (config == nullptr) return;
-  if (tl_rank < 0) die("Environment::Configure outside a RunRanks rank thread");
   std::string s(config);
   size_t eq = s.find("color=");
   if (eq == std::string::npos)
     die("Configure: unsupported configuration string '" + s + "'");
   long color = std::atol(s.c_str() + eq + 6);
-  {
-    std::lock_guard<std::mutex> lk(g_cfg_mu);
-    if (g_cfg_colors.empty()) g_cfg_colors.assign(g_world, 0);
-    g_cfg_colors[tl_rank] = color;
-  }
+  gather_per_rank(g_cfg_colors, g_cfg_mu, color, "Environment::Configure");
   shared_call([&]() -> uint64_t {
     std::lock_guard<std::mutex> lk(g_cfg_mu);
     for (long c : g_cfg_colors)
@@ -669,6 +677,37 @@ Distribution* Environment::CreateDistribution(size_t dataPartitions,
     if (d->h == 0) die("CreateDistribution failed");
     d->data_parts = dataPartitions;
     d->model_parts = modelPartitions;
+    return (uint64_t)(uintptr_t)d;
+  });
+  return (Distribution*)(uintptr_t)r;
+}
+
+namespace {
+std::vector<int64_t> g_dist_dcolors, g_dist_mcolors;
+std::mutex g_dist_colors_mu;
+}  // namespace
+
+Distribution* Environment::CreateDistributionWithColors(int dataColor,
+                                                        int modelColor) {
+  /* Reference include/mlsl.hpp:864: each rank passes ITS colors; ranks with
+   * the same dataColor form a data group (same for model). Gather per-rank
+   * colors, then the last arriver creates the colored distribution once.
+   * Unequal partitions are served by the core's padded ragged-group
+   * contract (docs/DESIGN.md). */
+  gather_per_rank(g_dist_dcolors, g_dist_colors_mu, (int64_t)dataColor,
+                  "Environment::CreateDistributionWithColors");
+  gather_per_rank(g_dist_mcolors, g_dist_colors_mu, (int64_t)modelColor,
+                  "Environment::CreateDistributionWithColors");
+  uint64_t r = shared_call([&]() -> uint64_t {
+    std::lock_guard<std::mutex> lk(g_dist_colors_mu);
+    DistImpl* d = new DistImpl();
+    d->h = mlsl_environment_create_distribution_with_colors(
+        g_dist_dcolors.data(), g_dist_mcolors.data(), (int64_t)g_world);
+    if (d->h == 0) die("CreateDistributionWithColors failed");
+    d->data_parts = 0;  // color-defined: no rectangular factorization
+    d->model_parts = 0;
+    g_dist_dcolors.clear();  // next call gathers afresh
+    g_dist_mcolors.clear();
     return (uint64_t)(uintptr_t)d;
   });
   return (Distribution*)(uintptr_t)r;

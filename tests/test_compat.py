@@ -62,6 +62,7 @@ def test_compat_v_collectives(compat_binary):
     out = _run(compat_binary, group_count=2, dist_update=0, user_buf=0,
                use_test=0)
     assert "compat_test: AllGatherv OK" in out
+    assert "compat_test: colored distribution OK" in out
 
 
 def test_compat_watchdog_on_divergent_ranks(compat_binary):
