@@ -188,10 +188,12 @@ class DataParallelTrainer:
       layers: ordered list of names; get_layer(params, name) -> subtree (its flattened
       size is the Operation's kernel count).
 
-    Attribute contract: ``trainer.params`` is replaced every step; on the fused
-    (no-comm) path the previous value's buffers are DONATED to XLA and deleted, so a
-    reference held across a step() becomes unreadable. Snapshot with
-    ``jax.device_get(trainer.params)`` (or construct with donate_params=False).
+    Attribute contract: ``trainer.params`` is replaced every step, and the
+    previous value's buffers are DONATED to XLA (in-place HBM update) on the
+    fused, per-layer, and distributed-update paths — a reference held across a
+    step() becomes unreadable. Snapshot with ``jax.device_get(trainer.params)``
+    or construct with donate_params=False (the overlap_updates path never
+    donates). Optimizer state follows the same donation contract.
     """
 
     def __init__(
@@ -307,13 +309,16 @@ class DataParallelTrainer:
         # Start/Wait machinery can be measured even when no comm is needed
         # (bench.py times it against the fused program on one chip).
         use_fused = not needs_comm and not force_graph_path
+        self.donate_params = bool(donate_params)
         sharding = NamedSharding(self.mesh, P())
-        if not use_fused or not donate_params:
+        if not donate_params or overlap_updates:
+            # overlap_updates never donates (per-layer subtree updates), so the
+            # owning copy would buy nothing
             self.params = jax.device_put(params, sharding)
         else:
-            # Owning copy: the fused step donates self.params, so the trainer must
-            # not alias the caller's arrays (device_put alone can alias on-device
-            # inputs).
+            # Owning copy: donating steps (fused AND per-layer update/apply)
+            # consume self.params, so the trainer must not alias the caller's
+            # arrays (device_put alone can alias on-device inputs).
             self.params = jax.tree.map(
                 lambda x: jax.device_put(jnp.array(x, copy=True), sharding), params
             )
@@ -455,7 +460,11 @@ class DataParallelTrainer:
             )
             return sm(params, *[reduced[n] for n in layers])
 
-        return jax.jit(update)
+        # donated params: the update is in-place in HBM (same contract as the
+        # fused path — see the class docstring)
+        return jax.jit(
+            update, donate_argnums=(0,) if self.donate_params else ()
+        )
 
     def _build_opt_update_fn(self):
         """optax path: reduced per-layer gradient buffers -> (params, opt_state)."""
@@ -502,7 +511,9 @@ class DataParallelTrainer:
             )
             return sm(params, opt_state, *[reduced[n] for n in layers])
 
-        return jax.jit(update)
+        return jax.jit(
+            update, donate_argnums=(0, 1) if self.donate_params else ()
+        )
 
     def _build_du_inc_fn(self):
         """distributed-update: owned-shard gradient -> owned-shard increment."""
@@ -554,7 +565,9 @@ class DataParallelTrainer:
             )
             return sm(params, *[incs[n] for n in layers])
 
-        return jax.jit(apply)
+        return jax.jit(
+            apply, donate_argnums=(0,) if self.donate_params else ()
+        )
 
     def _build_layer_update_fn(self, name: str):
         data_size, lr = self.data_size, self.lr

@@ -435,7 +435,6 @@ std::mutex g_retired_mu;
 
 struct DistImpl {
   uint64_t h = 0;
-  size_t data_parts = 0, model_parts = 0;
   /* generic-collective channels, keyed by per-rank call sequence (congruent
    * program order makes the k-th call on every rank the same collective) */
   std::map<long, Channel*> gen;
@@ -675,8 +674,6 @@ Distribution* Environment::CreateDistribution(size_t dataPartitions,
     d->h = mlsl_environment_create_distribution((int64_t)dataPartitions,
                                                 (int64_t)modelPartitions, 1);
     if (d->h == 0) die("CreateDistribution failed");
-    d->data_parts = dataPartitions;
-    d->model_parts = modelPartitions;
     return (uint64_t)(uintptr_t)d;
   });
   return (Distribution*)(uintptr_t)r;
@@ -704,8 +701,6 @@ Distribution* Environment::CreateDistributionWithColors(int dataColor,
     d->h = mlsl_environment_create_distribution_with_colors(
         g_dist_dcolors.data(), g_dist_mcolors.data(), (int64_t)g_world);
     if (d->h == 0) die("CreateDistributionWithColors failed");
-    d->data_parts = 0;  // color-defined: no rectangular factorization
-    d->model_parts = 0;
     g_dist_dcolors.clear();  // next call gathers afresh
     g_dist_mcolors.clear();
     return (uint64_t)(uintptr_t)d;
