@@ -311,14 +311,16 @@ class DataParallelTrainer:
         use_fused = not needs_comm and not force_graph_path
         self.donate_params = bool(donate_params)
         sharding = NamedSharding(self.mesh, P())
-        if not donate_params or overlap_updates:
-            # overlap_updates never donates (per-layer subtree updates), so the
-            # owning copy would buy nothing
+        # Donation happens on the fused and barrier-update paths; the
+        # overlap_updates per-layer path never donates (but the fused shortcut
+        # can still engage under overlap_updates on a no-comm grid). Make the
+        # owning copy exactly when some donating program will consume
+        # self.params — device_put alone can alias the caller's on-device
+        # arrays, and donating an aliased buffer deletes the caller's tree.
+        will_donate = donate_params and (use_fused or not overlap_updates)
+        if not will_donate:
             self.params = jax.device_put(params, sharding)
         else:
-            # Owning copy: donating steps (fused AND per-layer update/apply)
-            # consume self.params, so the trainer must not alias the caller's
-            # arrays (device_put alone can alias on-device inputs).
             self.params = jax.tree.map(
                 lambda x: jax.device_put(jnp.array(x, copy=True), sharding), params
             )
@@ -349,9 +351,7 @@ class DataParallelTrainer:
         self._du_inc_fn = self._build_du_inc_fn() if distributed_update else None
         self._du_apply_fn = self._build_du_apply_fn() if distributed_update else None
         self.distributed_update = distributed_update
-        self._fused_fn = (
-            self._build_fused_fn(donate=donate_params) if use_fused else None
-        )
+        self._fused_fn = self._build_fused_fn() if use_fused else None
         # Test-driven overlap (the reference's canonical loop polls
         # TestGradientComm and updates each layer as its collective lands,
         # tests/examples/mlsl_test/mlsl_test.cpp:660-698): per-layer jitted
@@ -588,7 +588,7 @@ class DataParallelTrainer:
 
         return jax.jit(update_layer)
 
-    def _build_fused_fn(self, donate: bool = True):
+    def _build_fused_fn(self):
         loss_fn, lr = self.loss_fn, self.lr
         optimizer = self._optax_opt
         clip = self.clip_global_norm
@@ -606,7 +606,7 @@ class DataParallelTrainer:
         # self.params and always replaces it) — halves parameter HBM traffic in the
         # optimizer tail, something a caller-owned raw-JAX step cannot safely do.
         if optimizer is None:
-            @functools.partial(jax.jit, donate_argnums=(0,) if donate else ())
+            @functools.partial(jax.jit, donate_argnums=(0,) if self.donate_params else ())
             def fused(params, batch):
                 x, y = batch
                 x = x.reshape(x.shape[NUM_GRID_AXES:])
@@ -619,7 +619,7 @@ class DataParallelTrainer:
 
         import optax
 
-        @functools.partial(jax.jit, donate_argnums=(0, 1) if donate else ())
+        @functools.partial(jax.jit, donate_argnums=(0, 1) if self.donate_params else ())
         def fused_opt(params, opt_state, batch):
             x, y = batch
             x = x.reshape(x.shape[NUM_GRID_AXES:])

@@ -266,8 +266,9 @@ class HybridTrainer:
         shard-local transforms only (adam, momentum, ...); params-consuming
         transforms see the flat local param vector on the plain path.
 
-        donate_params: the fused no-comm step donates the parameter (and
-        optimizer-state) buffers to XLA so the update is in-place in HBM —
+        donate_params: EVERY update path (fused no-comm, graph barrier
+        update, optax update, ZeRO-1 increment apply) donates the parameter
+        and optimizer-state buffers to XLA so the update is in-place in HBM —
         after step() returns, any EXTERNAL reference to the previous
         ``trainer.params`` tree points at deleted buffers (reading it raises).
         Pass donate_params=False to keep old param trees readable (e.g. EMA
@@ -527,7 +528,10 @@ class HybridTrainer:
             )
             return sm(params, *[reduced[n] for n in layers])
 
-        return jax.jit(update)
+        # donated params: in-place HBM update (same contract as the fused path)
+        return jax.jit(
+            update, donate_argnums=(0,) if self.donate_params else ()
+        )
 
     def _build_fused_fn(self):
         """One donated jit: loss + grads (+ in-body TP psum for replicated
@@ -651,7 +655,9 @@ class HybridTrainer:
             )
             return sm(params, states, *[reduced[n] for n in layers])
 
-        return jax.jit(update)
+        return jax.jit(
+            update, donate_argnums=(0, 1) if self.donate_params else ()
+        )
 
     def _build_du_inc_fn(self):
         """distributed update: owned-shard gradient -> owned-shard increment."""
@@ -685,7 +691,9 @@ class HybridTrainer:
             out_specs=self.specs,
             check=False,
         )
-        jitted = jax.jit(sm)
+        jitted = jax.jit(
+            sm, donate_argnums=(0,) if self.donate_params else ()
+        )
 
         def apply(params, incs):
             return jitted(params, *[incs[n] for n in layers])
