@@ -242,6 +242,10 @@ int64_t mlsl_statistics_get_total_comm_size(mlsl_handle_t stats);
 int64_t mlsl_statistics_get_total_comm_cycles(mlsl_handle_t stats);
 int64_t mlsl_statistics_get_total_compute_cycles(mlsl_handle_t stats);
 int64_t mlsl_statistics_get_total_isolation_comm_cycles(mlsl_handle_t stats);
+/* Fraction (x1000) of pure-comm time hidden behind compute; -1 until
+ * isolation stats and accounted steps exist. op_idx < 0 = session total. */
+int64_t mlsl_statistics_get_overlap_permille(mlsl_handle_t stats,
+                                             int64_t op_idx);
 int mlsl_statistics_print(mlsl_handle_t stats);
 
 int mlsl_handle_release(mlsl_handle_t h);

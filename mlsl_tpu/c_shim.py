@@ -464,9 +464,15 @@ def stats_control(stats_h: int, what: int) -> int:
 
 def stats_query(stats_h: int, what: int, op_idx: int) -> int:
     """what: 0=comm_size 1=comm_cycles 2=compute_cycles 3=isolation_comm_cycles
-    (per-op with op_idx >= 0, totals with op_idx < 0). Cycles are nanoseconds
-    (the TPU analog of the reference's rdtsc cycles)."""
+    4=overlap_permille (hidden/isolation x 1000; -1 until isolation stats and
+    accounted steps exist). Per-op with op_idx >= 0, totals with op_idx < 0.
+    Cycles are nanoseconds (the TPU analog of the reference's rdtsc cycles)."""
     st = _get(stats_h)
+    if what == 4:
+        # index-keyed (robust to duplicate op names); out-of-range op_idx has
+        # no slots and yields the no-data sentinel like the sibling queries
+        f = st.get_overlap_fraction(None if op_idx < 0 else int(op_idx))
+        return -1 if f is None else int(round(f * 1000))
     if op_idx < 0:
         return (st.get_total_comm_size(), st.get_total_comm_cycles(),
                 st.get_total_compute_cycles(),

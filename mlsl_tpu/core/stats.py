@@ -188,10 +188,21 @@ class Statistics:
         }
         return {"ops": ops, "total": total}
 
-    def get_overlap_fraction(self) -> Optional[float]:
-        """Session-total fraction of pure-comm time hidden behind compute
-        (None until isolation stats and at least one accounted step exist)."""
-        return self.overlap_report()["total"]["overlap_fraction"]
+    def get_overlap_fraction(self, op_idx: Optional[int] = None) -> Optional[float]:
+        """Fraction of pure-comm time hidden behind compute — session total, or
+        one operation's with ``op_idx`` (keyed by index, robust to duplicate op
+        names). None until isolation stats and an accounted step exist, or for
+        an op with no replayed comm."""
+        iso = exposed = 0
+        for (oi, key), iso_per_iter in self._isolation_slot_ns.items():
+            if op_idx is not None and oi != op_idx:
+                continue
+            slot = self._slots.get((oi, key))
+            if slot is None or slot.starts == 0 or iso_per_iter <= 0:
+                continue
+            iso += iso_per_iter * slot.starts
+            exposed += slot.comm_ns
+        return None if iso == 0 else max(0, iso - exposed) / iso
 
     # -- queries (reference include/mlsl.hpp:680-725) ----------------------
 

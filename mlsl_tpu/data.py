@@ -91,6 +91,21 @@ class AsyncLoader:
 _SENTINEL = object()
 
 
+def file_source(paths, epochs: Optional[int] = 1):
+    """Stream (x, y) batches from ``.npz`` files (keys 'x' and 'y') — the
+    analog of the reference's endpoint-server file reads (EPLIB_fopen/fread_nb,
+    eplib/eplib.h:51-58): the AsyncLoader's worker thread performs the disk
+    read AND the host->device transfer while the trainer computes, so the
+    training loop never blocks on IO. ``epochs=None`` cycles forever."""
+    paths = list(paths)  # a one-shot iterable must survive multiple epochs
+    e = 0
+    while epochs is None or e < epochs:
+        for p in paths:
+            with np.load(p) as z:
+                yield z["x"], z["y"]
+        e += 1
+
+
 def synthetic_source(batch: int, shape, num_classes: int, seed: int = 0, steps: Optional[int] = None):
     """Deterministic synthetic (x, y) batches (the reference tests likewise use
     generated algebraic data rather than real datasets)."""

@@ -567,6 +567,11 @@ int64_t mlsl_statistics_get_total_isolation_comm_cycles(mlsl_handle_t stats) {
   return call_i("stats_query", {(int64_t)stats, 3, -1});
 }
 
+int64_t mlsl_statistics_get_overlap_permille(mlsl_handle_t stats,
+                                              int64_t op_idx) {
+  return call_i("stats_query", {(int64_t)stats, 4, op_idx}, -1);
+}
+
 int mlsl_statistics_print(mlsl_handle_t stats) {
   return (int)call_i("stats_print", {(int64_t)stats});
 }

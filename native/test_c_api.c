@@ -293,6 +293,10 @@ int main(void) {
                 mlsl_statistics_get_comm_size(st, 1) ==
             mlsl_statistics_get_total_comm_size(st), "stats per-op sum");
       CHECK(mlsl_statistics_print(st) == 0, "stats print");
+      {
+        long long ov = (long long)mlsl_statistics_get_overlap_permille(st, -1);
+        CHECK(ov >= -1 && ov <= 1000, "overlap permille range");
+      }
       printf("statistics queries OK (bytes=%lld)\n",
              (long long)mlsl_statistics_get_total_comm_size(st));
     }

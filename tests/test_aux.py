@@ -100,6 +100,57 @@ class TestAsyncLoader:
         assert len(losses) == 5 and np.isfinite(losses).all()
         loader.close()
 
+    def test_file_source_trains_from_disk(self, env, tmp_path):
+        """file_source streams .npz batches through the background loader (the
+        reference's endpoint-server file-IO offload, eplib/eplib.h:51-58) and
+        lands on the same trajectory as feeding the arrays directly."""
+        from mlsl_tpu.data import AsyncLoader, file_source
+        from mlsl_tpu.models.mlp import LAYERS, get_layer, init, loss_fn
+        from mlsl_tpu.models.train import DataParallelTrainer
+
+        rng = np.random.default_rng(0)
+        paths, arrays = [], []
+        for i in range(3):
+            x = rng.normal(size=(16, 8)).astype(np.float32)
+            y = rng.integers(0, 4, size=(16,)).astype(np.int32)
+            p = tmp_path / f"batch{i}.npz"
+            np.savez(p, x=x, y=y)
+            paths.append(str(p))
+            arrays.append((x, y))
+
+        def run_files():
+            dist = env.create_distribution(8, 1)
+            sess = env.create_session()
+            sess.set_global_minibatch_size(16)
+            tr = DataParallelTrainer(
+                env, dist, sess, init(jax.random.PRNGKey(0)), loss_fn, LAYERS,
+                get_layer,
+            )
+            loader = AsyncLoader(file_source(paths, epochs=2), tr.shard_batch,
+                                 depth=2)
+            n = sum(1 for b in loader if np.isfinite(float(
+                np.asarray(tr.step(b)).reshape(-1)[0])))
+            loader.close()
+            assert n == 6  # 3 files x 2 epochs
+            return jax.device_get(tr.params)
+
+        def run_arrays():
+            dist = env.create_distribution(8, 1)
+            sess = env.create_session()
+            sess.set_global_minibatch_size(16)
+            tr = DataParallelTrainer(
+                env, dist, sess, init(jax.random.PRNGKey(0)), loss_fn, LAYERS,
+                get_layer,
+            )
+            for _ in range(2):
+                for x, y in arrays:
+                    tr.step(tr.shard_batch(x, y))
+            return jax.device_get(tr.params)
+
+        for a, b in zip(jax.tree.leaves(run_files()),
+                        jax.tree.leaves(run_arrays())):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-6)
+
     def test_worker_exception_surfaces(self, env):
         from mlsl_tpu.data import AsyncLoader
 
