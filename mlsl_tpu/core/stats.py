@@ -163,12 +163,7 @@ class Statistics:
         or callable explicitly) plus at least one accounted step."""
         ops: Dict[str, dict] = {}
         tot_iso = tot_exposed = 0
-        for (op_idx, key), iso_per_iter in self._isolation_slot_ns.items():
-            slot = self._slots.get((op_idx, key))
-            if slot is None or slot.starts == 0 or iso_per_iter <= 0:
-                continue
-            iso = iso_per_iter * slot.starts
-            exposed = slot.comm_ns
+        for op_idx, iso, exposed in self._overlap_slots():
             name = self.session.operations[op_idx].name
             ent = ops.setdefault(name, {"iso_ns": 0, "exposed_ns": 0})
             ent["iso_ns"] += iso
@@ -188,20 +183,27 @@ class Statistics:
         }
         return {"ops": ops, "total": total}
 
+    def _overlap_slots(self):
+        """(op_idx, true_comm_ns, exposed_ns) per qualifying slot — the ONE
+        copy of the overlap accounting rules, shared by overlap_report and
+        get_overlap_fraction so the printed table and the C API agree."""
+        for (oi, key), iso_per_iter in self._isolation_slot_ns.items():
+            slot = self._slots.get((oi, key))
+            if slot is None or slot.starts == 0 or iso_per_iter <= 0:
+                continue
+            yield oi, iso_per_iter * slot.starts, slot.comm_ns
+
     def get_overlap_fraction(self, op_idx: Optional[int] = None) -> Optional[float]:
         """Fraction of pure-comm time hidden behind compute — session total, or
         one operation's with ``op_idx`` (keyed by index, robust to duplicate op
         names). None until isolation stats and an accounted step exist, or for
         an op with no replayed comm."""
         iso = exposed = 0
-        for (oi, key), iso_per_iter in self._isolation_slot_ns.items():
+        for oi, slot_iso, slot_exposed in self._overlap_slots():
             if op_idx is not None and oi != op_idx:
                 continue
-            slot = self._slots.get((oi, key))
-            if slot is None or slot.starts == 0 or iso_per_iter <= 0:
-                continue
-            iso += iso_per_iter * slot.starts
-            exposed += slot.comm_ns
+            iso += slot_iso
+            exposed += slot_exposed
         return None if iso == 0 else max(0, iso - exposed) / iso
 
     # -- queries (reference include/mlsl.hpp:680-725) ----------------------
