@@ -36,6 +36,39 @@ GRID_AXES = (REPLICA_AXIS, DATA_AXIS, SEQ_AXIS, MODEL_AXIS)
 NUM_GRID_AXES = len(GRID_AXES)
 
 
+def dcn_aware_devices(
+    model_parts: int,
+    seq_parts: int = 1,
+    devices: Optional[Sequence[jax.Device]] = None,
+) -> Tuple[jax.Device, ...]:
+    """Order devices so the minor grid axes (model, then seq) stay WITHIN a
+    host while data/replica span hosts — bandwidth-hungry TP/SP collectives
+    ride ICI, and only the once-per-step gradient reduction crosses the DCN
+    (the standard multi-pod layout; pass the result as ``devices=`` to
+    create_distribution).
+
+    Rank layout is model-minor (see module docstring), so "model groups within
+    a host" means each host's devices must cover whole model x seq blocks:
+    model_parts * seq_parts must divide every host's local device count.
+    """
+    devices = tuple(jax.devices() if devices is None else devices)
+    by_proc: dict = {}
+    for d in devices:
+        by_proc.setdefault(d.process_index, []).append(d)
+    block = model_parts * seq_parts
+    for proc, ds in by_proc.items():
+        mlsl_assert(
+            len(ds) % block == 0,
+            "host %d has %d devices; model_parts*seq_parts=%d must divide the "
+            "per-host device count for model/seq groups to stay on ICI",
+            proc, len(ds), block,
+        )
+    ordered = []
+    for proc in sorted(by_proc):
+        ordered.extend(sorted(by_proc[proc], key=lambda d: d.id))
+    return tuple(ordered)
+
+
 class Topology:
     """The device world arranged as a (replica, data, seq, model) mesh.
 

@@ -52,6 +52,22 @@ for shard in gout.addressable_shards:
     assert got.shape[0] == 16
 dist.barrier(GroupType.GLOBAL)
 
+# DCN-aware layout: model groups must be host-local (TP rides ICI; only the
+# data-axis gradient reduction crosses the process boundary / DCN analog)
+from mlsl_tpu.comm.mesh import dcn_aware_devices
+
+ddevs = dcn_aware_devices(4)
+dcn = env.create_distribution(2, 4, devices=ddevs)
+for p in range(8):
+    members = [q for q in range(8)
+               if dcn.topology.coords(q)[:3] == dcn.topology.coords(p)[:3]]
+    procs = {ddevs[q].process_index for q in members}
+    assert len(procs) == 1, (p, procs)  # each model group on ONE host
+dbuf = dcn.make_buffer(lambda p: np.full(4, float(p + 1), np.float32), 4)
+dout = env.wait(dcn.all_reduce(dbuf, 4, DataType.FLOAT, ReductionType.SUM,
+                               GroupType.DATA))
+jax.block_until_ready(dout)
+
 # per-layer MLSL train step spanning both processes
 from mlsl_tpu.models.train import DataParallelTrainer
 from mlsl_tpu.models.mlp import LAYERS, get_layer, init as mlp_init, loss_fn
