@@ -39,3 +39,27 @@ def test_train_zero1_adam_example_runs():
     assert r.returncode == 0, r.stderr[-2000:]
     assert "OK" in r.stdout
     assert "resumed from step 2" in r.stdout
+
+
+def test_compat_cpp_example_builds_and_runs():
+    """The drop-in C++ example (examples/compat_example.cpp) must compile
+    against include/mlsl.hpp and run on the 8-device mesh."""
+    import shutil
+
+    if shutil.which("g++") is None:
+        pytest.skip("no C++ toolchain")
+    native = os.path.join(REPO, "native")
+    build = subprocess.run(
+        ["make", "-s", "compat_example"], cwd=native, capture_output=True,
+        text=True, timeout=300,
+    )
+    assert build.returncode == 0, build.stderr
+    exe = os.path.join(native, "compat_example")
+    env = dict(os.environ)
+    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=8"
+    env["MLSL_TPU_PLATFORM"] = "cpu"
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    r = subprocess.run([exe], capture_output=True, text=True, timeout=420,
+                       env=env, cwd=REPO)
+    assert r.returncode == 0, f"stdout:\n{r.stdout}\nstderr:\n{r.stderr[-2000:]}"
+    assert "compat example OK" in r.stdout
