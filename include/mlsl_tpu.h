@@ -55,7 +55,7 @@ mlsl_handle_t mlsl_environment_create_distribution_with_colors(
     const int64_t* data_colors, const int64_t* model_colors, int64_t n);
 /* Register codec params (reference SetQuantizationParams). lib_path (may be
  * NULL) selects a dlopen'd codec honoring the reference's symbol contract;
- * load failures return MLSL_TPU_FAILURE (see mlsl_last_error()). */
+ * load failures return MLSL_TPU_FAILURE (see mlsl_get_last_error()). */
 int mlsl_environment_set_quantization_params(
     const char* lib_path, const char* quant_name, const char* dequant_name,
     const char* reduce_name, int64_t block_size, int64_t elem_in_block);
