@@ -36,36 +36,21 @@ def main():
     # on reaping it — poll with a deadline and walk away. A transient tunnel
     # outage shouldn't zero the whole round, so retry with backoff before
     # giving up.
-    import subprocess
-
-    from benchmarks._common import PROBE_SRC  # d2h-readback probe (not
+    from benchmarks._common import probe_device_kind  # d2h-readback probe (not
     # block_until_ready, which can acknowledge at dispatch through the tunnel)
 
     attempts = int(os.environ.get("MLSL_BENCH_PROBE_ATTEMPTS", "4"))
     probe_timeout = float(os.environ.get("MLSL_BENCH_PROBE_TIMEOUT", "180"))
     last_err = ""
     for attempt in range(attempts):
-        child = subprocess.Popen(
-            [sys.executable, "-c", PROBE_SRC],
-            stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
-            start_new_session=True,
-            cwd=os.path.dirname(os.path.abspath(__file__)),
-        )
-        try:
-            # communicate() drains pipes while waiting so a chatty runtime
-            # can't wedge an alive probe into a false timeout
-            _, err_out = child.communicate(timeout=probe_timeout)
-        except subprocess.TimeoutExpired:
-            child.kill()  # best effort; do NOT wait() — a D-state child never reaps
-            last_err = f"probe timed out after {probe_timeout:.0f}s"
-        else:
-            if child.returncode != 0:
-                last_err = f"probe exited {child.returncode}:\n{err_out[-500:]}"
-            else:
-                break
+        kind, err_out = probe_device_kind(probe_timeout)
+        if kind is not None:
+            break
+        last_err = err_out
         if attempt + 1 < attempts:
             backoff = 30 * (2 ** attempt)
-            print(f"bench: backend unreachable ({last_err.splitlines()[0]}); "
+            first = (last_err.splitlines() or ["unknown"])[0]
+            print(f"bench: backend unreachable ({first}); "
                   f"retry {attempt + 2}/{attempts} in {backoff}s", file=sys.stderr)
             time.sleep(backoff)
     else:

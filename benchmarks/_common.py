@@ -30,7 +30,10 @@ PROBE_SRC = (
 )
 
 
-def probe_accelerator(tag: str, timeout: float = 180.0) -> None:
+def probe_device_kind(timeout: float = 90.0):
+    """Run PROBE_SRC in a subprocess -> (device_kind or None, error_tail).
+    The ONE copy of the probe-subprocess dance (capture.py, bench.py,
+    probe_accelerator, and the on-chip benches all use it)."""
     child = subprocess.Popen(
         [sys.executable, "-c", PROBE_SRC], stdout=subprocess.PIPE,
         stderr=subprocess.PIPE, text=True, start_new_session=True,
@@ -39,13 +42,22 @@ def probe_accelerator(tag: str, timeout: float = 180.0) -> None:
     try:
         # communicate() drains pipes while waiting (a chatty runtime must not
         # wedge an alive probe into a false timeout)
-        _, err = child.communicate(timeout=timeout)
+        out, err = child.communicate(timeout=timeout)
     except subprocess.TimeoutExpired:
         child.kill()  # best effort; a D-state child never reaps, so don't wait()
-        print(f"{tag}: accelerator unreachable", file=sys.stderr)
-        sys.exit(3)
+        return None, "probe timed out"
     if child.returncode != 0:
-        print(f"{tag}: probe failed:\n{err[-500:]}", file=sys.stderr)
+        return None, err[-500:]
+    for line in out.splitlines():
+        if line.startswith("KIND="):
+            return line[5:], ""
+    return None, "probe printed no KIND"
+
+
+def probe_accelerator(tag: str, timeout: float = 180.0) -> None:
+    kind, err = probe_device_kind(timeout)
+    if kind is None:
+        print(f"{tag}: accelerator unreachable:\n{err}", file=sys.stderr)
         sys.exit(3)
 
 

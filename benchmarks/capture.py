@@ -27,23 +27,8 @@ REPO = os.path.join(os.path.dirname(os.path.abspath(__file__)), "..")
 
 def probe(timeout: float = 90.0):
     """Returns device_kind string if the tunnel answers, else None."""
-    child = subprocess.Popen(
-        [sys.executable, "-c", PROBE_SRC], stdout=subprocess.PIPE,
-        stderr=subprocess.PIPE, text=True, start_new_session=True, cwd=REPO,
-    )
-    try:
-        # communicate() drains the pipes while waiting, so a chatty runtime
-        # can't fill the pipe and wedge an alive probe into a false negative
-        out, _ = child.communicate(timeout=timeout)
-    except subprocess.TimeoutExpired:
-        child.kill()  # best effort; a D-state child never reaps — walk away
-        return None
-    if child.returncode != 0:
-        return None
-    for line in out.splitlines():
-        if line.startswith("KIND="):
-            return line[5:]
-    return None
+    kind, _ = probe_device_kind(timeout)
+    return kind
 
 
 def run_step(name, cmd, timeout, env=None):
@@ -76,7 +61,7 @@ def run_step(name, cmd, timeout, env=None):
 
 sys.path.insert(0, REPO)
 from benchmarks._common import (  # noqa: E402
-    PROBE_SRC, append_measurement, git_sha, measured_path,
+    append_measurement, git_sha, measured_path, probe_device_kind,
 )
 
 

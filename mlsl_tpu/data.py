@@ -10,7 +10,6 @@ training loop never blocks on input.
 
 from __future__ import annotations
 
-import collections
 import queue
 import threading
 from typing import Callable, Iterator, Optional
