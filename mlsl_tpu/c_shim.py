@@ -89,7 +89,7 @@ def env_set_quantization_params(
     """Register codec parameters (reference src/mlsl.cpp:798). A lib_path is
     honored via the dlopen/ctypes trampoline (comm/codec.py); load failures
     raise and surface as MLSL_TPU_FAILURE with the message in
-    mlsl_last_error()."""
+    mlsl_get_last_error()."""
     from mlsl_tpu.types import QuantParams
 
     Environment.get_env().set_quantization_params(QuantParams(

@@ -9,11 +9,16 @@ import pytest
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
-def _run_example(name, timeout=420):
+def _mesh_env():
     env = dict(os.environ)
     env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=8"
     env["MLSL_TPU_PLATFORM"] = "cpu"
     env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    return env
+
+
+def _run_example(name, timeout=420):
+    env = _mesh_env()
     return subprocess.run(
         [sys.executable, os.path.join(REPO, "examples", name)],
         capture_output=True, text=True, timeout=timeout, env=env, cwd=REPO,
@@ -55,11 +60,7 @@ def test_compat_cpp_example_builds_and_runs():
     )
     assert build.returncode == 0, build.stderr
     exe = os.path.join(native, "compat_example")
-    env = dict(os.environ)
-    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=8"
-    env["MLSL_TPU_PLATFORM"] = "cpu"
-    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
     r = subprocess.run([exe], capture_output=True, text=True, timeout=420,
-                       env=env, cwd=REPO)
+                       env=_mesh_env(), cwd=REPO)
     assert r.returncode == 0, f"stdout:\n{r.stdout}\nstderr:\n{r.stderr[-2000:]}"
     assert "compat example OK" in r.stdout
