@@ -111,6 +111,68 @@ def load_library_codec(params) -> CustomCodec:
     def _nblocks(n: int) -> int:
         return -(-n // elem)
 
+    # The staging buffers are sized from the DECLARED geometry (block_size
+    # bytes written per elem_in_block-element block). The codec is opaque, so
+    # a mismatched declaration would otherwise corrupt the heap silently.
+    # Two defenses: a one-shot calibration probe at load time measures the
+    # codec's actual output footprint on a single block against the declared
+    # block_size (registration fails loudly on mismatch), and a sentinel guard
+    # tail on every staging buffer catches small count-dependent spills.
+    _GUARD = 64
+
+    def _probe_geometry() -> None:
+        # Slack is INPUT-derived (8 B/element covers a pathological 2x
+        # expansion over raw f32), never declared-output-derived: an
+        # optimistic declared block_size must not under-size the probe buffer
+        # the probe exists to protect.
+        slack = elem * 8 + bsz + 4096
+        buf = np.linspace(-1.0, 1.0, elem, dtype=np.float32)
+        diff = np.zeros(elem, np.float32)
+        out = np.full(slack, 0xA5, np.uint8)
+        rc = quant_c(
+            buf.ctypes.data, out.ctypes.data, buf.size, diff.ctypes.data,
+            _DL_COMP_FLOAT32, _COMP_RATIO, _DL_COMP_DFP,
+        )
+        if rc != 0:
+            raise MLSLError(
+                f"quantization library probe failed: error code {rc}"
+            )
+        touched = np.nonzero(out != 0xA5)[0]
+        written = int(touched[-1]) + 1 if touched.size else 0
+        if written > bsz:
+            raise MLSLError(
+                f"quantization library geometry mismatch: declared "
+                f"block_size={bsz} bytes per {elem}-element block, but "
+                f"{names[0]} wrote ~{written} bytes for one block — fix "
+                f"QuantParams.block_size/elem_in_block to match the codec"
+            )
+        dout = np.full(elem * 4 + slack, 0xA5, np.uint8)
+        rc = dequant_c(out.ctypes.data, dout.ctypes.data, elem)
+        if rc != 0:
+            raise MLSLError(
+                f"dequantization library probe failed: error code {rc}"
+            )
+        dtouched = np.nonzero(dout != 0xA5)[0]
+        dwritten = int(dtouched[-1]) + 1 if dtouched.size else 0
+        if dwritten > elem * 4:
+            raise MLSLError(
+                f"quantization library geometry mismatch: {names[1]} wrote "
+                f"~{dwritten} bytes decompressing one {elem}-element block "
+                f"(expected at most {elem * 4})"
+            )
+
+    _probe_geometry()
+
+    def _check_guard(arr: np.ndarray, payload_bytes: int, what: str) -> None:
+        tail = arr.view(np.uint8)[payload_bytes:]
+        if tail.size and not (tail == 0xA5).all():
+            raise MLSLError(
+                f"{what} wrote past the declared block geometry "
+                f"(block_size={bsz}, elem_in_block={elem}): the codec must "
+                f"write exactly block_size bytes per block of elem_in_block "
+                f"elements"
+            )
+
     def _host_compress(x: np.ndarray) -> np.ndarray:
         x = np.ascontiguousarray(x, dtype=np.float32)
         n = x.size
@@ -120,23 +182,27 @@ def load_library_codec(params) -> CustomCodec:
         # Feedback is framework-owned (applied to the input before this call),
         # so the codec's own diff buffer is zeroed per call.
         diff = np.zeros(nb * elem, np.float32)
-        out = np.zeros(nb * bsz, np.uint8)
+        out = np.full(nb * bsz + _GUARD, 0xA5, np.uint8)
+        out[: nb * bsz] = 0
         rc = quant_c(
             buf.ctypes.data, out.ctypes.data, buf.size, diff.ctypes.data,
             _DL_COMP_FLOAT32, _COMP_RATIO, _DL_COMP_DFP,
         )
         if rc != 0:
             raise MLSLError(f"quantization failed: error code {rc}")
-        return out
+        _check_guard(out, nb * bsz, f"compress ({names[0]})")
+        return out[: nb * bsz]
 
     def _host_decompress(p: np.ndarray, n: int) -> np.ndarray:
         nb = _nblocks(n)
-        out = np.zeros(nb * elem, np.float32)
+        out = np.zeros(nb * elem + _GUARD // 4, np.float32)
+        out.view(np.uint8)[nb * elem * 4:] = 0xA5
         rc = dequant_c(
-            np.ascontiguousarray(p).ctypes.data, out.ctypes.data, out.size
+            np.ascontiguousarray(p).ctypes.data, out.ctypes.data, nb * elem
         )
         if rc != 0:
             raise MLSLError(f"dequantization failed: error code {rc}")
+        _check_guard(out, nb * elem * 4, f"decompress ({names[1]})")
         return out[:n]
 
     def _host_reduce(a: np.ndarray, b: np.ndarray) -> np.ndarray:

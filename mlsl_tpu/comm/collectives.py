@@ -444,6 +444,17 @@ def _make_ragged_body(kind: str, group: ProcessGroup, *, op=None, root=None,
         mlsl_assert(send_count is not None, "alltoall needs send_count")
 
     def body(x):
+        if kind in ("scatter", "reduce_scatter"):
+            # Padded-buffer contract: every rank's buffer spans Gmax blocks.
+            # XLA clamps out-of-range dynamic_slice starts, which would hand
+            # large-group members a silent duplicate of the last in-range
+            # chunk — reject loudly at trace time instead.
+            mlsl_assert(
+                x.size >= gmax * recv_count,
+                "%s on unequal color groups needs a buffer spanning the "
+                "largest group: count %d < Gmax (%d) * recv_count (%d)",
+                kind, int(x.size), gmax, int(recv_count),
+            )
         full = _gather_group(x, ALL_AXES)                       # (W, n)
         me = _group_rank(ALL_AXES, sizes)                       # world rank
         members = jnp.take(jnp.asarray(member_np), me, axis=0)  # (Gmax,)

@@ -88,8 +88,18 @@ class Environment:
         self._initialized = True
         self._init_pid = os.getpid()
         if self.quant_params is not None:
-            # a pre-init SetQuantizationParams is applied now that config exists
-            self.set_quantization_params(self.quant_params)
+            try:
+                # a pre-init SetQuantizationParams is applied now that config
+                # exists; if the deferred codec can no longer load, unwind so a
+                # retried init() re-attempts it instead of silently proceeding
+                # with the built-in codec
+                self.set_quantization_params(self.quant_params)
+            except Exception:
+                self._initialized = False
+                self._init_pid = None
+                self.dispatcher.shutdown()
+                self.dispatcher = None
+                raise
         self._dump_config()
         return self
 

@@ -38,6 +38,7 @@
 #include <chrono>
 #include <condition_variable>
 #include <cstdint>
+#include <cstdio>
 #include <cstdlib>
 #include <cstring>
 #include <deque>
@@ -117,6 +118,11 @@ uint64_t shared_call(const std::function<uint64_t()>& fn) {
         s.cv.wait(lk);
         continue;
       }
+      // A slow fn cannot false-abort here: the last arriver executes fn while
+      // holding s.mu, so an expired waiter stays blocked on mutex
+      // reacquisition until fn returns — at which point s.done is true and
+      // the loop exits. A timeout observed with the lock held therefore
+      // means ranks genuinely diverged (arrived < world).
       if (s.cv.wait_until(lk, deadline) == std::cv_status::timeout && !s.done)
         die("rendezvous watchdog: rank " + std::to_string(tl_rank) +
             " stuck in construction-phase call #" + std::to_string(idx) +
@@ -235,17 +241,37 @@ void reclaim_one_shot(Channel& ch);  // defined after DistImpl
 /* cv.wait with the watchdog: caller holds lk; pred checked under the lock. */
 template <typename Pred>
 void watched_wait(Channel& ch, std::unique_lock<std::mutex>& lk,
-                  const char* where, long round, Pred pred) {
+                  const char* where, long round, Pred pred,
+                  const std::function<bool()>& progress = nullptr) {
   const long limit = watchdog_secs();
   auto deadline = std::chrono::steady_clock::now() +
                   std::chrono::seconds(limit > 0 ? limit : 0);
+  // A collective actively executing (progress() true) is slow, not divergent
+  // — an MPI program would block there too — so the deadline re-arms. But the
+  // re-arm is BOUNDED: a wait_fn that never returns (dead peer, hung
+  // transport) must still abort with diagnostics rather than hang the job
+  // silently forever.
+  const int max_rearms = 10;
+  int rearms = 0;
   while (!pred()) {
     if (limit <= 0) {
       ch.cv.wait(lk);
       continue;
     }
-    if (ch.cv.wait_until(lk, deadline) == std::cv_status::timeout && !pred())
+    if (ch.cv.wait_until(lk, deadline) == std::cv_status::timeout && !pred()) {
+      if (progress && progress() && rearms < max_rearms) {
+        rearms++;
+        std::fprintf(stderr,
+                     "mlsl compat: rank %d: %s round %ld still executing "
+                     "after %lds; watchdog re-armed (%d/%d)\n",
+                     tl_rank, where, round, (long)rearms * limit, rearms,
+                     max_rearms);
+        deadline = std::chrono::steady_clock::now() +
+                   std::chrono::seconds(limit);
+        continue;
+      }
       watchdog_abort(ch, where, round);
+    }
   }
 }
 
@@ -321,11 +347,13 @@ void* channel_wait(Channel& ch) {
       ch.waiting = false;
       ch.cv.notify_all();
     } else {
-      // another rank's thread is executing the global wait; the watchdog
-      // still applies — if THAT thread is itself stuck in a rendezvous the
-      // completion never comes
+      // another rank's thread is executing the global wait. While it is
+      // actively inside wait_fn that is progress (a slow collective), so the
+      // watchdog re-arms; if that thread is itself stuck in a rendezvous its
+      // own watchdog catches the divergence.
       watched_wait(ch, lk, "Wait (waiting for round completion)", round,
-                   [&] { return ch.completed_rounds > round || !ch.waiting; });
+                   [&] { return ch.completed_rounds > round || !ch.waiting; },
+                   [&] { return ch.waiting; });
     }
   }
   int64_t n = ch.recv_n[round & 1];

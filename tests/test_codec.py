@@ -227,6 +227,63 @@ def test_lib_path_missing_symbol_fails_loudly(env, tmp_path):
         ))
 
 
+def test_library_codec_geometry_mismatch_fails_loudly(env, tmp_path):
+    """A declared block geometry the codec doesn't honor must fail at
+    registration (load-time calibration probe), not corrupt the heap during a
+    collective: the sample codec writes 2 B/element, so declaring
+    elem_in_block=256 with block_size=256 under-sizes every staging block."""
+    so = _build_sample_codec(tmp_path)
+    with pytest.raises(MLSLError, match="geometry mismatch"):
+        env.set_quantization_params(QuantParams(
+            lib_path=so,
+            quant_buffer_func_name="sample_compress",
+            dequant_buffer_func_name="sample_decompress",
+            reduce_sum_func_name="sample_reduce_sum",
+            elem_in_block=256, block_size=256,  # codec writes 512 B/block
+        ))
+    # nothing mutated: the built-in codec is still active
+    assert env.config.custom_codec is None
+
+
+def test_failed_deferred_codec_unwinds_init(tmp_path, monkeypatch):
+    """A pre-init lib_path registration whose library can no longer load at
+    init() time must fail init() AND leave the environment uninitialized, so a
+    retry re-attempts the codec load instead of silently running the built-in.
+    (The load failure is injected: in-process dlopen caching means a deleted
+    .so file still resolves, so the filesystem can't produce one.)"""
+    import mlsl_tpu.comm.codec as codec_mod
+    from mlsl_tpu.core.environment import Environment
+
+    so = _build_sample_codec(tmp_path)
+    e = Environment.get_env()
+    assert not e._initialized
+    params = QuantParams(
+        lib_path=so,
+        quant_buffer_func_name="sample_compress",
+        dequant_buffer_func_name="sample_decompress",
+        reduce_sum_func_name="sample_reduce_sum",
+        elem_in_block=128, block_size=256,
+    )
+    e.set_quantization_params(params)  # loads fine now
+
+    real_load = codec_mod.load_library_codec
+
+    def boom(_params):
+        raise MLSLError("injected load failure")
+
+    monkeypatch.setattr(codec_mod, "load_library_codec", boom)
+    with pytest.raises(MLSLError, match="injected"):
+        e.init()
+    assert not e._initialized  # unwound: a retry re-attempts the load
+    monkeypatch.setattr(codec_mod, "load_library_codec", real_load)
+    e.init()
+    try:
+        assert e._initialized
+        assert e.config.custom_codec is not None
+    finally:
+        e.finalize()
+
+
 def _build_sample_codec(tmp_path) -> str:
     src = os.path.join(REPO, "native", "sample_codec.c")
     so = str(tmp_path / "libsample_codec.so")

@@ -423,10 +423,23 @@ def test_ragged_color_groups(env):
             dist.local_part(aout, p), np.concatenate(blocks)
         )
 
-    # alltoallv stays rejected: its count matrix already expresses raggedness
-    # (docs/DESIGN.md "Ragged color groups")
+    # an undersized buffer (sized for a small group, not Gmax) must be
+    # rejected loudly: XLA clamps out-of-range dynamic_slice starts, which
+    # would silently hand large-group members a duplicate chunk
     from mlsl_tpu.log import MLSLError
 
+    with pytest.raises(MLSLError, match="Gmax"):
+        env.wait(dist.scatter(
+            fill(dist, rc * 3), rc, DataType.FLOAT, 1, GroupType.DATA
+        ))
+    with pytest.raises(MLSLError, match="Gmax"):
+        env.wait(dist.reduce_scatter(
+            fill(dist, rc * 3), rc, DataType.FLOAT, ReductionType.SUM,
+            GroupType.DATA,
+        ))
+
+    # alltoallv stays rejected: its count matrix already expresses raggedness
+    # (docs/DESIGN.md "Ragged color groups")
     with pytest.raises(MLSLError):
         env.wait(dist.all_to_allv(
             fill(dist, 40), [8] * 5, None, None, None, DataType.FLOAT,
