@@ -235,6 +235,40 @@ def test_alltoallv_explicit_recv_counts(env):
         )
 
 
+def test_alltoallv_zero_counts_emulate_subgroups(env):
+    """docs/DESIGN.md 'Ragged color groups' tells users to spell a ragged
+    alltoallv as zero counts on an equal-size group: pairs across the logical
+    partition exchange nothing. Pin that the documented escape hatch works —
+    a {0,1}|{2,3} partition expressed purely through the count matrix."""
+    G = 4
+    dist = env.create_distribution(1, G, devices=env.devices[:G])
+    half = lambda i: i // 2
+    S = np.array([
+        [(i + j) % 2 + 1 if half(i) == half(j) else 0 for j in range(G)]
+        for i in range(G)
+    ])
+    send_len = int(S.sum(axis=1).max())
+    soff = np.hstack([np.zeros((G, 1), int), np.cumsum(S, axis=1)[:, :-1]])
+    R = S.T
+    roff = np.hstack([np.zeros((G, 1), int), np.cumsum(R, axis=1)[:, :-1]])
+    buf = dist.make_buffer(
+        lambda p: p * 100.0 + np.arange(send_len, dtype=np.float64), send_len
+    )
+    out = env.wait(
+        dist.all_to_allv(buf, S, soff, R, roff, DataType.FLOAT, GroupType.MODEL)
+    )
+    for p in range(G):
+        recv_len = np.asarray(out).shape[-1]
+        expected = np.zeros(recv_len, dtype=np.float32)
+        for j in range(G):
+            if half(j) != half(p):
+                continue  # cross-partition pairs exchange nothing
+            src = np.asarray(j * 100.0 + np.arange(send_len), dtype=np.float32)
+            seg = src[soff[j, p] : soff[j, p] + S[j, p]]
+            expected[roff[p, j] : roff[p, j] + len(seg)] = seg
+        np.testing.assert_allclose(dist.local_part(out, p), expected)
+
+
 def test_barrier(env):
     dist = env.create_distribution(2, 4)
     dist.barrier(GroupType.GLOBAL)
