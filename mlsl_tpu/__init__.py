@@ -18,6 +18,7 @@ from mlsl_tpu.types import (
     CompressionType,
     QuantParams,
 )
+from mlsl_tpu.log import MLSLError
 from mlsl_tpu.core.environment import Environment
 from mlsl_tpu.core.distribution import Distribution
 from mlsl_tpu.core.session import Session, Operation, OperationRegInfo
@@ -44,4 +45,5 @@ __all__ = [
     "CommBlockInfo",
     "ParameterSet",
     "Statistics",
+    "MLSLError",
 ]

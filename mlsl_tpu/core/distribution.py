@@ -226,7 +226,14 @@ class Distribution:
             send_buffer,
         )
 
-    def all_reduce(self, send_buffer, count, data_type, red_type, group_type) -> CommRequest:
+    def all_reduce(self, send_buffer, count, data_type, red_type, group_type,
+                   compression=None) -> CommRequest:
+        """compression (optional CompressionType) routes the reduction through
+        the registered codec — the built-in Pallas int8 block ring or a
+        user-pluggable codec from set_quantization_params (reference: quantized
+        allreduce swaps in MPI_QUANT_OP, src/comm_ep.cpp:946-950)."""
+        from mlsl_tpu.types import CompressionType
+
         return self._start(
             CommDesc(
                 "allreduce",
@@ -234,6 +241,9 @@ class Distribution:
                 int(count),
                 DataType(data_type),
                 op=ReductionType(red_type),
+                compression=(CompressionType(compression)
+                             if compression is not None
+                             else CompressionType.NONE),
             ),
             send_buffer,
         )

@@ -39,6 +39,13 @@ def test_train_transformer_example_runs():
     assert "checkpoint restored from step 10" in r.stdout
 
 
+def test_custom_codec_example_runs():
+    r = _run_example("custom_codec.py")
+    assert r.returncode == 0, r.stderr[-2000:]
+    assert "custom codec example OK" in r.stdout
+    assert "inconsistent geometry rejected" in r.stdout
+
+
 def test_train_zero1_adam_example_runs():
     r = _run_example("train_zero1_adam.py")
     assert r.returncode == 0, r.stderr[-2000:]
