@@ -60,6 +60,29 @@ def test_python_codec_identity_is_exact(env):
     np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-5)
 
 
+def test_public_allreduce_compression_kwarg(env):
+    """The public Distribution.all_reduce(compression=...) path routes through
+    the registered codec — the supported way to reach the quantized wire
+    without hand-building CommRequest internals."""
+    n = 512
+    env.set_quantization_params(QuantParams(
+        compress_fn=lambda x: x, decompress_fn=lambda p, n: p,
+    ))
+    dist = env.create_distribution(8, 1)
+    rng = np.random.default_rng(5)
+    vals = {p: rng.normal(size=n).astype(np.float32) for p in range(8)}
+    req = dist.all_reduce(
+        dist.make_buffer(lambda p: vals[p], n), n, DataType.FLOAT,
+        ReductionType.SUM, GroupType.DATA,
+        compression=CompressionType.QUANTIZATION,
+    )
+    out = env.wait(req)
+    want = np.sum([vals[p] for p in range(8)], axis=0)
+    np.testing.assert_allclose(
+        np.asarray(dist.local_part(out, 0)), want, rtol=1e-5, atol=1e-5
+    )
+
+
 def test_python_codec_lossy_with_reduce_and_feedback(env):
     """A lossy f16 codec with a compressed-domain reduce_sum: result close to
     exact, error-feedback residual carried on the request."""
