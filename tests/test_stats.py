@@ -96,28 +96,40 @@ def test_overlap_blocking_vs_overlapped(stats_env):
     assert iso > 0
     buf = dist.make_buffer(lambda p: np.ones(n, np.float32), n)
 
-    st.reset()
-    for _ in range(3):
-        ps.start_gradient_comm(buf)
-        ps.wait_gradient_comm()
-    blocked = st.get_overlap_fraction()
-    blocked_exposed = st.overlap_report()["total"]["exposed_ns"]
-
-    st.reset()
-    for _ in range(3):
-        ps.start_gradient_comm(buf)
-        time.sleep(iso / 1e9 * 4 + 0.02)  # 'compute' outlasting the collective
-        ps.wait_gradient_comm()
-    overlapped = st.get_overlap_fraction()
-    overlapped_exposed = st.overlap_report()["total"]["exposed_ns"]
+    def measure(sleep_s):
+        # Best-of-3 single reps: machine-load spikes only ever INFLATE exposed
+        # time, so the minimum is the pattern's capability estimate (the same
+        # best-of-blocks discipline bench.py uses on the shared tunnel).
+        best = None
+        for _ in range(3):
+            st.reset()
+            ps.start_gradient_comm(buf)
+            if sleep_s:
+                time.sleep(sleep_s)  # 'compute' outlasting the collective
+            ps.wait_gradient_comm()
+            frac = st.get_overlap_fraction()
+            exposed = st.overlap_report()["total"]["exposed_ns"]
+            if best is None or exposed < best[1]:
+                best = (frac, exposed)
+        return best
 
     # Comparative assertions only: absolute fractions are load-sensitive on a
     # shared machine (iso is replayed at commit; live runs race other tests).
-    assert blocked is not None and overlapped is not None
-    assert overlapped > blocked, (overlapped, blocked, iso)
-    assert overlapped_exposed < 0.6 * blocked_exposed, (
-        overlapped_exposed, blocked_exposed, iso,
-    )
+    # A sustained spike (e.g. a concurrent JAX import pinning the core) can
+    # straddle every rep of one phase, so the comparison itself retries.
+    for attempt in range(3):
+        blocked, blocked_exposed = measure(0)
+        overlapped, overlapped_exposed = measure(iso / 1e9 * 4 + 0.02)
+        assert blocked is not None and overlapped is not None
+        if overlapped > blocked and overlapped_exposed < 0.6 * blocked_exposed:
+            break
+        time.sleep(5 * (attempt + 1))
+    else:
+        raise AssertionError(
+            f"overlapped pattern never beat blocking across 3 attempts: "
+            f"fractions {overlapped} vs {blocked}, exposed "
+            f"{overlapped_exposed} vs {blocked_exposed}, iso {iso}"
+        )
 
 
 def test_overlap_test_driven_path(stats_env):
@@ -145,40 +157,50 @@ def test_overlap_test_driven_path(stats_env):
     buf = dist.make_buffer(lambda p: np.ones(n, np.float32), n)
     st = s.get_stats()
 
-    # blocking pattern: every collective's full latency is exposed
-    st.reset()
-    for _ in range(2):
-        for op in ops:
-            op.get_parameter_set(0).start_gradient_comm(buf)
-            op.get_parameter_set(0).wait_gradient_comm()
-    blocked = st.get_overlap_fraction()
-    blocked_exposed = st.overlap_report()["total"]["exposed_ns"]
+    def measure_blocking():
+        # blocking pattern: every collective's full latency is exposed
+        st.reset()
+        for _ in range(2):
+            for op in ops:
+                op.get_parameter_set(0).start_gradient_comm(buf)
+                op.get_parameter_set(0).wait_gradient_comm()
+        return st.get_overlap_fraction(), st.overlap_report()["total"]["exposed_ns"]
 
-    # Test-driven pattern: start all (newest first), poll while 'computing'
-    st.reset()
-    for _ in range(2):
-        for op in reversed(ops):
-            op.get_parameter_set(0).start_gradient_comm(buf)
-        pending = list(ops)
-        deadline = time.monotonic() + 30.0
-        while pending:
-            time.sleep(2 * iso_total / 1e9)  # simulated per-layer update compute
-            still = []
-            for op in pending:
-                done, _ = op.get_parameter_set(0).test_gradient_comm()
-                if not done:
-                    still.append(op)
-            pending = still
-            assert time.monotonic() < deadline, "collectives never completed"
-    overlapped = st.get_overlap_fraction()
-    overlapped_exposed = st.overlap_report()["total"]["exposed_ns"]
+    def measure_test_driven():
+        # Test-driven pattern: start all (newest first), poll while 'computing'
+        st.reset()
+        for _ in range(2):
+            for op in reversed(ops):
+                op.get_parameter_set(0).start_gradient_comm(buf)
+            pending = list(ops)
+            deadline = time.monotonic() + 30.0
+            while pending:
+                time.sleep(2 * iso_total / 1e9)  # simulated per-layer compute
+                still = []
+                for op in pending:
+                    done, _ = op.get_parameter_set(0).test_gradient_comm()
+                    if not done:
+                        still.append(op)
+                pending = still
+                assert time.monotonic() < deadline, "collectives never completed"
+        return st.get_overlap_fraction(), st.overlap_report()["total"]["exposed_ns"]
 
-    assert blocked is not None and overlapped is not None
-    assert overlapped > blocked, (overlapped, blocked)
-    # the polling path must expose well under half of what blocking exposes
-    assert overlapped_exposed < 0.5 * blocked_exposed, (
-        overlapped_exposed, blocked_exposed, iso_total,
-    )
+    # Comparative only, with retries: a sustained machine-load spike straddling
+    # one pattern's measurement can invert the comparison on a shared core.
+    for attempt in range(3):
+        blocked, blocked_exposed = measure_blocking()
+        overlapped, overlapped_exposed = measure_test_driven()
+        assert blocked is not None and overlapped is not None
+        # the polling path must expose well under half of what blocking exposes
+        if overlapped > blocked and overlapped_exposed < 0.5 * blocked_exposed:
+            break
+        time.sleep(5 * (attempt + 1))
+    else:
+        raise AssertionError(
+            f"test-driven pattern never beat blocking across 3 attempts: "
+            f"fractions {overlapped} vs {blocked}, exposed "
+            f"{overlapped_exposed} vs {blocked_exposed}, iso {iso_total}"
+        )
 
 
 def test_peer_op_redirection(stats_env):
