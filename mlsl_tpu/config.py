@@ -75,6 +75,12 @@ class Config:
     alltoall_split: int = 1         # MLSL_ALLTOALL_SPLIT
     thp_threshold_mb: int = 0       # MLSL_THP_THRESHOLD_MB
 
+    # Persistent XLA compilation cache (TPU-native: Session::Commit pre-lowers
+    # every per-edge collective, and on real chips each first compile costs
+    # tens of seconds — a warm cache makes restarts near-instant; the
+    # reference has no analog because MPI has no compile step). Empty = off.
+    compile_cache_dir: str = ""     # MLSL_COMPILE_CACHE_DIR
+
     @staticmethod
     def from_env() -> "Config":
         c = Config()
@@ -100,4 +106,7 @@ class Config:
         c.heap_size_gb = _env_int("MLSL_HEAP_SIZE_GB", c.heap_size_gb)
         c.alltoall_split = _env_int("MLSL_ALLTOALL_SPLIT", c.alltoall_split)
         c.thp_threshold_mb = _env_int("MLSL_THP_THRESHOLD_MB", c.thp_threshold_mb)
+        c.compile_cache_dir = os.environ.get(
+            "MLSL_COMPILE_CACHE_DIR", c.compile_cache_dir
+        )
         return c

@@ -83,6 +83,7 @@ class Environment:
         self.config = Config.from_env()
         set_log_level(self.config.log_level)
         sysinfo.auto_config(self.config)
+        self._apply_compile_cache()
         self.dispatcher = Dispatcher(self.config)
         self.devices = tuple(devices) if devices is not None else tuple(jax.devices())
         self._initialized = True
@@ -102,6 +103,34 @@ class Environment:
                 raise
         self._dump_config()
         return self
+
+    _jax_cache_defaults = None  # knob values before our first mutation
+
+    def _apply_compile_cache(self) -> None:
+        """Persistent XLA compilation cache: pre-lowered Session collectives and
+        jitted train steps reload from disk on warm restarts instead of
+        recompiling (first compiles cost tens of seconds on real chips).
+        Thresholds are zeroed while enabled so every program is cached — the
+        cache exists to eliminate recompiles, not just the largest ones. The
+        toggle is symmetric: an init() without MLSL_COMPILE_CACHE_DIR restores
+        the pre-mutation knob values, so 'empty = off' holds across
+        init/finalize cycles in one process."""
+        if Environment._jax_cache_defaults is None:
+            Environment._jax_cache_defaults = (
+                jax.config.jax_compilation_cache_dir,
+                jax.config.jax_persistent_cache_min_compile_time_secs,
+                jax.config.jax_persistent_cache_min_entry_size_bytes,
+            )
+        if self.config.compile_cache_dir:
+            jax.config.update("jax_compilation_cache_dir",
+                              self.config.compile_cache_dir)
+            jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
+            jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
+        else:
+            d, t, s = Environment._jax_cache_defaults
+            jax.config.update("jax_compilation_cache_dir", d)
+            jax.config.update("jax_persistent_cache_min_compile_time_secs", t)
+            jax.config.update("jax_persistent_cache_min_entry_size_bytes", s)
 
     def _dump_config(self) -> None:
         """One-time config/world dump at init (the reference's rank-0 env-var dump,
