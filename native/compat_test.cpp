@@ -332,6 +332,37 @@ int rank_main_mismatch(int argc, char** argv) {
   return 0;
 }
 
+/* Slow-but-healthy collective under an aggressive watchdog
+ * (MLSL_COMPAT_WATCHDOG_S=1): a multi-second AllReduce on the CPU mesh keeps
+ * the executing rank inside the collective past the deadline. The watchdog
+ * must RE-ARM for the waiting ranks (all ranks joined; slow is not divergent)
+ * instead of spuriously aborting, and the result must still be exact. */
+int rank_main_slowwait(int argc, char** argv) {
+  Environment& env = Environment::GetEnv();
+  env.Init(&argc, &argv);
+  size_t world = env.GetProcessCount();
+  size_t rank = env.GetProcessIdx();
+  Distribution* dist = env.CreateDistribution(world, 1);
+  const size_t n = 32u << 20;  // 32M floats: seconds of wire+reduce per core
+  std::vector<float> buf(n);
+  for (size_t i = 0; i < n; i++)
+    buf[i] = (float)(rank + 1) + (float)(i % 17);
+  CommReq* req = dist->AllReduce(buf.data(), buf.data(), n, DT_FLOAT, RT_SUM,
+                                 GT_GLOBAL);
+  env.Wait(req);
+  double wsum = world * (world + 1) / 2.0;
+  size_t bad = 0;
+  for (size_t i = 0; i < n; i++) {
+    double want = wsum + (double)world * (double)(i % 17);
+    if (std::fabs(buf[i] - want) > 1e-3 * (std::fabs(want) + 1.0)) bad++;
+  }
+  CHECK(bad == 0, "slowwait: %zu allreduce mismatches", bad);
+  env.DeleteDistribution(dist);
+  env.Finalize();
+  if (rank == 0) std::printf("compat_test slowwait: PASSED\n");
+  return 0;
+}
+
 int rank_main(int argc, char** argv) {
   Environment& env = Environment::GetEnv();
   CHECK(MLSL_MAJOR(Environment::GetVersion()) == MLSL_MAJOR_VERSION,
@@ -475,6 +506,8 @@ int main(int argc, char** argv) {
   }
   if (std::strcmp(argv[1], "mismatch") == 0)
     return MLSL::RunRanks(argc, argv, rank_main_mismatch);
+  if (std::strcmp(argv[1], "slowwait") == 0)
+    return MLSL::RunRanks(argc, argv, rank_main_slowwait);
   cfg.group_count = (size_t)std::atoi(argv[1]);
   if (cfg.group_count < 1) cfg.group_count = 1;
   if (argc > 2) cfg.dist_update = std::atoi(argv[2]) != 0;
