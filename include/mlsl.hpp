@@ -173,10 +173,15 @@ namespace MLSL
         CommReq* AllGatherv(void* sendBuffer, size_t sendCount,
                             void* recvBuffer, size_t* recvCounts,
                             DataType dataType, GroupType groupType);
-        /* rank-uniform count/offset arrays of size_t[group_size] (reference
-         * include/mlsl.hpp:432); NULL offsets = packed layout; the receive
-         * buffer is sized per the MPI contract (this rank's total receive
-         * extent) — member j receives sendCounts[j] elements from each peer */
+        /* Each rank passes its OWN size_t[group_size] count/offset vectors
+         * — full MPI_Ialltoallv generality (reference include/mlsl.hpp:432):
+         * the runtime gathers the per-rank rows, validates the pairwise
+         * invariant (recvCounts[j] here == sendCounts[myIdx] at member j),
+         * and issues one static-geometry exchange. NULL offsets = packed
+         * layout; the receive buffer is sized per the MPI contract (this
+         * rank's total receive extent). A NULL recvCounts selects the legacy
+         * rank-uniform mode (member j receives sendCounts[j] from each
+         * peer). */
         CommReq* AlltoAllv(void* sendBuffer, size_t* sendCounts,
                            size_t* sendOffsets, void* recvBuffer,
                            size_t* recvCounts, size_t* recvOffsets,

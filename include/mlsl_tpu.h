@@ -161,6 +161,18 @@ mlsl_handle_t mlsl_distribution_all_to_allv(mlsl_handle_t dist,
                                             const int64_t* recv_offsets,
                                             mlsl_data_type_t dt,
                                             mlsl_group_type_t group);
+/* General per-rank AlltoAllv (full MPI generality, reference
+ * src/comm_ep.cpp:1188-1265): count/offset tables are int64[world * group]
+ * row-major — row w holds world rank w's own vectors (what each MPI rank
+ * passes to MPI_Ialltoallv). recv_counts is validated against the transposed
+ * send geometry (the MPI pairwise invariant) at setup; offsets may be NULL
+ * for the packed default. send buffer is (world, send_len) staging with each
+ * rank's row valid to its own send extent. */
+mlsl_handle_t mlsl_distribution_all_to_allv_full(
+    mlsl_handle_t dist, const void* send, int64_t send_len,
+    const int64_t* send_counts, const int64_t* send_offsets,
+    const int64_t* recv_counts, const int64_t* recv_offsets,
+    mlsl_data_type_t dt, mlsl_group_type_t group);
 
 /* ---- activations (reference mlsl.hpp:210-268, c_bind activation calls) ---- */
 int64_t mlsl_operation_get_input_count(mlsl_handle_t op);

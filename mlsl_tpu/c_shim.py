@@ -438,6 +438,28 @@ def dist_all_to_allv(dist_h: int, addr: int, send_len: int,
     return _put((dist, req))
 
 
+def dist_all_to_allv_full(dist_h: int, addr: int, send_len: int,
+                          send_counts_addr: int, send_offsets_addr: int,
+                          recv_counts_addr: int, recv_offsets_addr: int,
+                          data_type: int, group: int) -> int:
+    """General per-rank AlltoAllv: int64[world * group] row-major tables, row w
+    = world rank w's own count/displacement vectors (full MPI generality; see
+    comm.request._normalize_alltoallv_per_rank). 0 addr = packed default
+    offsets / derived recv counts."""
+    dist = _get(dist_h)
+    gt = GroupType(group)
+    g = dist._group(gt)
+    gsize = 1 if g.is_self else g.size
+    w = dist.topology.world_size
+    rd = lambda a: _read_i64_array(a, w * gsize).reshape(w, gsize) if a else None
+    buf = _read_world_buffer(dist, addr, send_len, data_type)
+    req = dist.all_to_allv(
+        buf, rd(send_counts_addr), rd(send_offsets_addr),
+        rd(recv_counts_addr), rd(recv_offsets_addr), data_type, gt,
+    )
+    return _put((dist, req))
+
+
 # ---- statistics (reference mlsl.hpp:651-726, c_bind stats wrappers) ----
 
 def session_get_stats(sess_h: int) -> int:

@@ -195,16 +195,30 @@ def _body_alltoall(x, *, axes, sizes, send_count, **_):
     return mine.reshape(g * send_count)
 
 
-def _body_alltoallv(x, *, axes, sizes, S, Soff, Roff, recv_len, **_):
+def _body_alltoallv(x, *, axes, sizes, S=None, Soff=None, Roff=None, recv_len=None,
+                    S_tab=None, Soff_tab=None, Roff_tab=None, lmax=None, **_):
     """Emulated AlltoAllv with full static count matrices (MPI semantics).
 
-    S[i][j] = elements rank i sends to member j; Soff[i][j] = offset of that segment in
-    i's send buffer; Roff[i][j] = offset in i's receive buffer where data from j lands.
-    The reference expresses this with per-rank count arrays passed to pairwise
-    Isend/Irecv (src/comm_ep.cpp:1188-1265); SPMD needs the whole matrix statically.
-    Segment lengths vary per (j, me) pair, so slices use a static max length with a
-    validity mask.
+    Instance-uniform mode (S/Soff/Roff given): S[i][j] = elements member i sends to
+    member j; Soff[i][j] = offset of that segment in i's send buffer; Roff[i][j] =
+    offset in i's receive buffer where data from j lands — the same matrix for every
+    group instance.
+
+    Per-rank mode (S_tab/Soff_tab/Roff_tab given): (W, G, G) tables, row w = the
+    instance matrices seen by world rank w (each rank supplies its OWN count/offset
+    vectors, full MPI generality — different group instances may exchange different
+    geometries). The reference expresses this with per-rank count arrays passed to
+    pairwise Isend/Irecv (src/comm_ep.cpp:1188-1265); SPMD needs the matrices
+    statically, selected per rank by a traced world-rank index. Segment lengths vary
+    per (j, me) pair, so slices use a static max length with a validity mask.
     """
+    if S_tab is not None:
+        me_w = _group_rank(ALL_AXES, sizes)
+        sel = lambda t: jnp.take(jnp.asarray(t, dtype=jnp.int32), me_w, axis=0)
+        return _alltoallv_core(
+            _gather_group(x, axes), _group_rank(axes, sizes), x.dtype,
+            sel(S_tab), sel(Soff_tab), sel(Roff_tab), recv_len, lmax=lmax,
+        )
     return _alltoallv_core(
         _gather_group(x, axes), _group_rank(axes, sizes), x.dtype,
         S, Soff, Roff, recv_len,
@@ -268,14 +282,56 @@ def _axis_groups_tbl(group: ProcessGroup) -> Tuple[Tuple[int, ...], ...]:
     return tuple(rows)
 
 
-def _alltoallv_core(g_members, me_pos, x_dtype, S, Soff, Roff, recv_len):
+def _member_world_table(group: ProcessGroup) -> np.ndarray:
+    """(W, G) table: row w = the world ranks of w's group-instance members, in
+    group-rank order. Uniform groups only (axis-aligned or equal color groups)."""
+    if group.colors is not None:
+        rows = _color_groups_tbl(group)
+    elif not group.axes:
+        return np.arange(group.topology.world_size, dtype=np.int32)[:, None]
+    else:
+        rows = _axis_groups_tbl(group)
+    tbl = np.zeros((group.topology.world_size, len(rows[0])), dtype=np.int32)
+    for row in rows:
+        for p in row:
+            tbl[p] = row
+    return tbl
+
+
+def _per_rank_alltoallv_tables(group: ProcessGroup, kw: dict) -> dict:
+    """Expand per-world-rank count/offset rows (Sw/Swoff/Rwoff, each (W, G)) into
+    the (W, G, G) per-instance matrix tables the bodies select by world rank.
+
+    Row w of each table holds the instance matrices as seen by world rank w:
+    S_tab[w][i][j] = elements the member at group position i of w's instance
+    sends to position j. Footprint is W*G*G i32 — for subgroups (G << W, the
+    only case where tables differ from the instance-uniform (G, G) matrix)
+    this stays small (e.g. W=256, G=16 -> 256 KiB)."""
+    M = _member_world_table(group)                       # (W, G)
+    Sw = np.asarray(kw.pop("Sw"), dtype=np.int32)        # (W, G)
+    Swoff = np.asarray(kw.pop("Swoff"), dtype=np.int32)
+    Rwoff = np.asarray(kw.pop("Rwoff"), dtype=np.int32)
+    to3 = lambda t: tuple(tuple(tuple(int(v) for v in r) for r in m) for m in t)
+    out = dict(kw)
+    out["S_tab"] = to3(Sw[M])
+    out["Soff_tab"] = to3(Swoff[M])
+    out["Roff_tab"] = to3(Rwoff[M])
+    out["lmax"] = max(int(Sw.max()), 1) if Sw.size else 1
+    return out
+
+
+def _alltoallv_core(g_members, me_pos, x_dtype, S, Soff, Roff, recv_len, lmax=None):
     """Shared AlltoAllv scatter/merge math over an already-gathered (G, send_len)
-    member block; see _body_alltoallv for the semantics."""
+    member block; see _body_alltoallv for the semantics. The matrices may be
+    static tuples or traced (G, G) arrays (the per-rank table path); ``lmax``
+    (the static max segment length) must be supplied in the traced case."""
     g = len(S)
     s_m = jnp.asarray(S, dtype=jnp.int32)
     soff_m = jnp.asarray(Soff, dtype=jnp.int32)
     roff_m = jnp.asarray(Roff, dtype=jnp.int32)
-    lmax = int(np.max(S)) if np.max(S) > 0 else 1
+    if lmax is None:
+        lmax = int(np.max(S)) if np.max(S) > 0 else 1
+    lmax = max(int(lmax), 1)
     pos = jnp.arange(lmax)
     pad = jnp.zeros((lmax,), dtype=x_dtype)
     out = jnp.zeros((recv_len + lmax,), dtype=x_dtype)
@@ -294,6 +350,7 @@ def _alltoallv_core(g_members, me_pos, x_dtype, S, Soff, Roff, recv_len):
 def _make_subgroup_body(kind: str, groups: Tuple[Tuple[int, ...], ...], *,
                         op=None, root=None, recv_count=None, recv_counts=None,
                         pairs=None, S=None, Soff=None, Roff=None, recv_len=None,
+                        S_tab=None, Soff_tab=None, Roff_tab=None, lmax=None,
                         **_):
     """(n,) -> (out_n,) body over the single 'world' axis, using axis_index_groups."""
     gsize = len(groups[0])
@@ -369,6 +426,19 @@ def _make_subgroup_body(kind: str, groups: Tuple[Tuple[int, ...], ...], *,
         world_pairs = [(row[int(s)], row[int(d)]) for row in groups for s, d in pairs]
         return lambda v: lax.ppermute(v, "world", world_pairs)
     if kind == "alltoallv":
+        if S_tab is not None:
+            # per-rank tables: select this world rank's instance matrices
+            def body_a2av(v):
+                me_w = lax.axis_index("world")
+                sel = lambda t: jnp.take(
+                    jnp.asarray(t, dtype=jnp.int32), me_w, axis=0
+                )
+                return _alltoallv_core(
+                    gather_group(v), mypos(), v.dtype,
+                    sel(S_tab), sel(Soff_tab), sel(Roff_tab), recv_len,
+                    lmax=lmax,
+                )
+            return body_a2av
         return lambda v: _alltoallv_core(
             gather_group(v), mypos(), v.dtype, S, Soff, Roff, recv_len
         )
@@ -570,16 +640,34 @@ def build_collective(kind: str, group: ProcessGroup, dtype, **kw) -> Callable:
     mesh = topo.mesh
     sizes = _axis_sizes(mesh)
 
+    if kind == "alltoallv" and "Sw" in kw and group.is_uniform:
+        # per-world-rank count/offset rows -> per-instance (W, G, G) tables
+        kw = _per_rank_alltoallv_tables(group, dict(kw))
+
     if group.is_self or (group.colors is None and sizes_prod(group.axes, sizes) == 1):
         # Single-member group: every collective is the identity (or local reshape).
-        def body(x, _kind=kind, _kw=kw):
-            if _kind == "alltoallv":
-                return x[: _kw["recv_len"]]
-            if _kind in ("scatter", "reduce_scatter"):
-                return x[: _kw["recv_count"]]
-            if _kind == "allgatherv":
-                return x[: _kw["recv_counts"][0]]
-            return x
+        if kind == "alltoallv" and "S_tab" in kw:
+            # per-rank mode on a 1-member group: a local repack (each rank moves
+            # its own soff-segment to its roff slot)
+            def body(x, _kw=kw):
+                me_w = _group_rank(ALL_AXES, sizes)
+                sel = lambda t: jnp.take(
+                    jnp.asarray(t, dtype=jnp.int32), me_w, axis=0
+                )
+                return _alltoallv_core(
+                    x[None], jnp.int32(0), x.dtype,
+                    sel(_kw["S_tab"]), sel(_kw["Soff_tab"]), sel(_kw["Roff_tab"]),
+                    _kw["recv_len"], lmax=_kw["lmax"],
+                )
+        else:
+            def body(x, _kind=kind, _kw=kw):
+                if _kind == "alltoallv":
+                    return x[: _kw["recv_len"]]
+                if _kind in ("scatter", "reduce_scatter"):
+                    return x[: _kw["recv_count"]]
+                if _kind == "allgatherv":
+                    return x[: _kw["recv_counts"][0]]
+                return x
 
     elif group.colors is not None:
         if group.is_uniform:

@@ -375,13 +375,24 @@ def _check_recv_count(d: CommDesc) -> None:
 
 
 def _normalize_alltoallv(d: CommDesc) -> dict:
-    """Expand user count/offset arrays into full (G, G) static matrices.
+    """Expand user count/offset arrays into full static matrices.
 
     MPI semantics: S[i][j] = elements i->member j. 1-D arrays mean 'same on every
-    rank' (S[i][j] = counts[j]); 2-D arrays give the full matrix. Offsets default to
+    rank' (S[i][j] = counts[j]); (G, G) arrays give the full instance-uniform
+    matrix (every group instance exchanges the same geometry). Offsets default to
     the packed (cumulative) layout. The receive matrix is derived: R[i][j] = S[j][i].
+
+    (W, G) arrays (world size x group size, W != G) select per-rank mode: row w is
+    what world rank w sends to each member of ITS OWN group instance — the full
+    generality of each MPI rank passing its own count vectors
+    (reference src/comm_ep.cpp:1188-1265), so different instances of a subgroup
+    may exchange different geometries.
     """
     g = d.group.size
+    w = d.group.topology.world_size
+    a = np.asarray(d.send_counts, dtype=int)
+    if a.ndim == 2 and a.shape == (w, g) and w != g:
+        return _normalize_alltoallv_per_rank(d, a)
 
     def packed(mat):
         return np.hstack([np.zeros((g, 1), int), np.cumsum(mat, axis=1)[:, :-1]])
@@ -407,6 +418,56 @@ def _normalize_alltoallv(d: CommDesc) -> dict:
     recv_len = int(np.max(roff + r)) if g > 0 else 1
     to_t = lambda m: tuple(tuple(int(v) for v in row) for row in m)
     return dict(S=to_t(s), Soff=to_t(soff), Roff=to_t(roff), recv_len=max(recv_len, 1))
+
+
+def _normalize_alltoallv_per_rank(d: CommDesc, s: np.ndarray) -> dict:
+    """Per-rank mode: each world rank supplies its own (G,) count/offset rows,
+    stacked into (W, G) arrays. The receive geometry is DERIVED from the send
+    matrix via the member table (R[w][j] = S[member_j_of_w's_instance][pos(w)]);
+    explicit recv_counts must match it — the MPI pairwise invariant
+    (sendcounts[j]@i == recvcounts[i]@j), checked here at trace time instead of
+    deadlocking/corrupting at run time like a mismatched MPI exchange would."""
+    g = d.group.size
+    w = d.group.topology.world_size
+    mlsl_assert(
+        d.group.is_uniform,
+        "per-rank alltoallv requires equal-size groups (ragged partitions are "
+        "spelled with zero counts on an equal-size group; docs/DESIGN.md)",
+    )
+    M = collectives._member_world_table(d.group)  # (W, G)
+    pos = np.empty(w, dtype=int)
+    for p in range(w):
+        pos[p] = list(M[p]).index(p)
+
+    def packed(mat):
+        return np.hstack([np.zeros((w, 1), int), np.cumsum(mat, axis=1)[:, :-1]])
+
+    def expand(arr, name):
+        a = np.asarray(arr, dtype=int)
+        if a.ndim == 1:
+            a = np.tile(a, (w, 1))
+        mlsl_assert(
+            a.shape == (w, g),
+            "per-rank alltoallv %s must be (world=%d, group=%d), got %s",
+            name, w, g, a.shape,
+        )
+        return a
+
+    soff = packed(s) if d.send_offsets is None else expand(d.send_offsets,
+                                                           "send_offsets")
+    r = s[M, pos[:, None]]  # R[w][j] = S[M[w][j]][pos[w]]
+    if d.recv_counts is not None:
+        mlsl_assert(
+            np.array_equal(expand(d.recv_counts, "recv_counts"), r),
+            "alltoallv recv_counts violate the MPI pairwise invariant: "
+            "recv_counts[w][j] must equal member j's send count toward w",
+        )
+    roff = packed(r) if d.recv_offsets is None else expand(d.recv_offsets,
+                                                           "recv_offsets")
+    recv_len = int(np.max(roff + r)) if r.size else 1
+    to_t = lambda m: tuple(tuple(int(v) for v in row) for row in m)
+    return dict(Sw=to_t(s), Swoff=to_t(soff), Rwoff=to_t(roff),
+                recv_len=max(recv_len, 1))
 
 
 def _array_is_ready(arr: jax.Array) -> bool:

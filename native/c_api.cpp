@@ -386,6 +386,20 @@ mlsl_handle_t mlsl_distribution_all_to_allv(mlsl_handle_t dist,
       0);
 }
 
+mlsl_handle_t mlsl_distribution_all_to_allv_full(
+    mlsl_handle_t dist, const void* send, int64_t send_len,
+    const int64_t* send_counts, const int64_t* send_offsets,
+    const int64_t* recv_counts, const int64_t* recv_offsets,
+    mlsl_data_type_t dt, mlsl_group_type_t group) {
+  return (mlsl_handle_t)call_i(
+      "dist_all_to_allv_full",
+      {(int64_t)dist, (int64_t)(intptr_t)send, send_len,
+       (int64_t)(intptr_t)send_counts, (int64_t)(intptr_t)send_offsets,
+       (int64_t)(intptr_t)recv_counts, (int64_t)(intptr_t)recv_offsets,
+       (int64_t)dt, (int64_t)group},
+      0);
+}
+
 int64_t mlsl_operation_get_input_count(mlsl_handle_t op) {
   return call_i("operation_input_count", {(int64_t)op});
 }

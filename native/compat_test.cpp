@@ -454,6 +454,77 @@ int rank_main(int argc, char** argv) {
     if (rank == 0) std::printf("compat_test: AllGatherv OK\n");
   }
 
+  /* general per-rank AlltoAllv (reference mlsl.hpp:432, each rank its OWN
+   * count/displacement vectors — the MPI_Ialltoallv generality): rank i
+   * sends (i + j) % 3 + 1 elements to rank j; counts gathered across ranks,
+   * pairwise invariant validated by the engine, blocks written back at this
+   * rank's own displacements. Oracle: recv block j = rank j's segment toward
+   * me, fill value sender*100 + send_offset + k. */
+  {
+    std::vector<size_t> sc(world), soff(world), rc(world), roff(world);
+    size_t send_total = 0, recv_total = 0;
+    for (size_t j = 0; j < world; j++) {
+      sc[j] = (2 * rank + j) % 3 + 1;  /* S[i][j], deliberately asymmetric */
+      soff[j] = send_total;
+      send_total += sc[j];
+      rc[j] = (2 * j + rank) % 3 + 1;  /* = S[j][rank], what j sends to me */
+      roff[j] = recv_total;
+      recv_total += rc[j];
+    }
+    std::vector<float> send(send_total), recv(recv_total, -1.0f);
+    for (size_t k = 0; k < send_total; k++) send[k] = (float)(rank * 100 + k);
+    CommReq* areq = dist->AlltoAllv(send.data(), sc.data(), soff.data(),
+                                    recv.data(), rc.data(), roff.data(),
+                                    DT_FLOAT, GT_GLOBAL);
+    env.Wait(areq);
+    env.Wait(areq);  /* second Wait = MPI no-op */
+    for (size_t j = 0; j < world; j++) {
+      /* sender j's offset of its segment toward me */
+      size_t j_soff = 0;
+      for (size_t t = 0; t < (size_t)rank; t++) j_soff += (2 * j + t) % 3 + 1;
+      for (size_t k = 0; k < rc[j]; k++)
+        CHECK(recv[roff[j] + k] == (float)(j * 100 + j_soff + k),
+              "per-rank AlltoAllv payload");
+    }
+    if (rank == 0) std::printf("compat_test: per-rank AlltoAllv OK\n");
+  }
+
+  /* the same, on MODEL subgroups: counts keyed on the WORLD rank, so the
+   * different group instances exchange genuinely different geometries (the
+   * engine's per-rank (world, group) table path). Model groups are
+   * consecutive ranks (model-minor layout). */
+  if (cfg.group_count > 1) {
+    size_t gsz = dist->GetProcessCount(GT_MODEL);
+    size_t mypos = dist->GetProcessIdx(GT_MODEL);
+    size_t base = rank - mypos; /* my instance's first world rank */
+    std::vector<size_t> sc(gsz), soff(gsz), rc(gsz), roff(gsz);
+    size_t send_total = 0, recv_total = 0;
+    for (size_t j = 0; j < gsz; j++) {
+      sc[j] = (3 * rank + j) % 4 + 1;
+      soff[j] = send_total;
+      send_total += sc[j];
+      rc[j] = (3 * (base + j) + mypos) % 4 + 1; /* member j's count toward me */
+      roff[j] = recv_total;
+      recv_total += rc[j];
+    }
+    std::vector<float> send(send_total), recv(recv_total, -1.0f);
+    for (size_t k = 0; k < send_total; k++) send[k] = (float)(rank * 100 + k);
+    CommReq* areq = dist->AlltoAllv(send.data(), sc.data(), soff.data(),
+                                    recv.data(), rc.data(), roff.data(),
+                                    DT_FLOAT, GT_MODEL);
+    env.Wait(areq);
+    for (size_t j = 0; j < gsz; j++) {
+      size_t wj = base + j;
+      size_t j_soff = 0;
+      for (size_t t = 0; t < mypos; t++) j_soff += (3 * wj + t) % 4 + 1;
+      for (size_t k = 0; k < rc[j]; k++)
+        CHECK(recv[roff[j] + k] == (float)(wj * 100 + j_soff + k),
+              "subgroup per-rank AlltoAllv payload");
+    }
+    if (rank == 0)
+      std::printf("compat_test: subgroup per-rank AlltoAllv OK\n");
+  }
+
   /* color-defined distribution (reference mlsl.hpp:864): unequal data groups
    * {ranks 0..2} and {ranks 3..}, allreduce summed within each group */
   if (world >= 4) {

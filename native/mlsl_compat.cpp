@@ -80,6 +80,27 @@ long watchdog_secs() {
   return v;
 }
 
+/* cv-wait with the divergence watchdog, shared by the construction-phase
+ * slots and the AlltoAllv count gather: waits for pred (caller holds lk),
+ * dying with diag() on timeout. The comm channels use watched_wait below,
+ * which adds bounded re-arming for slow-but-progressing collectives. */
+template <typename Pred>
+void watched_slot_wait(std::unique_lock<std::mutex>& lk,
+                       std::condition_variable& cv, Pred pred,
+                       const std::function<std::string()>& diag) {
+  const long limit = watchdog_secs();
+  auto deadline = std::chrono::steady_clock::now() +
+                  std::chrono::seconds(limit > 0 ? limit : 0);
+  while (!pred()) {
+    if (limit <= 0) {
+      cv.wait(lk);
+      continue;
+    }
+    if (cv.wait_until(lk, deadline) == std::cv_status::timeout && !pred())
+      die(diag());
+  }
+}
+
 struct SharedSlot {
   std::mutex mu;
   std::condition_variable cv;
@@ -110,26 +131,18 @@ uint64_t shared_call(const std::function<uint64_t()>& fn) {
     s.done = true;
     s.cv.notify_all();
   } else {
-    const long limit = watchdog_secs();
-    auto deadline = std::chrono::steady_clock::now() +
-                    std::chrono::seconds(limit > 0 ? limit : 0);
-    while (!s.done) {
-      if (limit <= 0) {
-        s.cv.wait(lk);
-        continue;
-      }
-      // A slow fn cannot false-abort here: the last arriver executes fn while
-      // holding s.mu, so an expired waiter stays blocked on mutex
-      // reacquisition until fn returns — at which point s.done is true and
-      // the loop exits. A timeout observed with the lock held therefore
-      // means ranks genuinely diverged (arrived < world).
-      if (s.cv.wait_until(lk, deadline) == std::cv_status::timeout && !s.done)
-        die("rendezvous watchdog: rank " + std::to_string(tl_rank) +
-            " stuck in construction-phase call #" + std::to_string(idx) +
-            " (arrived=" + std::to_string(s.arrived) + "/" +
-            std::to_string(g_world) +
-            ") — ranks issued API calls in divergent order");
-    }
+    // A slow fn cannot false-abort here: the last arriver executes fn while
+    // holding s.mu, so an expired waiter stays blocked on mutex
+    // reacquisition until fn returns — at which point s.done is true and
+    // the loop exits. A timeout observed with the lock held therefore
+    // means ranks genuinely diverged (arrived < world).
+    watched_slot_wait(lk, s.cv, [&] { return s.done; }, [&] {
+      return "rendezvous watchdog: rank " + std::to_string(tl_rank) +
+             " stuck in construction-phase call #" + std::to_string(idx) +
+             " (arrived=" + std::to_string(s.arrived) + "/" +
+             std::to_string(g_world) +
+             ") — ranks issued API calls in divergent order";
+    });
   }
   return s.result;
 }
@@ -948,24 +961,140 @@ CommReq* Distribution::AllGatherv(void* sendBuffer, size_t sendCount,
       (int64_t)sendCount);
 }
 
+namespace {
+
+/* Per-call gather of every rank's AlltoAllv count/offset rows into the full
+ * (world, group) tables the engine's per-rank mode consumes (reference MPI
+ * generality: each rank passes its own vectors to pairwise Isend/Irecv,
+ * src/comm_ep.cpp:1188-1265). Keyed by per-rank call sequence like the comm
+ * channels: congruent program order makes the k-th AlltoAllv on every rank
+ * the same exchange. The last arriver computes the uniform staging extents;
+ * the state is kept alive by the issue lambda's shared_ptr. */
+struct A2AVState {
+  std::mutex mu;
+  std::condition_variable cv;
+  int arrived = 0;
+  bool done = false;
+  std::vector<int64_t> sc, soff, rc, roff;  // (world * group), row-major
+  int64_t send_slot = 0, recv_slot = 0;     // uniform staging row extents
+};
+std::map<std::pair<const void*, long>, std::shared_ptr<A2AVState>> g_a2av;
+std::mutex g_a2av_mu;
+thread_local std::unordered_map<const void*, long> tl_a2av_seq;
+
+std::shared_ptr<A2AVState> a2av_state(DistImpl* d, long seq, size_t g) {
+  std::lock_guard<std::mutex> lk(g_a2av_mu);
+  auto key = std::make_pair((const void*)d, seq);
+  auto& sp = g_a2av[key];
+  if (!sp) {
+    sp = std::make_shared<A2AVState>();
+    size_t n = (size_t)g_world * g;
+    sp->sc.assign(n, 0);
+    sp->soff.assign(n, 0);
+    sp->rc.assign(n, 0);
+    sp->roff.assign(n, 0);
+  }
+  return sp;
+}
+
+}  // namespace
+
 CommReq* Distribution::AlltoAllv(void* sendBuffer, size_t* sendCounts,
                                  size_t* sendOffsets, void* recvBuffer,
                                  size_t* recvCounts, size_t* recvOffsets,
                                  DataType dataType, GroupType groupType) {
-  /* reference include/mlsl.hpp:432, in the rank-uniform (1-D, same arrays on
-   * every rank) mode the core's static-matrix emulation supports: member j
-   * receives sendCounts[j] from every peer. recvCounts is accepted for
-   * signature parity; MPI requires it to equal the transposed send counts, so
-   * it carries no independent information — the engine derives the receive
-   * geometry from sendCounts (R = S^T), and a recvCounts that violates the
-   * invariant dies here instead of silently receiving the wrong geometry.
-   * The engine's staging rows are padded to max(sendCounts), so the
-   * write-back into the caller's buffer is capped at THIS rank's MPI-sized
-   * receive extent — a ported program's recvBuffer sized per the reference
-   * contract is never overrun. */
   DistImpl* d = D(this);
   uint64_t h = d->h;
   size_t g = group_size(d, groupType);
+  size_t esz = dt_size(dataType);
+
+  if (recvCounts != nullptr) {
+    /* General per-rank mode (reference include/mlsl.hpp:432 with each rank
+     * passing its OWN arrays — full MPI_Ialltoallv generality): gather every
+     * rank's rows into (world, group) tables, then issue the engine's
+     * per-rank exchange once. The engine validates the MPI pairwise
+     * invariant (recv_counts = transposed send geometry) at setup and fails
+     * loudly on a mismatch — the case the old rank-uniform mode die()d on is
+     * now simply a valid exchange. Missing offsets default to the packed
+     * layout per rank, matching MPI displacement semantics. */
+    long seq = tl_a2av_seq[d]++;
+    auto st = a2av_state(d, seq, g);
+    std::vector<int64_t> myrc(g), myroff(g);
+    int64_t my_send = 0, my_recv = 0;
+    {
+      std::unique_lock<std::mutex> lk(st->mu);
+      int64_t acc_s = 0, acc_r = 0;
+      for (size_t j = 0; j < g; j++) {
+        int64_t s = (int64_t)sendCounts[j];
+        int64_t so = sendOffsets != nullptr ? (int64_t)sendOffsets[j] : acc_s;
+        int64_t r = (int64_t)recvCounts[j];
+        int64_t ro = recvOffsets != nullptr ? (int64_t)recvOffsets[j] : acc_r;
+        acc_s += s;
+        acc_r += r;
+        st->sc[(size_t)tl_rank * g + j] = s;
+        st->soff[(size_t)tl_rank * g + j] = so;
+        st->rc[(size_t)tl_rank * g + j] = r;
+        st->roff[(size_t)tl_rank * g + j] = ro;
+        myrc[j] = r;
+        myroff[j] = ro;
+        my_send = std::max(my_send, so + s);
+        my_recv = std::max(my_recv, ro + r);
+      }
+      st->arrived++;
+      if (st->arrived == g_world) {
+        for (int w = 0; w < g_world; w++) {
+          int64_t se = 0, re = 0;
+          for (size_t j = 0; j < g; j++) {
+            se = std::max(se, st->soff[(size_t)w * g + j] +
+                                  st->sc[(size_t)w * g + j]);
+            re = std::max(re, st->roff[(size_t)w * g + j] +
+                                  st->rc[(size_t)w * g + j]);
+          }
+          st->send_slot = std::max(st->send_slot, se);
+          st->recv_slot = std::max(st->recv_slot, re);
+        }
+        if (st->send_slot == 0) st->send_slot = 1;
+        if (st->recv_slot == 0) st->recv_slot = 1;
+        st->done = true;
+        st->cv.notify_all();
+        std::lock_guard<std::mutex> lk2(g_a2av_mu);
+        g_a2av.erase(std::make_pair((const void*)d, seq));
+      } else {
+        watched_slot_wait(lk, st->cv, [&] { return st->done; }, [&] {
+          return "rendezvous watchdog: rank " + std::to_string(tl_rank) +
+                 " stuck gathering AlltoAllv counts (arrived=" +
+                 std::to_string(st->arrived) + "/" + std::to_string(g_world) +
+                 ") — ranks issued collectives in divergent order";
+        });
+      }
+    }
+    /* block-accurate write-back: copy ONLY this rank's valid blocks; gap
+     * bytes between blocks are left untouched, as MPI guarantees */
+    std::function<void(void*, const char*)> writer =
+        [myrc, myroff, esz, g](void* up, const char* src) {
+          for (size_t j = 0; j < g; j++)
+            std::memcpy((char*)up + (size_t)myroff[j] * esz,
+                        src + (size_t)myroff[j] * esz,
+                        (size_t)myrc[j] * esz);
+        };
+    int64_t send_slot = st->send_slot, recv_slot = st->recv_slot;
+    return generic_start(
+        d, sendBuffer, (size_t)send_slot, dataType, recv_slot, recvBuffer,
+        [h, st, send_slot, dataType, groupType](const void* world) {
+          return mlsl_distribution_all_to_allv_full(
+              h, world, send_slot, st->sc.data(), st->soff.data(),
+              st->rc.data(), st->roff.data(), (mlsl_data_type_t)dataType,
+              (mlsl_group_type_t)groupType);
+        },
+        my_send, my_recv, std::move(writer));
+  }
+
+  /* Legacy rank-uniform (1-D, same arrays on every rank) mode, kept for
+   * callers that pass no recvCounts: member j receives sendCounts[j] from
+   * every peer. The engine's staging rows are padded to max(sendCounts), so
+   * the write-back into the caller's buffer is capped at THIS rank's
+   * MPI-sized receive extent — a ported program's recvBuffer sized per the
+   * reference contract is never overrun. */
   std::vector<int64_t> sc(g), soff, roff;
   int64_t send_len = 0, maxc = 0;
   for (size_t j = 0; j < g; j++) {
@@ -982,21 +1111,10 @@ CommReq* Distribution::AlltoAllv(void* sendBuffer, size_t* sendCounts,
     for (size_t j = 0; j < g; j++) send_len += sc[j];
   }
   /* recv_len is the engine's PADDED staging extent (uniform across ranks);
-   * my_recv is THIS rank's MPI-sized receive extent — the write-back cap, so
-   * a recvBuffer sized per the reference contract is never overrun. */
+   * my_recv is THIS rank's MPI-sized receive extent — the write-back cap. */
   int64_t mine = sc[GetProcessIdx(groupType)];
-  if (recvCounts != nullptr) {
-    /* MPI invariant in rank-uniform mode: I receive sendCounts[myIdx] from
-     * every peer, so every recvCounts entry must equal it */
-    for (size_t j = 0; j < g; j++)
-      if ((int64_t)recvCounts[j] != mine)
-        die("AlltoAllv: recvCounts[" + std::to_string(j) + "] = " +
-            std::to_string(recvCounts[j]) + " violates R = S^T (expected " +
-            std::to_string(mine) + " = sendCounts[myIdx])");
-  }
   int64_t recv_len, my_recv;
   std::function<void(void*, const char*)> writer;  // offset mode only
-  size_t esz = dt_size(dataType);
   if (recvOffsets != nullptr) {
     roff.resize(g);
     int64_t maxoff = 0;

@@ -235,6 +235,78 @@ def test_alltoallv_explicit_recv_counts(env):
         )
 
 
+def _per_rank_a2av_oracle(dist, members, pos, S, soff, roff, R, send_len, out, world):
+    """Expected per-rank alltoallv result: rank p receives, from each member j of
+    its instance, that member's segment toward p's position."""
+    for p in range(world):
+        recv_len = np.asarray(out).shape[-1]
+        expected = np.zeros(recv_len, dtype=np.float32)
+        for jpos, q in enumerate(members[p]):
+            src = np.asarray(q * 100.0 + np.arange(send_len), dtype=np.float32)
+            seg = src[soff[q, pos[p]]: soff[q, pos[p]] + S[q, pos[p]]]
+            expected[roff[p, jpos]: roff[p, jpos] + len(seg)] = seg
+        np.testing.assert_allclose(dist.local_part(out, p), expected)
+
+
+def test_alltoallv_per_rank_instances(env):
+    """Full per-rank MPI AlltoAllv: each world rank passes its OWN (G,) count
+    vector — stacked as (W, G) — so the two MODEL-group instances exchange
+    genuinely different geometries (the reference's pairwise Isend/Irecv
+    generality, src/comm_ep.cpp:1188-1265)."""
+    W, G = 8, 4
+    dist = env.create_distribution(2, G)
+    g = dist._group(GroupType.MODEL)
+    members = group_members(dist, GroupType.MODEL, W)
+    pos = np.array([g.group_idx_of(p) for p in range(W)])
+    # S[w][j] = what world rank w sends to position j of ITS instance; make the
+    # two instances (ranks 0-3 vs 4-7) differ and vary within each instance
+    S = np.array([[(w * 7 + 3 * j) % 4 + (w >= G) for j in range(G)]
+                  for w in range(W)])
+    soff = np.hstack([np.zeros((W, 1), int), np.cumsum(S, axis=1)[:, :-1]])
+    # R[w][j] = S[members[w][j]][pos[w]] (the MPI pairwise invariant)
+    R = np.array([[S[members[w][j], pos[w]] for j in range(G)] for w in range(W)])
+    roff = np.hstack([np.zeros((W, 1), int), np.cumsum(R, axis=1)[:, :-1]])
+    send_len = int(S.sum(axis=1).max())
+    buf = dist.make_buffer(
+        lambda p: p * 100.0 + np.arange(send_len, dtype=np.float64), send_len
+    )
+    out = env.wait(
+        dist.all_to_allv(buf, S, soff, R, roff, DataType.FLOAT, GroupType.MODEL)
+    )
+    _per_rank_a2av_oracle(dist, members, pos, S, soff, roff, R, send_len, out, W)
+
+    # a recv_counts row violating the pairwise invariant is rejected at setup
+    bad = R.copy()
+    bad[3, 1] += 1
+    with pytest.raises(MLSLError):
+        dist.all_to_allv(buf, S, soff, bad, roff, DataType.FLOAT, GroupType.MODEL)
+
+
+def test_alltoallv_per_rank_color_groups(env):
+    """Per-rank counts on equal-size COLOR groups (evens | odds): the flat-mesh
+    subgroup path selects each rank's instance matrices by world rank."""
+    W = 8
+    G = 4
+    data_colors = tuple(p % 2 for p in range(W))   # two strided groups of 4
+    model_colors = tuple(p // 4 for p in range(W))
+    dist = env.create_distribution_with_colors(data_colors, model_colors)
+    g = dist._group(GroupType.DATA)
+    members = group_members(dist, GroupType.DATA, W)
+    pos = np.array([g.group_idx_of(p) for p in range(W)])
+    S = np.array([[(w + 2 * j) % 3 + (w % 2) for j in range(G)] for w in range(W)])
+    soff = np.hstack([np.zeros((W, 1), int), np.cumsum(S, axis=1)[:, :-1]])
+    R = np.array([[S[members[w][j], pos[w]] for j in range(G)] for w in range(W)])
+    roff = np.hstack([np.zeros((W, 1), int), np.cumsum(R, axis=1)[:, :-1]])
+    send_len = int(S.sum(axis=1).max())
+    buf = dist.make_buffer(
+        lambda p: p * 100.0 + np.arange(send_len, dtype=np.float64), send_len
+    )
+    out = env.wait(
+        dist.all_to_allv(buf, S, soff, R, roff, DataType.FLOAT, GroupType.DATA)
+    )
+    _per_rank_a2av_oracle(dist, members, pos, S, soff, roff, R, send_len, out, W)
+
+
 def test_alltoallv_zero_counts_emulate_subgroups(env):
     """docs/DESIGN.md 'Ragged color groups' tells users to spell a ragged
     alltoallv as zero counts on an equal-size group: pairs across the logical
