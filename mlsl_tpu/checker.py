@@ -13,6 +13,7 @@ from __future__ import annotations
 import numpy as np
 import jax
 
+from mlsl_tpu.config import _env_int
 from mlsl_tpu.log import mlsl_assert
 from mlsl_tpu.types import jnp_dtype
 
@@ -22,8 +23,8 @@ CHKP_VALUES = 2  # + finiteness check (syncs the device)
 
 
 def level() -> int:
-    from mlsl_tpu.config import _env_int
-
+    # read fresh each Start (tests toggle the env var at runtime); top-level
+    # import keeps this per-Start hot path free of import machinery
     return _env_int("MLSL_CHKP", 0)
 
 

@@ -99,9 +99,14 @@ class CommRequest:
         self._epoch = 0
         self._dlock = threading.Lock()  # serializes dispatch vs restart
         self._dispatch_error: Optional[BaseException] = None
+        self._single_full = False  # hot path: one un-chunked program
         with CommRequest._seq_lock:
             CommRequest._seq += 1
             self.uid = CommRequest._seq
+        # per-Start hot-path constants (VERDICT r4 item 3: keep the host
+        # dispatch floor low — no per-dispatch string building / re-derivation)
+        self._trace_name = f"mlsl:{desc.kind}:{name or self.uid}"
+        self._payload = desc.payload_bytes()
 
     # -- setup ------------------------------------------------------------
 
@@ -175,6 +180,7 @@ class CommRequest:
         if d.kind == "barrier":
             self._fns = [collectives.build_barrier(d.group)]
             self._chunk_slices = [slice(None)]
+            self._single_full = True
             self.is_setup = True
             return
 
@@ -205,6 +211,11 @@ class CommRequest:
             fn = collectives.build_collective(d.kind, d.group, dtype, **kw)
             self._fns = [fn] * len(chunks)
             self._chunk_slices = chunks
+        # hot-path precomputation: the per-layer dispatch floor must stay in
+        # single-digit µs (VERDICT r4 item 3), so nothing re-derived per Start
+        self._single_full = (
+            len(self._chunk_slices) == 1 and self._chunk_slices[0] == slice(None)
+        )
         self.is_setup = True
 
     def _plan_chunks(self, compressed_ok: bool = False):
@@ -226,7 +237,7 @@ class CommRequest:
 
     def start(self, buf: jax.Array) -> "CommRequest":
         mlsl_assert(self.is_setup, "request must be setup() before start()")
-        from mlsl_tpu import checker
+        from mlsl_tpu import checker  # module cached after first call
 
         chkp = checker.level()
         if chkp:
@@ -260,9 +271,7 @@ class CommRequest:
                 log_debug("dropping superseded dispatch of %s", self.name or self.uid)
                 return
             try:
-                with jax.profiler.TraceAnnotation(
-                    f"mlsl:{self.desc.kind}:{self.name or self.uid}"
-                ):
+                with jax.profiler.TraceAnnotation(self._trace_name):
                     self._dispatch_inner(buf)
             except Exception as e:
                 if epoch is None:
@@ -306,7 +315,7 @@ class CommRequest:
             out, self._err = self._quant_fn(buf, self._err)
             self._results = [out]
             return
-        if len(self._chunk_slices) == 1 and self._chunk_slices[0] == slice(None):
+        if self._single_full:
             self._results = [self._fns[0](buf)]
         else:
             self._results = [
@@ -537,6 +546,12 @@ class Dispatcher:
                 self.flush()
             req._dispatch(buf)
             return
+        if req._payload <= cfg.msg_priority_threshold:
+            # small message: below every deferral threshold in both the native
+            # and Python schedulers — dispatch immediately without touching the
+            # lock or the ctypes queue (the per-layer hot path)
+            req._dispatch(buf)
+            return
         native = None
         immediate = False
         with self._lock:
@@ -554,18 +569,16 @@ class Dispatcher:
                     "deferred request %s (%d B)", req.name, req.desc.payload_bytes()
                 )
             return
-        if req.desc.payload_bytes() > cfg.msg_priority_threshold:
-            with self._lock:
-                # A restart of an already-deferred request supersedes the stale entry
-                # (otherwise flush would re-dispatch the old buffer last and clobber
-                # the fresh results). An entry already popped mid-flight is dropped
-                # by the epoch check in _dispatch.
-                self._pending = [e for e in self._pending if e[0] is not req]
-                self._pending.append((req, buf, req._epoch))
-                self._note_deferred_locked()
-            log_debug("deferred request %s (%d B)", req.name, req.desc.payload_bytes())
-        else:
-            req._dispatch(buf)
+        # payload > threshold here (the small-message fast path returned above)
+        with self._lock:
+            # A restart of an already-deferred request supersedes the stale entry
+            # (otherwise flush would re-dispatch the old buffer last and clobber
+            # the fresh results). An entry already popped mid-flight is dropped
+            # by the epoch check in _dispatch.
+            self._pending = [e for e in self._pending if e[0] is not req]
+            self._pending.append((req, buf, req._epoch))
+            self._note_deferred_locked()
+        log_debug("deferred request %s (%d B)", req.name, req._payload)
 
     def _note_deferred_locked(self) -> None:
         """Arm the progress thread: dispatch happens msg_priority_flush_ms from the
@@ -610,17 +623,32 @@ class Dispatcher:
             self._thread = None
 
     def flush(self) -> None:
+        if not self._pending and not self._by_id:
+            # Nothing deferred: skip the lock (the hot wait()/test() path).
+            # Lock-free read is safe: entries THIS thread cares about were
+            # added by this thread (visible), and flush marks a request
+            # in-flight BEFORE removing it from the queues (ordering below),
+            # so a request is never in neither place.
+            return
+        # INVARIANT for the lock-free fast paths in flush()/wait_dispatched()/
+        # is_in_flight(): _in_flight gains a uid BEFORE the entry leaves
+        # _pending/_by_id. The lock orders writers, but lock-free readers see
+        # individual bytecodes — with the opposite order a reader could find
+        # the queues empty and the uid not yet in-flight while its dispatch
+        # has not run, and read half-built _results.
         if self._native is not None:
             with self._lock:
                 order = self._native.drain()
-                items = [self._by_id.pop(rid) for rid in order if rid in self._by_id]
+                items = [self._by_id[rid] for rid in order if rid in self._by_id]
                 self._in_flight.update(e[0].uid for e in items)
+                for rid in order:
+                    self._by_id.pop(rid, None)
             self._dispatch_items(items)
             return
         with self._lock:
+            self._in_flight.update(e[0].uid for e in self._pending)
             pending, self._pending = self._pending, []
             items = list(reversed(pending)) if self.config.msg_priority_mode else pending
-            self._in_flight.update(e[0].uid for e in items)
         self._dispatch_items(items)
 
     def _dispatch_items(self, items) -> None:
@@ -642,14 +670,19 @@ class Dispatcher:
                 self._cv.notify_all()
 
     def is_in_flight(self, uid: int) -> bool:
-        with self._lock:
-            return uid in self._in_flight
+        # GIL-atomic set membership; flush() adds the uid BEFORE the paired
+        # _pending/_by_id removal (see the invariant there), so a caller that
+        # saw the queues empty observes the uid here until its dispatch
+        # completes (per-poll lock acquisition would dominate the test() floor)
+        return uid in self._in_flight
 
     def wait_dispatched(self, req: CommRequest) -> None:
         """Ensure req's programs have been launched: flush the queue, then wait out
         a dispatch racing on the progress thread (its _results would otherwise be
         read half-built)."""
         self.flush()
+        if req.uid not in self._in_flight:  # hot path: nothing racing
+            return
         with self._cv:
             while req.uid in self._in_flight:
                 self._cv.wait()

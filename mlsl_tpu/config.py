@@ -31,6 +31,18 @@ def _env_bool(name: str, default: bool) -> bool:
     return v.strip().lower() not in ("0", "false", "no", "off")
 
 
+# env var -> Config field, for the explicit-override bookkeeping in from_env
+# (auto_config must never clobber a knob the user exported)
+_ENV_FIELDS = {
+    "MLSL_LARGE_MSG_SIZE_MB": "large_msg_size_mb",
+    "MLSL_LARGE_MSG_CHUNKS": "large_msg_chunks",
+    "MLSL_MSG_PRIORITY_THRESHOLD": "msg_priority_threshold",
+    "MLSL_MSG_PRIORITY_FLUSH_MS": "msg_priority_flush_ms",
+    "MLSL_GATHER_DEVICE_LIMIT_MB": "gather_device_limit_mb",
+    "MLSL_NUM_SERVERS": "num_servers",
+}
+
+
 @dataclasses.dataclass
 class Config:
     # --- core tier (reference src/env.cpp:26-40) ---
@@ -51,6 +63,11 @@ class Config:
     large_msg_size_mb: int = 128    # MLSL_LARGE_MSG_SIZE_MB
     large_msg_chunks: int = 4       # MLSL_LARGE_MSG_CHUNKS
     max_short_msg_size: int = 0     # MLSL_MAX_SHORT_MSG_SIZE
+    # Per-device output cap (MiB) for the device-side rooted gather, whose
+    # rank-uniform SPMD result replicates the concatenation on every member
+    # (docs/DESIGN.md 'Rooted gather'); larger gathers must use
+    # gather_to_host (host delivery, no device footprint). 0 = unlimited.
+    gather_device_limit_mb: int = 1024  # MLSL_GATHER_DEVICE_LIMIT_MB
 
     # --- priority scheduling (reference eplib/env.c:135-165, allreduce_pr.c) ---
     msg_priority: bool = False        # MLSL_MSG_PRIORITY: newest-first dispatch
@@ -84,6 +101,13 @@ class Config:
     @staticmethod
     def from_env() -> "Config":
         c = Config()
+        # Record which knobs the user set EXPLICITLY via MLSL_* env vars:
+        # sysinfo.auto_config tunes only the others (explicit always wins,
+        # mirroring the reference where MLSL_AUTO_CONFIG never overrides a
+        # user-exported variable, src/mlsl.cpp:649-682).
+        c._explicit = {
+            field for env, field in _ENV_FIELDS.items() if os.environ.get(env)
+        }
         c.log_level = _env_int("MLSL_LOG_LEVEL", c.log_level)
         c.dup_group = _env_bool("MLSL_DUP_GROUP", c.dup_group)
         c.enable_stats = _env_bool("MLSL_STATS", c.enable_stats)
@@ -92,6 +116,9 @@ class Config:
         c.large_msg_size_mb = _env_int("MLSL_LARGE_MSG_SIZE_MB", c.large_msg_size_mb)
         c.large_msg_chunks = _env_int("MLSL_LARGE_MSG_CHUNKS", c.large_msg_chunks)
         c.max_short_msg_size = _env_int("MLSL_MAX_SHORT_MSG_SIZE", c.max_short_msg_size)
+        c.gather_device_limit_mb = _env_int(
+            "MLSL_GATHER_DEVICE_LIMIT_MB", c.gather_device_limit_mb
+        )
         c.msg_priority = _env_bool("MLSL_MSG_PRIORITY", c.msg_priority)
         c.msg_priority_threshold = _env_int(
             "MLSL_MSG_PRIORITY_THRESHOLD", c.msg_priority_threshold

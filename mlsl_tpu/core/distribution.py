@@ -35,7 +35,9 @@ from mlsl_tpu.comm.mesh import (
 )
 from mlsl_tpu.comm.request import CommDesc, CommRequest, ComputeType
 from mlsl_tpu.log import mlsl_assert
-from mlsl_tpu.types import DataType, GroupType, ReductionType, jnp_dtype
+from mlsl_tpu.types import (
+    DataType, GroupType, ReductionType, dtype_size, jnp_dtype,
+)
 
 
 class Distribution:
@@ -292,16 +294,83 @@ class Distribution:
         )
 
     def gather(self, send_buffer, send_count, data_type, root_idx, group_type) -> CommRequest:
+        """Device-side rooted gather. SPMD buffers are rank-uniform, so the
+        result buffer spans (G * send_count) on EVERY member — an HBM superset
+        over MPI's root-only delivery (reference src/comm_ep.cpp:1011-1120)
+        that is structural to single-program shard_map (docs/DESIGN.md,
+        'Rooted gather and the memory contract'). Above
+        MLSL_GATHER_DEVICE_LIMIT_MB (per-device output bytes) it is rejected
+        in favor of gather_to_host, which has no device footprint at all."""
+        g = self._group(group_type)
+        gsize = 1 if g.is_self else g.size
+        cfg = getattr(self.env, "config", None)
+        limit = getattr(cfg, "gather_device_limit_mb", 0) if cfg else 0
+        out_bytes = gsize * int(send_count) * dtype_size(DataType(data_type))
+        mlsl_assert(
+            limit <= 0 or out_bytes <= limit * 1024 * 1024,
+            "gather output (%d MiB per device; rank-uniform SPMD buffers "
+            "replicate the concatenation on every member) exceeds "
+            "MLSL_GATHER_DEVICE_LIMIT_MB=%d — use gather_to_host for "
+            "root-delivered results with no device footprint",
+            out_bytes >> 20, limit,
+        )
         return self._start(
             CommDesc(
                 "gather",
-                self._group(group_type),
+                g,
                 int(send_count),
                 DataType(data_type),
                 root=int(root_idx),
             ),
             send_buffer,
         )
+
+    def gather_to_host(self, send_buffer, send_count, data_type, root_idx,
+                       group_type) -> dict:
+        """Rooted gather with HOST delivery: {root_world_rank: np.ndarray(G*n)}
+        per group instance.
+
+        The TPU-native rooted contract: in a single-controller SPMD program a
+        rooted result is consumed by the controller (or written back to one
+        rank's user buffer, as the compat layer does), so the concatenation is
+        assembled on the host from the already-distributed blocks — ZERO
+        device-side wire traffic and ZERO extra HBM, strictly less data motion
+        than the reference's network gather (src/comm_ep.cpp:1011-1120). The
+        device path (``gather``) stays available for results that feed device
+        computation, at the documented rank-uniform HBM cost. Works on ragged
+        color groups too (host assembly needs no padding).
+
+        Multi-process: needs other hosts' shards, so (like every MPI gather)
+        EVERY process must call it; remote blocks ride one DCN all-gather to
+        each host — the same G*n the reference's network gather moves
+        (src/comm_ep.cpp:1011-1120), still with zero HBM superset."""
+        g = self._group(group_type)
+        world = self.topology.world_size
+        if getattr(send_buffer, "is_fully_addressable", True):
+            host = np.asarray(send_buffer)
+        else:
+            from jax.experimental import multihost_utils
+
+            host = multihost_utils.process_allgather(send_buffer, tiled=True)
+        host = np.asarray(host).reshape(world, -1)[:, : int(send_count)]
+        if g.is_self:
+            return {p: host[p].copy() for p in range(world)}
+        if g.colors is not None:
+            rows = [g.member_world_ranks(c) for c in sorted(set(g.colors))]
+        else:
+            from mlsl_tpu.comm.collectives import _axis_groups_tbl
+
+            rows = list(_axis_groups_tbl(g))
+        out = {}
+        for row in rows:
+            mlsl_assert(
+                int(root_idx) < len(row),
+                "root member index %d out of range for group of size %d",
+                int(root_idx), len(row),
+            )
+            root_w = int(row[int(root_idx)])
+            out[root_w] = np.concatenate([host[q] for q in row])
+        return out
 
     def all_gather(self, send_buffer, send_count, data_type, group_type) -> CommRequest:
         return self._start(
@@ -414,6 +483,7 @@ class Distribution:
     AlltoAll = all_to_all
     AlltoAllv = all_to_allv
     Gather = gather
+    GatherToHost = gather_to_host
     AllGather = all_gather
     AllGatherv = all_gatherv
     Scatter = scatter

@@ -58,17 +58,75 @@ def probe() -> SysInfo:
     )
 
 
-def auto_config(config) -> None:
-    """Adjust config defaults from probed hardware (reference src/mlsl.cpp:649-682).
+def device_class(si: SysInfo) -> str:
+    """Coarse tuning class from the probed device kind (the analog of the
+    reference's Xeon-vs-Phi x ETH-vs-MLX-vs-HFI matrix, src/sysinfo.hpp:27-48):
 
-    The reference bumps MLSL_LARGE_MSG_CHUNKS on Ethernet; the TPU analog keys on
-    platform: on real TPU keep few large chunks (ICI is fast, dispatch overhead
-    dominates); on CPU simulation keep chunking minimal so tests stay cheap.
+    - 'tpu-performance': v4/v5p-class (3D-torus ICI, wide links) — dispatch
+      overhead dominates; defer only genuinely large messages, few chunks.
+    - 'tpu-efficiency': v5e/v6e-class ('lite' kinds; 2D-torus, narrower links)
+      — collectives are slower relative to compute; defer earlier and chunk
+      more so Waits can complete (and overlap) incrementally.
+    - 'host-sim': CPU/GPU simulation meshes — keep chunking off so tests stay
+      cheap and deterministic.
     """
+    if si.platform != "tpu":
+        return "host-sim"
+    k = si.device_kind.lower()
+    if "lite" in k or "v5e" in k or "v6e" in k:
+        return "tpu-efficiency"
+    return "tpu-performance"
+
+
+# Per-class knob defaults applied by auto_config (each may be further keyed on
+# probed HBM below). Values are design-rule settings pending on-chip tuning —
+# the table exists so the tuning has one place to land, and so v5e-class and
+# host-sim probes demonstrably pick different dispatch policies.
+_CLASS_DEFAULTS = {
+    "tpu-performance": dict(
+        msg_priority_threshold=1 << 20,   # defer only >1 MiB
+        msg_priority_flush_ms=1.0,        # fast dispatch: short coalescing
+        large_msg_size_mb=128,
+        large_msg_chunks=4,
+    ),
+    "tpu-efficiency": dict(
+        msg_priority_threshold=1 << 18,   # defer >256 KiB: narrower ICI
+        msg_priority_flush_ms=2.0,
+        large_msg_size_mb=64,             # chunk earlier
+        large_msg_chunks=4,
+    ),
+    "host-sim": dict(
+        msg_priority_threshold=10000,
+        msg_priority_flush_ms=2.0,
+        large_msg_size_mb=128,
+        large_msg_chunks=1,               # chunking only costs on a sim mesh
+    ),
+}
+
+
+def auto_config(config) -> None:
+    """Adjust config defaults from probed hardware (reference AutoConfig,
+    src/mlsl.cpp:649-682): pick the device-class row from _CLASS_DEFAULTS,
+    then key memory-sensitive knobs on probed per-device HBM. Knobs the user
+    exported explicitly (Config._explicit, tracked by from_env) are NEVER
+    overridden — same contract as the reference, where AutoConfig fills only
+    unset variables. Gated on MLSL_AUTO_CONFIG_TYPE != 0."""
     si = probe()
     if config.auto_config_type == 0:
         return
-    if si.platform == "tpu":
-        config.large_msg_chunks = max(config.large_msg_chunks, 4)
-    else:
-        config.large_msg_chunks = 1
+    tuned = dict(_CLASS_DEFAULTS[device_class(si)])
+    if si.memory_per_device:
+        # one deferred chunk should stay under ~1.5% of per-device HBM so a
+        # chunked large allreduce never spikes transient memory
+        cap_mb = max(8, si.memory_per_device // (64 * 1024 * 1024))
+        tuned["large_msg_size_mb"] = min(tuned["large_msg_size_mb"], cap_mb)
+        # the device-gather cap scales with the actual HBM: a quarter of the
+        # chip, rather than a fixed 1 GiB, keeps the contract meaningful on
+        # both 16 GiB v5e and 95 GiB v5p
+        tuned["gather_device_limit_mb"] = max(
+            256, si.memory_per_device // (4 * 1024 * 1024)
+        )
+    explicit = getattr(config, "_explicit", set())
+    for k, v in tuned.items():
+        if k not in explicit:
+            setattr(config, k, v)

@@ -1,0 +1,28 @@
+#!/bin/sh
+# One-command setup from a fresh clone (the analog of the reference's
+# scripts/install.sh): editable-install the Python package and prebuild the
+# native runtime (C API .so, MLSL-compat runtime, test binaries). The native
+# build is optional — mlsl_tpu auto-builds libmlsl_core.so lazily on first
+# use and degrades to pure-Python paths without a toolchain.
+#
+# Usage:  sh scripts/install.sh          # install + native build
+#         sh scripts/install.sh --no-native
+set -e
+ROOT="$(cd "$(dirname "$0")/.." && pwd)"
+
+echo "mlsl_tpu install: pip install -e ${ROOT}"
+# --no-build-isolation: use the environment's setuptools (works offline)
+python -m pip install --no-build-isolation --no-deps -e "${ROOT}"
+
+if [ "${1:-}" != "--no-native" ]; then
+  if command -v g++ >/dev/null 2>&1; then
+    echo "mlsl_tpu install: building native runtime (native/)"
+    make -s -C "${ROOT}/native"
+  else
+    echo "mlsl_tpu install: no g++ found; skipping native build" >&2
+    echo "  (pure-Python paths remain fully functional)" >&2
+  fi
+fi
+
+echo "mlsl_tpu install: done. Optional env setup:"
+echo "  source ${ROOT}/scripts/mlsltpuvars.sh [tpu|cpusim]"

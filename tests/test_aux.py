@@ -237,3 +237,111 @@ print("CACHE_RUN_OK")
             assert _jax.config.jax_compilation_cache_dir == before
         finally:
             e.finalize()
+
+
+class TestAutoConfig:
+    """auto_config keys dispatch knobs on the probed device class + HBM
+    (reference AutoConfig src/mlsl.cpp:649-682); explicit MLSL_* env always
+    wins (VERDICT r4 item 7)."""
+
+    V5E = None  # built in _si to avoid import at collection time
+
+    def _si(self, platform, kind, mem):
+        from mlsl_tpu import sysinfo
+
+        return sysinfo.SysInfo(platform=platform, device_kind=kind,
+                               num_devices=8, num_hosts=1,
+                               memory_per_device=mem)
+
+    def _tuned(self, monkeypatch, si, env_vars=()):
+        from mlsl_tpu import sysinfo
+        from mlsl_tpu.config import Config
+
+        for k, v in env_vars:
+            monkeypatch.setenv(k, v)
+        c = Config.from_env()
+        c.auto_config_type = 1
+        monkeypatch.setattr(sysinfo, "probe", lambda: si)
+        sysinfo.auto_config(c)
+        return c
+
+    def test_classes_differ(self, monkeypatch):
+        from mlsl_tpu import sysinfo
+
+        v5e = self._si("tpu", "TPU v5 lite", 16 * 2**30)
+        v5p = self._si("tpu", "TPU v5p", 95 * 2**30)
+        cpu = self._si("cpu", "cpu", 0)
+        assert sysinfo.device_class(v5e) == "tpu-efficiency"
+        assert sysinfo.device_class(v5p) == "tpu-performance"
+        assert sysinfo.device_class(cpu) == "host-sim"
+        ce = self._tuned(monkeypatch, v5e)
+        cp = self._tuned(monkeypatch, v5p)
+        cc = self._tuned(monkeypatch, cpu)
+        # v5e defers earlier than v5p; both differ from the CPU sim defaults
+        assert ce.msg_priority_threshold < cp.msg_priority_threshold
+        assert ce.msg_priority_threshold != cc.msg_priority_threshold
+        assert cc.large_msg_chunks == 1 and ce.large_msg_chunks == 4
+        # HBM-keyed: gather cap is a quarter of the chip, chunk size bounded
+        assert ce.gather_device_limit_mb == 4096       # 16 GiB / 4
+        assert cp.gather_device_limit_mb == 95 * 1024 // 4
+        assert ce.large_msg_size_mb <= 64
+
+    def test_explicit_env_wins(self, monkeypatch):
+        v5e = self._si("tpu", "TPU v5 lite", 16 * 2**30)
+        c = self._tuned(monkeypatch, v5e,
+                        env_vars=[("MLSL_MSG_PRIORITY_THRESHOLD", "777")])
+        assert c.msg_priority_threshold == 777         # user export untouched
+        assert c.msg_priority_flush_ms == 2.0          # others still tuned
+        assert c.gather_device_limit_mb == 4096
+
+    def test_gate_off_by_default(self, monkeypatch):
+        from mlsl_tpu import sysinfo
+        from mlsl_tpu.config import Config
+
+        c = Config.from_env()
+        monkeypatch.setattr(
+            sysinfo, "probe",
+            lambda: self._si("tpu", "TPU v5 lite", 16 * 2**30),
+        )
+        before = dataclasses_asdict_safe(c)
+        sysinfo.auto_config(c)  # auto_config_type defaults to 0: no-op
+        assert dataclasses_asdict_safe(c) == before
+
+
+def dataclasses_asdict_safe(c):
+    import dataclasses as _d
+
+    return {f.name: getattr(c, f.name) for f in _d.fields(c)}
+
+
+class TestPackaging:
+    """Install-story parity (reference scripts/install.sh + Makefile staging
+    targets): the package must build a valid wheel OFFLINE from a clean
+    checkout, with the library packaged and tests/benchmarks excluded."""
+
+    @pytest.mark.slow
+    def test_wheel_builds_offline(self, tmp_path):
+        import glob
+        import subprocess
+        import sys
+        import zipfile
+
+        repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        run = subprocess.run(
+            [sys.executable, "-m", "pip", "wheel", ".", "--no-deps",
+             "--no-build-isolation", "-w", str(tmp_path)],
+            cwd=repo, capture_output=True, text=True, timeout=300,
+        )
+        assert run.returncode == 0, run.stderr[-2000:]
+        wheels = glob.glob(str(tmp_path / "*.whl"))
+        assert len(wheels) == 1
+        names = zipfile.ZipFile(wheels[0]).namelist()
+        assert "mlsl_tpu/__init__.py" in names
+        assert any(n.startswith("mlsl_tpu/comm/") for n in names)
+        assert any(n.startswith("mlsl_tpu/models/") for n in names)
+        assert not any(n.startswith(("tests/", "benchmarks/")) for n in names)
+
+    def test_install_script_present(self):
+        repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        path = os.path.join(repo, "scripts", "install.sh")
+        assert os.path.exists(path) and os.access(path, os.X_OK)

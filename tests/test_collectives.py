@@ -133,6 +133,57 @@ def test_gather_and_scatter(env, root):
         np.testing.assert_allclose(dist.local_part(sout, p), root_buf[p * 4 : (p + 1) * 4])
 
 
+def test_gather_to_host(env):
+    """Root-delivered gather with NO device program: the concatenation is
+    assembled host-side per group instance (the TPU-native rooted memory
+    contract, docs/DESIGN.md 'Rooted gather'); non-root members never hold it
+    anywhere, and no collective is compiled at all."""
+    from mlsl_tpu.comm import collectives
+
+    dist = env.create_distribution(2, 4)
+    buf = fill(dist)
+    before = set(collectives._cache.keys())
+    out = dist.gather_to_host(buf, N, DataType.FLOAT, 1, GroupType.MODEL)
+    # no new device program of any kind was built for the host path
+    assert set(collectives._cache.keys()) == before
+    host = lambda p: np.asarray(p * 1000.0 + np.arange(N), dtype=np.float32)
+    # two model instances {0..3} and {4..7}; root member index 1 -> ranks 1, 5
+    assert set(out.keys()) == {1, 5}
+    np.testing.assert_allclose(out[1], np.concatenate([host(q) for q in range(4)]))
+    np.testing.assert_allclose(out[5], np.concatenate([host(q) for q in range(4, 8)]))
+
+
+def test_gather_to_host_ragged_colors(env):
+    """Host delivery needs no padding, so ragged color groups work directly."""
+    data_colors = (0, 0, 0, 1, 1, 1, 1, 1)   # sizes 3 and 5
+    dist = env.create_distribution_with_colors(data_colors, tuple([0] * 8))
+    buf = fill(dist)
+    out = dist.gather_to_host(buf, N, DataType.FLOAT, 0, GroupType.DATA)
+    host = lambda p: np.asarray(p * 1000.0 + np.arange(N), dtype=np.float32)
+    assert set(out.keys()) == {0, 3}
+    np.testing.assert_allclose(out[0], np.concatenate([host(q) for q in range(3)]))
+    np.testing.assert_allclose(out[3], np.concatenate([host(q) for q in range(3, 8)]))
+    assert out[0].shape == (3 * N,) and out[3].shape == (5 * N,)
+
+
+def test_gather_device_limit(env):
+    """Device-side gathers whose rank-uniform output would exceed the HBM cap
+    are rejected with a pointer to gather_to_host."""
+    dist = env.create_distribution(1, 8)
+    count = 40_000  # 8 * 40k * 4 B = 1.22 MiB output per device
+    buf = fill(dist, count=count)
+    old = env.config.gather_device_limit_mb
+    env.config.gather_device_limit_mb = 1
+    try:
+        with pytest.raises(MLSLError, match="gather_to_host"):
+            dist.gather(buf, count, DataType.FLOAT, 0, GroupType.MODEL)
+    finally:
+        env.config.gather_device_limit_mb = old
+    # host delivery at the same size is fine
+    out = dist.gather_to_host(buf, count, DataType.FLOAT, 0, GroupType.MODEL)
+    assert out[0].shape == (8 * count,)
+
+
 @pytest.mark.parametrize("grid", [(2, 4), (1, 8)])
 @pytest.mark.parametrize("gt", [GroupType.MODEL, GroupType.DATA])
 def test_reduce_scatter(env, grid, gt):

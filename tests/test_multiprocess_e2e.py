@@ -1,12 +1,15 @@
 """Multi-process e2e graph matrix: the reference's mlsl_test phases under
-jax.distributed (2 processes x 4 devices = one 8-device world over gloo).
+jax.distributed — 2 processes x 4 devices AND 4 processes x 2 devices, both
+one 8-device world over gloo (the reference's canonical matrix runs at 4
+ranks: mpiexec -n 4, tests/examples/mlsl_test/Makefile:56-105).
 
-The reference runs its entire correctness matrix multi-process
-(tests/examples/mlsl_test/Makefile:56-105, mpiexec -n 4); the single-process
-version of these phases lives in test_e2e_graph.py. Here each OS process owns 4
-virtual CPU devices, and every closed-form oracle is checked on the ranks whose
-shards are addressable from that process — so both processes together cover all
-8 ranks, with cross-process collectives riding the gloo DCN analog.
+The single-process version of these phases lives in test_e2e_graph.py. Here
+each OS process owns its addressable slice of the virtual CPU devices, and
+every closed-form oracle is checked on the ranks whose shards are addressable
+from that process — all processes together cover all 8 ranks, with
+cross-process collectives riding the gloo DCN analog. The 4-process run also
+pins the DCN/ICI hierarchy contract: model groups stay within one process
+(host), the gradient/data groups span every process.
 """
 
 import os
@@ -18,8 +21,9 @@ import pytest
 
 WORKER = r'''
 import os, sys
-pid, port = int(sys.argv[1]), sys.argv[2]
-os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
+pid, port, nproc = int(sys.argv[1]), sys.argv[2], int(sys.argv[3])
+ndev = 8 // nproc
+os.environ["XLA_FLAGS"] = f"--xla_force_host_platform_device_count={ndev}"
 import jax
 jax.config.update("jax_platforms", "cpu")
 jax.config.update("jax_cpu_collectives_implementation", "gloo")
@@ -29,9 +33,10 @@ from mlsl_tpu.core.activation import pack_local, unpack_local
 from mlsl_tpu.types import CompressionType, DataType, GroupType, OpType, ReductionType
 
 env = mlsl.Environment.get_env().init(
-    coordinator_address=f"127.0.0.1:{port}", num_processes=2, process_id=pid
+    coordinator_address=f"127.0.0.1:{port}", num_processes=nproc, process_id=pid
 )
-assert jax.process_count() == 2
+assert jax.process_count() == nproc
+OWN = 8 // nproc  # ranks whose shards this process can check
 
 MB = 8
 FM1, FM2 = 16, 8
@@ -91,6 +96,33 @@ def model_members(dist, p):
 # ---- phase loop (reference mlsl_test.cpp:660-698) on a 4x2 hybrid grid ----
 model_parts = 2
 dist = env.create_distribution(8 // model_parts, model_parts)
+
+# DCN/ICI hierarchy contract (SURVEY aux: model groups must ride intra-host
+# links, only the data axis crosses hosts): every model group's devices live
+# in ONE process; every gradient (data) group spans ALL processes.
+devs = dist.topology.mesh.devices
+for p in range(8):
+    _, members = model_members(dist, p)
+    mprocs = {devs[dist.topology.coords(q)].process_index for q in members}
+    assert len(mprocs) == 1, f"model group of {p} crosses hosts: {mprocs}"
+    gmembers = [q for q in range(8)
+                if dist.topology.coords(q)[0] == dist.topology.coords(p)[0]
+                and dist.topology.coords(q)[3] == dist.topology.coords(p)[3]]
+    gprocs = {devs[dist.topology.coords(q)].process_index for q in gmembers}
+    assert len(gprocs) == nproc, f"grad group of {p} spans {gprocs}, want all {nproc}"
+print(f"proc {pid} hierarchy OK", flush=True)
+
+# Rooted host-delivered gather across processes (docs/DESIGN.md 'Rooted
+# gather'): remote blocks ride one DCN all-gather; every process assembles
+# each instance's concatenation with zero device-side HBM superset.
+gh_buf = dist.make_buffer(lambda p: rank_fill(p, 8), 8)
+gh = dist.gather_to_host(gh_buf, 8, DataType.FLOAT, 1, GroupType.MODEL)
+for p in range(0, 8, model_parts):
+    _, ms = model_members(dist, p)
+    want = np.concatenate([rank_fill(q, 8) for q in ms])
+    np.testing.assert_allclose(gh[ms[1]], want)
+assert len(gh) == 8 // model_parts
+print(f"proc {pid} gather_to_host OK", flush=True)
 s, op1, op2 = build_net(dist)
 out_act, in_act = op1.get_output(0), op2.get_input(0)
 ps1 = op1.get_parameter_set(0)
@@ -144,8 +176,8 @@ for it in range(2):
         if got is not None:
             np.testing.assert_allclose(np.asarray(got, np.float64), want, rtol=1e-6)
             checked_upd += 1
-# each process owns 4 of 8 ranks, 2 iterations
-assert checked_fwd == 8 and checked_bwd == 8 and checked_upd == 8, (
+# each process owns OWN of 8 ranks, 2 iterations
+assert checked_fwd == 2 * OWN and checked_bwd == 2 * OWN and checked_upd == 2 * OWN, (
     checked_fwd, checked_bwd, checked_upd)
 print(f"proc {pid} phase loop OK", flush=True)
 
@@ -190,7 +222,7 @@ for mp in (1, 2, 8):
                     np.testing.assert_allclose(
                         np.asarray(got, np.float64), want, rtol=1e-6)
                     nchecked += 1
-                assert nchecked == 4, nchecked
+                assert nchecked == OWN, nchecked
         print(f"proc {pid} matrix mp={mp} du={du} OK", flush=True)
 
 env.finalize()
@@ -198,9 +230,7 @@ print(f"proc {pid} E2E OK", flush=True)
 '''
 
 
-@pytest.mark.slow
-@pytest.mark.filterwarnings("ignore")
-def test_two_process_e2e_graph_matrix(tmp_path):
+def _run_matrix(tmp_path, nproc):
     worker = tmp_path / "worker.py"
     worker.write_text(WORKER)
     with socket.socket() as s:
@@ -213,11 +243,11 @@ def test_two_process_e2e_graph_matrix(tmp_path):
     env["PYTHONPATH"] = repo + os.pathsep + env.get("PYTHONPATH", "")
     procs = [
         subprocess.Popen(
-            [sys.executable, str(worker), str(i), str(port)],
+            [sys.executable, str(worker), str(i), str(port), str(nproc)],
             stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True, env=env,
             cwd=repo,
         )
-        for i in range(2)
+        for i in range(nproc)
     ]
     outs = []
     for i, p in enumerate(procs):
@@ -230,6 +260,23 @@ def test_two_process_e2e_graph_matrix(tmp_path):
         outs.append(out)
     for i, (p, out) in enumerate(zip(procs, outs)):
         assert p.returncode == 0, f"proc {i} failed:\n{out[-3000:]}"
+        assert f"proc {i} hierarchy OK" in out
+        assert f"proc {i} gather_to_host OK" in out
         assert f"proc {i} phase loop OK" in out
         assert f"proc {i} matrix mp=2 du=True OK" in out
         assert f"proc {i} E2E OK" in out
+
+
+@pytest.mark.slow
+@pytest.mark.filterwarnings("ignore")
+def test_two_process_e2e_graph_matrix(tmp_path):
+    _run_matrix(tmp_path, nproc=2)
+
+
+@pytest.mark.slow
+@pytest.mark.filterwarnings("ignore")
+def test_four_process_e2e_graph_matrix(tmp_path):
+    """The reference's canonical 4-rank matrix (mpiexec -n 4 -ppn 1,
+    tests/examples/mlsl_test/Makefile:56-105): 4 processes x 2 devices,
+    model groups intra-process, data/grad groups spanning all four."""
+    _run_matrix(tmp_path, nproc=4)

@@ -127,6 +127,76 @@ def test_overlap_updates_with_nested_layer_names(env):
     assert "stage0.0" not in trainer.params
 
 
+def test_overlap_event_order_deterministic(env):
+    """Load-independent complement to the wall-clock overlap comparisons in
+    test_stats (VERDICT r4 item 6): pin the engine's overlap SEMANTICS by event
+    ORDER, which no machine load can invert. The sync engine must issue every
+    per-layer gradient Start (newest gradient first) before any Wait or Test,
+    and the Test-driven path must poll every pending request once before ever
+    falling back to a blocking Wait."""
+    from mlsl_tpu.core.parameter_set import ParameterSet
+    from mlsl_tpu.models.train import DataParallelTrainer
+
+    params = mlp_init(jax.random.PRNGKey(0))
+    dist = env.create_distribution(8, 1)
+    x, y = _make_data(32)
+
+    def run(overlap_updates):
+        sess = env.create_session()
+        sess.set_global_minibatch_size(32)
+        trainer = DataParallelTrainer(
+            env, dist, sess, params, mlp_loss, LAYERS, get_layer, lr=0.1,
+            force_graph_path=True, overlap_updates=overlap_updates,
+        )
+        batch = trainer.shard_batch(x, y)
+        trainer.step(batch)  # warm: compiles + cached requests, unrecorded
+        events = []
+        orig = {
+            "start_gradient_comm": ParameterSet.start_gradient_comm,
+            "wait_gradient_comm": ParameterSet.wait_gradient_comm,
+            "test_gradient_comm": ParameterSet.test_gradient_comm,
+        }
+
+        def recorder(kind, fn):
+            def wrapped(self, *a):
+                events.append((kind, self.op.name))
+                return fn(self, *a)
+            return wrapped
+
+        try:
+            for meth, fn in orig.items():
+                setattr(ParameterSet, meth,
+                        recorder(meth.split("_")[0], fn))
+            trainer.step(batch)
+        finally:
+            for meth, fn in orig.items():
+                setattr(ParameterSet, meth, fn)
+        return events
+
+    # --- blocking path: start all (newest first), then wait in layer order ---
+    ev = run(overlap_updates=False)
+    starts = [name for kind, name in ev if kind == "start"]
+    assert starts == list(reversed(LAYERS))  # newest-gradient-first, pinned
+    first_nonstart = next(i for i, e in enumerate(ev) if e[0] != "start")
+    assert first_nonstart == len(LAYERS)  # every Start precedes any Wait
+    assert all(kind == "wait" for kind, _ in ev[first_nonstart:])
+
+    # --- Test-driven path: all Starts first; every pending layer polled
+    # (a full Test pass) before any blocking Wait is even considered ---
+    ev = run(overlap_updates=True)
+    starts = [name for kind, name in ev if kind == "start"]
+    assert starts == list(reversed(LAYERS))
+    first_nonstart = next(i for i, e in enumerate(ev) if e[0] != "start")
+    assert first_nonstart == len(LAYERS)
+    wait_pos = [i for i, e in enumerate(ev) if e[0] == "wait"]
+    if wait_pos:  # a Wait may never happen (all Tests complete immediately)
+        tested_before_wait = {name for kind, name in
+                              ev[first_nonstart: wait_pos[0]] if kind == "test"}
+        assert tested_before_wait == set(LAYERS)
+    else:
+        assert {name for kind, name in ev if kind == "test"} == set(LAYERS)
+
+
 def test_overlap_with_distributed_update_rejected(env):
     from mlsl_tpu.log import MLSLError
     from mlsl_tpu.models.train import DataParallelTrainer
