@@ -110,6 +110,8 @@ def main():
              [sys.executable, "benchmarks/kernels_on_chip.py"], 2400),
             ("allreduce_curve",
              [sys.executable, "benchmarks/allreduce_curve.py"], 2400),
+            ("bucketing",
+             [sys.executable, "benchmarks/bucketing_bench.py"], 1200),
         ]
 
     record = {

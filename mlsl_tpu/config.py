@@ -39,6 +39,7 @@ _ENV_FIELDS = {
     "MLSL_MSG_PRIORITY_THRESHOLD": "msg_priority_threshold",
     "MLSL_MSG_PRIORITY_FLUSH_MS": "msg_priority_flush_ms",
     "MLSL_GATHER_DEVICE_LIMIT_MB": "gather_device_limit_mb",
+    "MLSL_GRAD_BUCKET_MB": "grad_bucket_mb",
     "MLSL_NUM_SERVERS": "num_servers",
 }
 
@@ -63,6 +64,10 @@ class Config:
     large_msg_size_mb: int = 128    # MLSL_LARGE_MSG_SIZE_MB
     large_msg_chunks: int = 4       # MLSL_LARGE_MSG_CHUNKS
     max_short_msg_size: int = 0     # MLSL_MAX_SHORT_MSG_SIZE
+    # Gradient bucketing (core/bucketing.py): coalesce per-layer gradient
+    # allreduces below this bucket size into one concatenated allreduce
+    # (fewer host dispatches, bandwidth-sized wire messages). 0 = off.
+    grad_bucket_mb: int = 0         # MLSL_GRAD_BUCKET_MB
     # Per-device output cap (MiB) for the device-side rooted gather, whose
     # rank-uniform SPMD result replicates the concatenation on every member
     # (docs/DESIGN.md 'Rooted gather'); larger gathers must use
@@ -119,6 +124,7 @@ class Config:
         c.gather_device_limit_mb = _env_int(
             "MLSL_GATHER_DEVICE_LIMIT_MB", c.gather_device_limit_mb
         )
+        c.grad_bucket_mb = _env_int("MLSL_GRAD_BUCKET_MB", c.grad_bucket_mb)
         c.msg_priority = _env_bool("MLSL_MSG_PRIORITY", c.msg_priority)
         c.msg_priority_threshold = _env_int(
             "MLSL_MSG_PRIORITY_THRESHOLD", c.msg_priority_threshold

@@ -1,0 +1,261 @@
+"""Gradient bucketing: coalesce small per-layer gradient allreduces.
+
+A beyond-reference capability (the reference syncs one collective per
+ParameterSet, src/mlsl_impl.cpp:446-539, with LIFO *scheduling* but no
+*coalescing*): a deep model's backward pass issues one small allreduce per
+parameter tensor, each paying a full host dispatch and wire latency — on the
+dispatch-floor numbers (README 'Host dispatch floor') a ResNet-50's ~160
+small tensors are launch-bound, not bandwidth-bound.
+
+Buckets pack eligible ParameterSets — same gradient group, same dtype, plain
+uncompressed allreduce path — into ``MLSL_GRAD_BUCKET_MB``-sized groups in
+REVERSE creation order (the backward-pass start order), at Session.commit.
+The last member to Start triggers ONE concatenated allreduce for the whole
+bucket; each member's Wait/Test slices its own segment from the bucket
+result. One dispatch + one wire latency amortized over the bucket, and the
+wire sees a bandwidth-sized message.
+
+Opportunistic by design: correctness never depends on co-arrival. Any
+pattern the bucket cannot serve exactly — a Wait/Test before the bucket
+fills, a member restarted while the bucket is in flight — falls back to the
+member's individual cached request (the always-correct path the bucket
+merely optimizes), and the bucket re-arms for the next round.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import List
+
+import jax
+import jax.numpy as jnp
+
+from mlsl_tpu.comm.request import CommDesc, CommRequest, ComputeType
+from mlsl_tpu.log import log_debug
+from mlsl_tpu.types import CompressionType, ReductionType
+
+
+class GradBucket:
+    """One coalesced allreduce shared by several ParameterSets.
+
+    Round lifecycle (all transitions under _lock):
+      collecting --(all members registered)--> dispatched
+      collecting --(any Wait/Test early)-----> fallback: registered members'
+                                               individual requests start, the
+                                               round re-arms immediately
+      dispatched --(every member consumed)---> re-armed for the next round
+    A member restarting while dispatched abandons its bucket slot for that
+    round (counts as consumed) and runs individually.
+    """
+
+    def __init__(self, members: List, env):
+        # members in START order (reverse creation = backward pass order)
+        self.members = members
+        self._idx = {id(ps): i for i, ps in enumerate(members)}
+        self.counts = [ps.owned_kernel_count * ps.kernel_size for ps in members]
+        self.offsets = [0]
+        for c in self.counts[:-1]:
+            self.offsets.append(self.offsets[-1] + c)
+        total = sum(self.counts)
+        ps0 = members[0]
+        self.req = CommRequest(
+            CommDesc(
+                "allreduce",
+                ps0.dist.grad_group,
+                total,
+                ps0.data_type,
+                compute_type=ComputeType.PARAM_GRAD,
+                op=ReductionType.SUM,
+            ),
+            env.dispatcher,
+            name=f"bucket[{len(members)}x{total}]",
+        )
+        self.req.setup()
+        # jitted pack/unpack: EAGER concatenate/slice on sharded arrays pays
+        # one full dispatch per op (~2 ms each on the CPU mesh); one compiled
+        # program for the whole pack and one for the whole unpack keeps the
+        # bucket's overhead below a single member's dispatch cost
+        self._concat = jax.jit(lambda *xs: jnp.concatenate(xs, axis=-1))
+        offsets, counts = self.offsets, self.counts
+        self._split = jax.jit(lambda x: tuple(
+            jax.lax.slice_in_dim(x, o, o + c, axis=x.ndim - 1)
+            for o, c in zip(offsets, counts)
+        ))
+        self._lock = threading.Lock()
+        self._bufs: dict = {}        # member index -> buffer (this round)
+        self._dispatched = False
+        self._parts = None           # split bucket result (this round)
+        self._consumed: set = set()
+        self._last: dict = {}        # member index -> last delivered result
+        # a failed bucket dispatch must raise at EVERY member's wait/test —
+        # like the per-layer path, where each request raises its own error —
+        # not only at the first waiter (CommRequest consumes its error once)
+        self._error = None
+        self._error_left: set = set()
+
+    # -- round state machine (all under _lock) -----------------------------
+
+    def start(self, ps, buf) -> bool:
+        """Register a member's gradient buffer. True = the bucket owns this
+        round for ps; False = run this start on ps's individual request."""
+        i = self._idx[id(ps)]
+        with self._lock:
+            if self._dispatched:
+                # restart while the bucket is in flight: abandon the slot for
+                # this round and run individually (well-defined supersede
+                # semantics live on the individual request)
+                self._consume_locked(i)
+                return False
+            self._bufs[i] = buf  # a pre-dispatch restart supersedes
+            if len(self._bufs) == len(self.members):
+                # a fresh round supersedes an undelivered error (the same
+                # contract as CommRequest.start resetting _dispatch_error)
+                self._error = None
+                self._error_left.clear()
+                ordered = [self._bufs[j] for j in range(len(self.members))]
+                self.req.start(self._concat(*ordered))
+                self._dispatched = True
+            return True
+
+    def _fallback_locked(self) -> None:
+        """A member was waited/tested before the bucket filled: dispatch every
+        registered member's individual request and re-arm. Those members'
+        current round becomes individual (ps._bucket_round cleared)."""
+        log_debug(
+            "grad bucket fallback: %d/%d members started",
+            len(self._bufs), len(self.members),
+        )
+        for j, buf in self._bufs.items():
+            ps = self.members[j]
+            ps.grad_req.start(buf)
+            ps._bucket_round = False
+        self._bufs.clear()
+        self._consumed.clear()
+
+    def _consume_locked(self, i: int) -> None:
+        self._consumed.add(i)
+        if self._dispatched and len(self._consumed) == len(self.members):
+            self._bufs.clear()
+            self._consumed.clear()
+            self._dispatched = False
+            self._parts = None
+
+    def _part_locked(self, out, i: int):
+        if self._parts is None:
+            self._parts = self._split(out)  # one compiled unpack per round
+        res = self._parts[i]
+        self._last[i] = res
+        self._consume_locked(i)
+        return res
+
+    def _record_error_locked(self, e: BaseException) -> None:
+        self._error = e
+        self._error_left = set(range(len(self.members)))
+        self._bufs.clear()
+        self._consumed.clear()
+        self._dispatched = False
+        self._parts = None
+
+    def _raise_error_locked(self, i: int) -> None:
+        err = self._error
+        self._error_left.discard(i)
+        if not self._error_left:  # every member has seen it: clear for reuse
+            self._error = None
+        raise err
+
+    def wait(self, ps):
+        """-> (handled, result). handled=False: the fallback just started
+        ps's individual request; the caller must wait it."""
+        i = self._idx[id(ps)]
+        with self._lock:
+            if self._error is not None:
+                self._raise_error_locked(i)
+            if not self._dispatched:
+                if i not in self._bufs:
+                    # nothing pending this round: MPI no-op, last result again
+                    return True, self._last.get(i)
+                self._fallback_locked()
+                return False, None
+        # Blocking wait OUTSIDE the lock: a concurrent Test on another member
+        # must stay a non-blocking poll. Safe: the round cannot re-arm (or the
+        # request restart) until THIS member consumes, and CommRequest.wait is
+        # idempotent for concurrent waiters of a completed round.
+        try:
+            out = self.req.wait()
+        except Exception as e:
+            with self._lock:
+                self._record_error_locked(e)
+                self._raise_error_locked(i)
+        with self._lock:
+            return True, self._part_locked(out, i)
+
+    def test(self, ps):
+        """-> (handled, done, result_or_None); handled=False as in wait()."""
+        i = self._idx[id(ps)]
+        with self._lock:
+            if self._error is not None:
+                self._raise_error_locked(i)
+            if not self._dispatched:
+                if i not in self._bufs:
+                    return True, True, self._last.get(i)
+                self._fallback_locked()
+                return False, False, None
+            try:
+                done, out = self.req.test()
+            except Exception as e:
+                self._record_error_locked(e)
+                self._raise_error_locked(i)
+            if not done:
+                return True, False, None
+            return True, True, self._part_locked(out, i)
+
+
+def build_buckets(session, bucket_mb: int) -> int:
+    """Pack eligible ParameterSets into GradBuckets (called at Commit).
+    Returns the number of buckets formed."""
+    from mlsl_tpu.comm.collectives import _group_key
+    from mlsl_tpu.types import dtype_size
+
+    eligible: dict = {}  # (group key, dtype) -> [ps] in creation order
+    for op in session.operations:
+        for ps in op.parameter_sets:
+            if (
+                ps.need_comm
+                and not ps.distributed_update
+                and ps.compression == CompressionType.NONE
+                and ps.bucket is None
+            ):
+                key = (_group_key(ps.dist.grad_group), ps.data_type)
+                eligible.setdefault(key, []).append(ps)
+
+    limit = bucket_mb * 1024 * 1024
+    n_buckets = 0
+    for (_, dt), pss in eligible.items():
+        esize = dtype_size(dt)
+        cur: List = []
+        cur_bytes = 0
+        groups: List[List] = []
+        for ps in reversed(pss):  # backward-pass start order
+            nbytes = ps.owned_kernel_count * ps.kernel_size * esize
+            if nbytes >= limit:
+                # bandwidth-sized already: bucketing adds only copy traffic
+                if len(cur) > 1:
+                    groups.append(cur)
+                cur, cur_bytes = [], 0
+                continue
+            if cur_bytes + nbytes > limit and cur:
+                if len(cur) > 1:
+                    groups.append(cur)
+                cur, cur_bytes = [], 0
+            cur.append(ps)
+            cur_bytes += nbytes
+        if len(cur) > 1:
+            groups.append(cur)
+        for members in groups:
+            bucket = GradBucket(members, session.env)
+            for ps in members:
+                ps.bucket = bucket
+            n_buckets += 1
+    if n_buckets:
+        log_debug("grad bucketing: %d bucket(s) formed", n_buckets)
+    return n_buckets

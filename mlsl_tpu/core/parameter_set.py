@@ -60,6 +60,12 @@ class ParameterSet:
 
         self.grad_req: Optional[CommRequest] = None
         self.inc_req: Optional[CommRequest] = None
+        # gradient bucketing (core/bucketing.py, assigned at Session.commit):
+        # the bucket opportunistically coalesces this set's grad allreduce
+        # with its neighbors'; _bucket_round tracks whether the CURRENT round
+        # is bucket-owned or individual (fallback)
+        self.bucket = None
+        self._bucket_round = False
         env = op.session.env
         if self.need_comm:
             n_owned = self.owned_kernel_count * self.kernel_size
@@ -138,16 +144,26 @@ class ParameterSet:
         (R, D, S, M, localKernelCount*kernelSize)."""
         self.op.session._stat_event(self, "start", is_param=True)
         if self.need_comm:
-            self.grad_req.start(grad_buf)
+            if self.bucket is not None and self.bucket.start(self, grad_buf):
+                self._bucket_round = True
+            else:
+                self._bucket_round = False
+                self.grad_req.start(grad_buf)
         self.op.session._stat_event(self, "start_done", is_param=True)
 
     def wait_gradient_comm(self):
         self.op.session._stat_event(self, "wait", is_param=True)
         out = None
+        if self.need_comm and self._bucket_round:
+            handled, out = self.bucket.wait(self)
+            if not handled:
+                # the bucket's fallback just started our individual request
+                self._bucket_round = False
+                out = self.grad_req.wait()
         # A request completed via test() has is_started False but a cached
         # result; wait() must still deliver it (MPI: MPI_Wait on a completed
         # request). Only a never-started request yields None.
-        if self.need_comm and (
+        elif self.need_comm and (
             self.grad_req.is_started or self.grad_req._result is not None
         ):
             out = self.grad_req.wait()
@@ -159,6 +175,11 @@ class ParameterSet:
         self.op.session._stat_event(self, "test", is_param=True)
         if not self.need_comm:
             done, out = True, None
+        elif self._bucket_round:
+            handled, done, out = self.bucket.test(self)
+            if not handled:
+                self._bucket_round = False
+                done, out = self.grad_req.test()
         else:
             done, out = self.grad_req.test()
         self.op.session._stat_event(self, "test_done", is_param=True)

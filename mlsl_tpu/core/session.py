@@ -298,8 +298,13 @@ class Session:
             for act in op.inputs:
                 act.init_peer_connection()
         self._committed = True
+        cfg = self.env.config
+        if cfg is not None and cfg.grad_bucket_mb > 0:
+            from mlsl_tpu.core.bucketing import build_buckets
+
+            build_buckets(self, cfg.grad_bucket_mb)
         self.stats.initialize()
-        if self.env.config is not None and self.env.config.enable_stats:
+        if cfg is not None and cfg.enable_stats:
             self.stats.collect_isolation_stats()
 
     # -- statistics plumbing ----------------------------------------------

@@ -88,18 +88,21 @@ _CLASS_DEFAULTS = {
         msg_priority_flush_ms=1.0,        # fast dispatch: short coalescing
         large_msg_size_mb=128,
         large_msg_chunks=4,
+        grad_bucket_mb=4,                 # coalesce launch-bound small grads
     ),
     "tpu-efficiency": dict(
         msg_priority_threshold=1 << 18,   # defer >256 KiB: narrower ICI
         msg_priority_flush_ms=2.0,
         large_msg_size_mb=64,             # chunk earlier
         large_msg_chunks=4,
+        grad_bucket_mb=4,
     ),
     "host-sim": dict(
         msg_priority_threshold=10000,
         msg_priority_flush_ms=2.0,
         large_msg_size_mb=128,
         large_msg_chunks=1,               # chunking only costs on a sim mesh
+        grad_bucket_mb=0,                 # keep sim tests launch-for-launch
     ),
 }
 

@@ -1,0 +1,207 @@
+"""Gradient bucketing (core/bucketing.py): coalesced per-layer allreduces
+must train bit-identically to the per-layer path, dispatch fewer collectives,
+and degrade to the individual path whenever the co-arrival pattern breaks."""
+
+import numpy as np
+import pytest
+import jax
+
+from mlsl_tpu.models.mlp import LAYERS, get_layer, init as mlp_init, loss_fn as mlp_loss
+from mlsl_tpu.types import DataType, GroupType, OpType
+
+
+def _make_data(b=32):
+    rng = np.random.default_rng(0)
+    x = rng.normal(size=(b, 8)).astype(np.float32)
+    y = rng.integers(0, 4, size=(b,)).astype(np.int32)
+    return x, y
+
+
+@pytest.fixture()
+def bucket_env(env):
+    env.config.grad_bucket_mb = 4
+    yield env
+    env.config.grad_bucket_mb = 0
+
+
+def _trainer(env, overlap_updates=False):
+    from mlsl_tpu.models.train import DataParallelTrainer
+
+    params = mlp_init(jax.random.PRNGKey(0))
+    dist = env.create_distribution(8, 1)
+    sess = env.create_session()
+    sess.set_global_minibatch_size(32)
+    return DataParallelTrainer(
+        env, dist, sess, params, mlp_loss, LAYERS, get_layer, lr=0.1,
+        force_graph_path=True, overlap_updates=overlap_updates,
+    )
+
+
+@pytest.mark.parametrize("overlap_updates", [False, True])
+def test_bucketed_training_matches_unbucketed(env, overlap_updates):
+    """Same data, same steps: bucketed training must match the per-layer path
+    exactly (the sum is associative over the concatenation)."""
+    x, y = _make_data(32)
+
+    env.config.grad_bucket_mb = 0
+    t_plain = _trainer(env, overlap_updates)
+    env.config.grad_bucket_mb = 4
+    t_bucket = _trainer(env, overlap_updates)
+    env.config.grad_bucket_mb = 0
+
+    # bucketing actually engaged on the second trainer
+    pss = [t_bucket.ops[n].get_parameter_set(0) for n in LAYERS]
+    assert all(ps.bucket is not None for ps in pss)
+    assert len({id(ps.bucket) for ps in pss}) == 1  # MLP fits one 4 MiB bucket
+
+    for _ in range(3):
+        b1 = t_plain.shard_batch(x, y)
+        b2 = t_bucket.shard_batch(x, y)
+        t_plain.step(b1)
+        t_bucket.step(b2)
+    for name in LAYERS:
+        for g, w in zip(
+            jax.tree.leaves(get_layer(jax.device_get(t_bucket.params), name)),
+            jax.tree.leaves(get_layer(jax.device_get(t_plain.params), name)),
+        ):
+            np.testing.assert_array_equal(np.asarray(g), np.asarray(w))
+
+
+def test_bucket_coalesces_dispatches(bucket_env):
+    """One step = ONE bucket allreduce dispatch instead of one per layer."""
+    from mlsl_tpu.comm.request import CommRequest
+
+    t = _trainer(bucket_env)
+    x, y = _make_data(32)
+    batch = t.shard_batch(x, y)
+    t.step(batch)  # warm
+
+    started = []
+    orig = CommRequest.start
+
+    def rec(self, buf):
+        started.append(self.name or self.uid)
+        return orig(self, buf)
+
+    try:
+        CommRequest.start = rec
+        t.step(batch)
+    finally:
+        CommRequest.start = orig
+    bucket_starts = [s for s in started if str(s).startswith("bucket[")]
+    assert len(bucket_starts) == 1, started
+    # no individual grad request fired
+    assert len(started) == 1, started
+
+
+def test_bucket_fallback_on_partial_round(bucket_env):
+    """A Wait before the bucket fills falls back to individual requests and
+    the bucket re-arms for the next (complete) round."""
+    env = bucket_env
+    dist = env.create_distribution(8, 1)
+    s = env.create_session()
+    s.set_global_minibatch_size(8)
+    ops = []
+    for i in range(3):
+        r = s.create_operation_reg_info(OpType.CC)
+        r.add_input(8, 4)
+        r.add_output(8, 4)
+        r.add_parameter_set(64, 1)
+        ops.append(s.get_operation(s.add_operation(r, dist)))
+    s.commit()
+    pss = [op.get_parameter_set(0) for op in ops]
+    assert all(ps.bucket is not None for ps in pss)
+
+    def buf(scale):
+        return dist.make_buffer(
+            lambda p: scale * (p * 100.0 + np.arange(64, dtype=np.float64)), 64
+        )
+
+    oracle = lambda scale: sum(
+        scale * (p * 100.0 + np.arange(64, dtype=np.float32)) for p in range(8)
+    )
+
+    # partial round: only 2 of 3 start, then a wait -> individual fallback
+    pss[0].start_gradient_comm(buf(1.0))
+    pss[1].start_gradient_comm(buf(2.0))
+    out0 = pss[0].wait_gradient_comm()
+    out1 = pss[1].wait_gradient_comm()
+    np.testing.assert_allclose(
+        np.asarray(out0)[0, 0, 0, 0], oracle(1.0), rtol=1e-6)
+    np.testing.assert_allclose(
+        np.asarray(out1)[0, 0, 0, 0], oracle(2.0), rtol=1e-6)
+
+    # next round is complete: bucket serves it again
+    for i, ps in enumerate(pss):
+        ps.start_gradient_comm(buf(float(i + 3)))
+    outs = [ps.wait_gradient_comm() for ps in pss]
+    for i, out in enumerate(outs):
+        np.testing.assert_allclose(
+            np.asarray(out)[0, 0, 0, 0], oracle(float(i + 3)), rtol=1e-6)
+        assert pss[i]._bucket_round  # served by the bucket, not the fallback
+
+
+def test_bucket_error_reaches_every_member(bucket_env):
+    """A failed bucket dispatch raises at EVERY member's wait (the per-layer
+    contract: each request reports its own failure), and the next complete
+    round supersedes the error and works."""
+    env = bucket_env
+    dist = env.create_distribution(8, 1)
+    s = env.create_session()
+    s.set_global_minibatch_size(8)
+    ops = []
+    for _ in range(2):
+        r = s.create_operation_reg_info(OpType.CC)
+        r.add_input(8, 4)
+        r.add_output(8, 4)
+        r.add_parameter_set(64, 1)
+        ops.append(s.get_operation(s.add_operation(r, dist)))
+    s.commit()
+    pss = [op.get_parameter_set(0) for op in ops]
+    bucket = pss[0].bucket
+    assert bucket is not None and bucket is pss[1].bucket
+
+    buf = dist.make_buffer(
+        lambda p: p * 1.0 + np.arange(64, dtype=np.float64), 64)
+    boom = RuntimeError("bucket dispatch failed")
+    orig_wait = type(bucket.req).wait
+    try:
+        type(bucket.req).wait = lambda self: (_ for _ in ()).throw(boom)
+        pss[0].start_gradient_comm(buf)
+        pss[1].start_gradient_comm(buf)
+        with pytest.raises(RuntimeError, match="bucket dispatch failed"):
+            pss[0].wait_gradient_comm()
+        with pytest.raises(RuntimeError, match="bucket dispatch failed"):
+            pss[1].wait_gradient_comm()
+    finally:
+        type(bucket.req).wait = orig_wait
+    # the next round supersedes the error and the bucket serves it
+    pss[0].start_gradient_comm(buf)
+    pss[1].start_gradient_comm(buf)
+    out = pss[0].wait_gradient_comm()
+    want = sum(p * 1.0 + np.arange(64, dtype=np.float32) for p in range(8))
+    np.testing.assert_allclose(np.asarray(out)[0, 0, 0, 0], want, rtol=1e-6)
+    assert pss[1].wait_gradient_comm() is not None
+
+
+def test_bucket_eligibility(bucket_env):
+    """distributed_update and compressed sets stay individual; a singleton
+    leftover is not bucketed (a 1-member bucket is pure overhead)."""
+    env = bucket_env
+    dist = env.create_distribution(8, 1)
+    s = env.create_session()
+    s.set_global_minibatch_size(8)
+
+    r1 = s.create_operation_reg_info(OpType.CC)
+    r1.add_input(8, 4)
+    r1.add_output(8, 4)
+    r1.add_parameter_set(64, 1)
+    op1 = s.get_operation(s.add_operation(r1, dist))
+    r2 = s.create_operation_reg_info(OpType.CC)
+    r2.add_input(8, 4)
+    r2.add_output(8, 4)
+    r2.add_parameter_set(64, 1, distributed_update=True)
+    op2 = s.get_operation(s.add_operation(r2, dist))
+    s.commit()
+    assert op1.get_parameter_set(0).bucket is None  # singleton: not bucketed
+    assert op2.get_parameter_set(0).bucket is None  # distributed_update path
