@@ -63,11 +63,16 @@ def main():
             for _ in range(NL)
         ]
 
+        from benchmarks._common import device_sync
+
         def step(pss):
             for ps, b in zip(reversed(pss), reversed(bufs)):
                 ps.start_gradient_comm(b)
             outs = [ps.wait_gradient_comm() for ps in pss]
-            jax.block_until_ready(outs[-1])
+            # d2h readback, not block_until_ready: through the axon tunnel
+            # block_until_ready can acknowledge at dispatch (memory:
+            # axon-tunnel-timing), and this bench runs in the on-chip capture
+            device_sync(outs[-1])
 
         times = {}
         for label, mb in (("individual_ms", 0), ("bucketed_ms", 4)):

@@ -168,7 +168,10 @@ class GradBucket:
         ps's individual request; the caller must wait it."""
         i = self._idx[id(ps)]
         with self._lock:
-            if self._error is not None:
+            if self._error is not None and i in self._error_left:
+                # deliver the failed round's error ONCE per member; a member
+                # that already consumed it proceeds normally (a fresh partial
+                # registration falls back below)
                 self._raise_error_locked(i)
             if not self._dispatched:
                 if i not in self._bufs:
@@ -177,14 +180,18 @@ class GradBucket:
                 self._fallback_locked()
                 return False, None
         # Blocking wait OUTSIDE the lock: a concurrent Test on another member
-        # must stay a non-blocking poll. Safe: the round cannot re-arm (or the
-        # request restart) until THIS member consumes, and CommRequest.wait is
-        # idempotent for concurrent waiters of a completed round.
+        # must stay a non-blocking poll. Safe on success: the round cannot
+        # re-arm (or the request restart) until THIS member consumes, and
+        # CommRequest.wait is idempotent for concurrent waiters of a completed
+        # round. On FAILURE CommRequest consumes its error once, so a second
+        # concurrent waiter raises a secondary artifact — first error wins
+        # below, and everyone re-raises the stored real error.
         try:
             out = self.req.wait()
         except Exception as e:
             with self._lock:
-                self._record_error_locked(e)
+                if self._error is None:
+                    self._record_error_locked(e)
                 self._raise_error_locked(i)
         with self._lock:
             return True, self._part_locked(out, i)
@@ -193,7 +200,7 @@ class GradBucket:
         """-> (handled, done, result_or_None); handled=False as in wait()."""
         i = self._idx[id(ps)]
         with self._lock:
-            if self._error is not None:
+            if self._error is not None and i in self._error_left:
                 self._raise_error_locked(i)
             if not self._dispatched:
                 if i not in self._bufs:
@@ -203,7 +210,8 @@ class GradBucket:
             try:
                 done, out = self.req.test()
             except Exception as e:
-                self._record_error_locked(e)
+                if self._error is None:
+                    self._record_error_locked(e)
                 self._raise_error_locked(i)
             if not done:
                 return True, False, None

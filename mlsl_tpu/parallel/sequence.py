@@ -162,6 +162,156 @@ def _ring_flash(q, k, v, axis: str, axis_size: int, causal: bool) -> jax.Array:
     return out.reshape(b, h, sl, d).astype(q.dtype)
 
 
+def zigzag_perm(seq_len: int, axis_size: int):
+    """Permutation putting a sequence into ZIGZAG layout: device r's contiguous
+    shard holds global chunks r and 2G-1-r (chunk = seq_len / (2G)).
+
+    Returns ``perm`` with ``x_zigzag = x[..., perm, :]``; invert with
+    ``x[..., inv, :] = x_zigzag`` where ``inv = zigzag_perm_inverse(...)``.
+    """
+    import numpy as np
+
+    g = axis_size
+    mlsl_assert(
+        seq_len % (2 * g) == 0,
+        "zigzag needs seq_len %% (2 * axis_size) == 0 (got %d, %d)",
+        seq_len, g,
+    )
+    c = seq_len // (2 * g)
+    chunks = np.arange(seq_len).reshape(2 * g, c)
+    order = [x for r in range(g) for x in (r, 2 * g - 1 - r)]
+    return chunks[order].reshape(-1)
+
+
+def zigzag_perm_inverse(seq_len: int, axis_size: int):
+    import numpy as np
+
+    perm = zigzag_perm(seq_len, axis_size)
+    inv = np.empty_like(perm)
+    inv[perm] = np.arange(seq_len)
+    return inv
+
+
+def zigzag_ring_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    axis: str,
+    axis_size: int,
+) -> jax.Array:
+    """Load-balanced CAUSAL ring attention over zigzag-sharded sequences.
+
+    With contiguous block sharding, causal ring attention computes the full
+    (2c x 2c) score block every hop and masks half of it away on average —
+    ~2x wasted MXU work at large ring sizes, and SPMD lockstep means nobody
+    can skip ahead. Zigzag layout (device r holds global chunks r and
+    2G-1-r; see zigzag_perm) makes every hop exactly TWO unmasked (c x c)
+    block updates on every device:
+
+      - visiting kv from an earlier rank (src < me): both my chunks see the
+        visitor's first chunk -> (q0, k0), (q1, k0);
+      - visiting kv from a later rank (src > me): my second chunk sees both
+        visitor chunks -> (q1, k0), (q1, k1);
+
+    and chunk-level visibility is all-or-nothing, so the off-diagonal
+    updates need NO mask at all. Only the self-hop touches masked diagonals.
+    Total block-FLOPs: ~2Gc^2 vs the contiguous schedule's 4Gc^2 — the
+    schedule used by production context-parallel trainers, absent from the
+    reference (its sequence dimension does not exist; SURVEY §5.7).
+
+    Inputs are zigzag-sharded device-local (B, H, 2c, D) shards; call inside
+    shard_map like ring_attention. Non-causal attention gains nothing from
+    zigzag — use ring_attention for it.
+    """
+    if axis_size == 1:
+        return _dense_attention(q, k, v, True, 0)
+    b, h, sl, d = q.shape
+    mlsl_assert(sl % 2 == 0, "zigzag shard length must be even (got %d)", sl)
+    c = sl // 2
+    g = axis_size
+    scale = 1.0 / jnp.sqrt(d).astype(jnp.float32)
+    me = lax.axis_index(axis)
+
+    as_chunks = lambda x: x.astype(jnp.float32).reshape(b, h, 2, c, d)
+    qz = as_chunks(q)
+
+    def full_update(qc, kc, vc, acc, m, l):
+        """Unmasked (c x c) online-softmax update (chunk fully visible)."""
+        s = jnp.einsum("bhqd,bhkd->bhqk", qc, kc) * scale
+        s_max = jnp.max(s, axis=-1)
+        m_new = jnp.maximum(m, s_max)
+        p = jnp.exp(s - m_new[..., None])
+        corr = jnp.exp(m - m_new)
+        l_new = l * corr + jnp.sum(p, axis=-1)
+        acc_new = acc * corr[..., None] + jnp.einsum("bhqk,bhkd->bhqd", p, vc)
+        return acc_new, m_new, l_new
+
+    def diag_update(qc, kc, vc, acc, m, l):
+        """Within-chunk causal (lower-triangular) update — self-hop only."""
+        s = jnp.einsum("bhqd,bhkd->bhqk", qc, kc) * scale
+        tri = jnp.arange(c)[None, :] <= jnp.arange(c)[:, None]
+        s = jnp.where(tri[None, None], s, _NEG)
+        s_max = jnp.max(s, axis=-1)
+        m_new = jnp.maximum(m, s_max)
+        p = jnp.exp(s - m_new[..., None])
+        p = jnp.where(s <= _NEG / 2, 0.0, p)
+        corr = jnp.exp(m - m_new)
+        l_new = l * corr + jnp.sum(p, axis=-1)
+        acc_new = acc * corr[..., None] + jnp.einsum("bhqk,bhkd->bhqd", p, vc)
+        return acc_new, m_new, l_new
+
+    acc = _pvary(jnp.zeros((b, h, 2, c, d), jnp.float32), axis)
+    m = _pvary(jnp.full((b, h, 2, c), _NEG, jnp.float32), axis)
+    l = _pvary(jnp.zeros((b, h, 2, c), jnp.float32), axis)
+
+    # self hop: q0*k0 (diag), q1*k0 (full: chunk 2G-1-me is after chunk me),
+    # q1*k1 (diag)
+    kz, vz = as_chunks(k), as_chunks(v)
+    a0, m0, l0 = diag_update(
+        qz[:, :, 0], kz[:, :, 0], vz[:, :, 0], acc[:, :, 0], m[:, :, 0], l[:, :, 0]
+    )
+    a1, m1, l1 = full_update(
+        qz[:, :, 1], kz[:, :, 0], vz[:, :, 0], acc[:, :, 1], m[:, :, 1], l[:, :, 1]
+    )
+    a1, m1, l1 = diag_update(qz[:, :, 1], kz[:, :, 1], vz[:, :, 1], a1, m1, l1)
+    acc = jnp.stack([a0, a1], axis=2)
+    m = jnp.stack([m0, m1], axis=2)
+    l = jnp.stack([l0, l1], axis=2)
+
+    perm = [(i, (i + 1) % g) for i in range(g)]
+
+    def hop(t, state):
+        (acc, m, l), k_cur, v_cur = state
+        src = (me - t) % g          # original owner of the visiting kv
+        early = src < me            # visitor's chunks precede mine
+        qsel = (jnp.where(early, 0, 1), jnp.int32(1))
+        ksel = (jnp.int32(0), jnp.where(early, 0, 1))
+        for u in range(2):
+            qi, ki = qsel[u], ksel[u]
+            qc = lax.dynamic_index_in_dim(qz, qi, axis=2, keepdims=False)
+            kc = lax.dynamic_index_in_dim(k_cur, ki, axis=2, keepdims=False)
+            vc = lax.dynamic_index_in_dim(v_cur, ki, axis=2, keepdims=False)
+            ac = lax.dynamic_index_in_dim(acc, qi, axis=2, keepdims=False)
+            mc = lax.dynamic_index_in_dim(m, qi, axis=2, keepdims=False)
+            lc = lax.dynamic_index_in_dim(l, qi, axis=2, keepdims=False)
+            ac, mc, lc = full_update(qc, kc, vc, ac, mc, lc)
+            acc = lax.dynamic_update_index_in_dim(acc, ac, qi, axis=2)
+            m = lax.dynamic_update_index_in_dim(m, mc, qi, axis=2)
+            l = lax.dynamic_update_index_in_dim(l, lc, qi, axis=2)
+        return (
+            (acc, m, l),
+            lax.ppermute(k_cur, axis, perm),
+            lax.ppermute(v_cur, axis, perm),
+        )
+
+    (acc, m, l), _, _ = lax.fori_loop(
+        1, g, hop,
+        ((acc, m, l), lax.ppermute(kz, axis, perm), lax.ppermute(vz, axis, perm)),
+    )
+    out = acc / jnp.maximum(l[..., None], 1e-30)
+    return out.reshape(b, h, sl, d).astype(q.dtype)
+
+
 def ulysses_attention(
     q: jax.Array,
     k: jax.Array,

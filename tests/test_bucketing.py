@@ -183,6 +183,23 @@ def test_bucket_error_reaches_every_member(bucket_env):
     np.testing.assert_allclose(np.asarray(out)[0, 0, 0, 0], want, rtol=1e-6)
     assert pss[1].wait_gradient_comm() is not None
 
+    # a member that consumed its error and retries SOLO must not see the
+    # stale error again: its partial round falls back to the individual path
+    try:
+        type(bucket.req).wait = lambda self: (_ for _ in ()).throw(boom)
+        pss[0].start_gradient_comm(buf)
+        pss[1].start_gradient_comm(buf)
+        with pytest.raises(RuntimeError, match="bucket dispatch failed"):
+            pss[0].wait_gradient_comm()
+    finally:
+        type(bucket.req).wait = orig_wait
+    pss[0].start_gradient_comm(buf)      # solo retry, round stays partial
+    out = pss[0].wait_gradient_comm()    # -> individual fallback, not error
+    np.testing.assert_allclose(np.asarray(out)[0, 0, 0, 0], want, rtol=1e-6)
+    # member 1 still collects the original error exactly once
+    with pytest.raises(RuntimeError, match="bucket dispatch failed"):
+        pss[1].wait_gradient_comm()
+
 
 def test_bucket_eligibility(bucket_env):
     """distributed_update and compressed sets stay individual; a singleton
