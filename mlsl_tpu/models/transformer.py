@@ -39,7 +39,9 @@ from mlsl_tpu.models.train import (
     _unflatten_like,
 )
 from mlsl_tpu.comm.mesh import NUM_GRID_AXES
-from mlsl_tpu.parallel.sequence import ring_attention, ulysses_attention
+from mlsl_tpu.parallel.sequence import (
+    ring_attention, ulysses_attention, zigzag_perm, zigzag_ring_attention,
+)
 from mlsl_tpu.types import CompressionType, DataType, OpType
 
 
@@ -52,7 +54,11 @@ class TransformerConfig:
     n_blocks: int = 2
     seq_len: int = 64
     mlp_ratio: int = 4
-    attention: str = "ring"  # 'ring' | 'ulysses'
+    attention: str = "ring"  # 'ring' | 'zigzag' | 'ulysses'. 'zigzag' is the
+    # load-balanced causal ring (parallel/sequence.py): the trainer feeds
+    # tokens/labels in zigzag sequence order and the position embedding rows
+    # follow, so training is mathematically identical to 'ring' at ~2x fewer
+    # attention block-FLOPs on the ring hops.
     dtype: str = "bfloat16"  # MXU compute dtype; 'float32' for exactness tests
     n_experts: int = 0       # >0: MoE FFN with expert parallelism over 'model'
     moe_top_k: int = 1       # 1 = switch routing; 2 = GShard-style top-2
@@ -169,10 +175,28 @@ def forward_local(params, tokens, cfg: TransformerConfig, sp: int, tp: int):
     aux_total = jnp.float32(0.0)
     s_idx = lax.axis_index(SEQ_AXIS) if sp > 1 else 0
     sl = tokens.shape[1]
-    pos = lax.dynamic_slice_in_dim(emb["pos"], s_idx * sl, sl, axis=0)
+    if cfg.attention == "zigzag" and sp > 1:
+        # zigzag layout: tokens/labels arrive zigzag-ordered (shard_tokens),
+        # so the position rows follow the SAME permutation — zigzag_perm is
+        # the single source of truth for the layout, derived from the RUN-TIME
+        # global length sp*sl (shard_tokens permutes whatever length it is
+        # fed, which may be shorter than cfg.seq_len). Slice this shard's
+        # window of the constant index vector first, then gather only the sl
+        # needed rows.
+        perm = jnp.asarray(zigzag_perm(sp * sl, sp))
+        idx = lax.dynamic_slice_in_dim(perm, s_idx * sl, sl, axis=0)
+        pos = emb["pos"][idx]
+    else:
+        pos = lax.dynamic_slice_in_dim(emb["pos"], s_idx * sl, sl, axis=0)
     h = (emb["tok"][tokens] + pos[None]).astype(cdt)
 
-    attn_fn = ring_attention if cfg.attention == "ring" else ulysses_attention
+    if cfg.attention == "zigzag":
+        attn_fn = lambda q, k, v, ax, n, causal=True: (
+            zigzag_ring_attention(q, k, v, ax, n) if n > 1
+            else ring_attention(q, k, v, ax, n, causal=causal)
+        )
+    else:
+        attn_fn = ring_attention if cfg.attention == "ring" else ulysses_attention
     for i in range(cfg.n_blocks):
         lnp = params[f"blk{i}.ln"]
         ap = params[f"blk{i}.attn"]
@@ -703,6 +727,13 @@ class HybridTrainer:
     # -- step --------------------------------------------------------------
 
     def shard_tokens(self, tokens: np.ndarray, labels: np.ndarray):
+        if self.cfg.attention == "zigzag" and self.sp > 1:
+            # feed the sequence in zigzag order; CE is position-wise, so a
+            # consistent (tokens, labels) permutation leaves the loss and the
+            # parameter trajectory identical to the contiguous layout
+            perm = zigzag_perm(tokens.shape[1], self.sp)
+            tokens = np.asarray(tokens)[:, perm]
+            labels = np.asarray(labels)[:, perm]
         sharding = NamedSharding(self.mesh, self._token_spec())
         return (
             jax.device_put(jnp.asarray(tokens), sharding),

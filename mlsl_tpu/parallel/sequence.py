@@ -198,6 +198,7 @@ def zigzag_ring_attention(
     v: jax.Array,
     axis: str,
     axis_size: int,
+    use_flash: Optional[bool] = None,
 ) -> jax.Array:
     """Load-balanced CAUSAL ring attention over zigzag-sharded sequences.
 
@@ -222,6 +223,10 @@ def zigzag_ring_attention(
     Inputs are zigzag-sharded device-local (B, H, 2c, D) shards; call inside
     shard_map like ring_attention. Non-causal attention gains nothing from
     zigzag — use ring_attention for it.
+
+    use_flash: None = auto (the fused Pallas block kernel on TPU when the
+    chunk tiling admits — no (c x c) score materialization); True forces it
+    (interpret mode off-TPU), False forces the einsum fallback.
     """
     if axis_size == 1:
         return _dense_attention(q, k, v, True, 0)
@@ -229,54 +234,94 @@ def zigzag_ring_attention(
     mlsl_assert(sl % 2 == 0, "zigzag shard length must be even (got %d)", sl)
     c = sl // 2
     g = axis_size
-    scale = 1.0 / jnp.sqrt(d).astype(jnp.float32)
+    bh = b * h
     me = lax.axis_index(axis)
+    if use_flash is None:
+        use_flash = _use_flash(c, c, d)
 
-    as_chunks = lambda x: x.astype(jnp.float32).reshape(b, h, 2, c, d)
-    qz = as_chunks(q)
+    # Both modes share the schedule below on (bh, 2, c, ...) chunked carries;
+    # they differ only in the per-chunk update and the m/l carry layout.
+    if use_flash:
+        from mlsl_tpu.ops.attention_kernels import (
+            NEG, flash_block_update, supports,
+        )
 
-    def full_update(qc, kc, vc, acc, m, l):
-        """Unmasked (c x c) online-softmax update (chunk fully visible)."""
-        s = jnp.einsum("bhqd,bhkd->bhqk", qc, kc) * scale
-        s_max = jnp.max(s, axis=-1)
-        m_new = jnp.maximum(m, s_max)
-        p = jnp.exp(s - m_new[..., None])
-        corr = jnp.exp(m - m_new)
-        l_new = l * corr + jnp.sum(p, axis=-1)
-        acc_new = acc * corr[..., None] + jnp.einsum("bhqk,bhkd->bhqd", p, vc)
-        return acc_new, m_new, l_new
+        mlsl_assert(
+            supports(c, c, d),
+            "flash zigzag requires chunk length (local seq / 2) %% 128 == 0 "
+            "and head_dim %% 8 == 0 (got chunk=%d, head_dim=%d); use "
+            "use_flash=False",
+            c, d,
+        )
+        interpret = jax.default_backend() != "tpu"
+        zoff = jnp.zeros((1,), jnp.int32)
 
-    def diag_update(qc, kc, vc, acc, m, l):
-        """Within-chunk causal (lower-triangular) update — self-hop only."""
-        s = jnp.einsum("bhqd,bhkd->bhqk", qc, kc) * scale
-        tri = jnp.arange(c)[None, :] <= jnp.arange(c)[:, None]
-        s = jnp.where(tri[None, None], s, _NEG)
-        s_max = jnp.max(s, axis=-1)
-        m_new = jnp.maximum(m, s_max)
-        p = jnp.exp(s - m_new[..., None])
-        p = jnp.where(s <= _NEG / 2, 0.0, p)
-        corr = jnp.exp(m - m_new)
-        l_new = l * corr + jnp.sum(p, axis=-1)
-        acc_new = acc * corr[..., None] + jnp.einsum("bhqk,bhkd->bhqd", p, vc)
-        return acc_new, m_new, l_new
+        def full_update(qc, kc, vc, ac, mc, lc):
+            # chunk fully visible: no mask, offsets irrelevant
+            return flash_block_update(
+                qc, kc, vc, ac, mc, lc, zoff, zoff, False, interpret
+            )
 
-    acc = _pvary(jnp.zeros((b, h, 2, c, d), jnp.float32), axis)
-    m = _pvary(jnp.full((b, h, 2, c), _NEG, jnp.float32), axis)
-    l = _pvary(jnp.zeros((b, h, 2, c), jnp.float32), axis)
+        def diag_update(qc, kc, vc, ac, mc, lc):
+            # equal offsets + causal = within-chunk lower triangle
+            return flash_block_update(
+                qc, kc, vc, ac, mc, lc, zoff, zoff, True, interpret
+            )
+
+        as_chunks = lambda x: x.reshape(bh, 2, c, d)
+        qz = as_chunks(q)
+        m = _pvary(jnp.full((bh, 2, c, 128), NEG, jnp.float32), axis)
+        l = _pvary(jnp.zeros((bh, 2, c, 128), jnp.float32), axis)
+        denom = lambda l: jnp.maximum(l[..., :1], 1e-30)
+    else:
+        scale = 1.0 / jnp.sqrt(d).astype(jnp.float32)
+
+        def full_update(qc, kc, vc, ac, mc, lc):
+            """Unmasked (c x c) online-softmax update (chunk fully visible)."""
+            s = jnp.einsum("bqd,bkd->bqk", qc, kc) * scale
+            s_max = jnp.max(s, axis=-1)
+            m_new = jnp.maximum(mc, s_max)
+            p = jnp.exp(s - m_new[..., None])
+            corr = jnp.exp(mc - m_new)
+            l_new = lc * corr + jnp.sum(p, axis=-1)
+            a_new = ac * corr[..., None] + jnp.einsum("bqk,bkd->bqd", p, vc)
+            return a_new, m_new, l_new
+
+        def diag_update(qc, kc, vc, ac, mc, lc):
+            """Within-chunk causal (lower-triangular) update — self-hop only."""
+            s = jnp.einsum("bqd,bkd->bqk", qc, kc) * scale
+            tri = jnp.arange(c)[None, :] <= jnp.arange(c)[:, None]
+            s = jnp.where(tri[None], s, _NEG)
+            s_max = jnp.max(s, axis=-1)
+            m_new = jnp.maximum(mc, s_max)
+            p = jnp.exp(s - m_new[..., None])
+            p = jnp.where(s <= _NEG / 2, 0.0, p)
+            corr = jnp.exp(mc - m_new)
+            l_new = lc * corr + jnp.sum(p, axis=-1)
+            a_new = ac * corr[..., None] + jnp.einsum("bqk,bkd->bqd", p, vc)
+            return a_new, m_new, l_new
+
+        as_chunks = lambda x: x.astype(jnp.float32).reshape(bh, 2, c, d)
+        qz = as_chunks(q)
+        m = _pvary(jnp.full((bh, 2, c), _NEG, jnp.float32), axis)
+        l = _pvary(jnp.zeros((bh, 2, c), jnp.float32), axis)
+        denom = lambda l: jnp.maximum(l[..., None], 1e-30)
+
+    acc = _pvary(jnp.zeros((bh, 2, c, d), jnp.float32), axis)
 
     # self hop: q0*k0 (diag), q1*k0 (full: chunk 2G-1-me is after chunk me),
     # q1*k1 (diag)
     kz, vz = as_chunks(k), as_chunks(v)
     a0, m0, l0 = diag_update(
-        qz[:, :, 0], kz[:, :, 0], vz[:, :, 0], acc[:, :, 0], m[:, :, 0], l[:, :, 0]
+        qz[:, 0], kz[:, 0], vz[:, 0], acc[:, 0], m[:, 0], l[:, 0]
     )
     a1, m1, l1 = full_update(
-        qz[:, :, 1], kz[:, :, 0], vz[:, :, 0], acc[:, :, 1], m[:, :, 1], l[:, :, 1]
+        qz[:, 1], kz[:, 0], vz[:, 0], acc[:, 1], m[:, 1], l[:, 1]
     )
-    a1, m1, l1 = diag_update(qz[:, :, 1], kz[:, :, 1], vz[:, :, 1], a1, m1, l1)
-    acc = jnp.stack([a0, a1], axis=2)
-    m = jnp.stack([m0, m1], axis=2)
-    l = jnp.stack([l0, l1], axis=2)
+    a1, m1, l1 = diag_update(qz[:, 1], kz[:, 1], vz[:, 1], a1, m1, l1)
+    acc = jnp.stack([a0, a1], axis=1)
+    m = jnp.stack([m0, m1], axis=1)
+    l = jnp.stack([l0, l1], axis=1)
 
     perm = [(i, (i + 1) % g) for i in range(g)]
 
@@ -288,16 +333,16 @@ def zigzag_ring_attention(
         ksel = (jnp.int32(0), jnp.where(early, 0, 1))
         for u in range(2):
             qi, ki = qsel[u], ksel[u]
-            qc = lax.dynamic_index_in_dim(qz, qi, axis=2, keepdims=False)
-            kc = lax.dynamic_index_in_dim(k_cur, ki, axis=2, keepdims=False)
-            vc = lax.dynamic_index_in_dim(v_cur, ki, axis=2, keepdims=False)
-            ac = lax.dynamic_index_in_dim(acc, qi, axis=2, keepdims=False)
-            mc = lax.dynamic_index_in_dim(m, qi, axis=2, keepdims=False)
-            lc = lax.dynamic_index_in_dim(l, qi, axis=2, keepdims=False)
+            qc = lax.dynamic_index_in_dim(qz, qi, axis=1, keepdims=False)
+            kc = lax.dynamic_index_in_dim(k_cur, ki, axis=1, keepdims=False)
+            vc = lax.dynamic_index_in_dim(v_cur, ki, axis=1, keepdims=False)
+            ac = lax.dynamic_index_in_dim(acc, qi, axis=1, keepdims=False)
+            mc = lax.dynamic_index_in_dim(m, qi, axis=1, keepdims=False)
+            lc = lax.dynamic_index_in_dim(l, qi, axis=1, keepdims=False)
             ac, mc, lc = full_update(qc, kc, vc, ac, mc, lc)
-            acc = lax.dynamic_update_index_in_dim(acc, ac, qi, axis=2)
-            m = lax.dynamic_update_index_in_dim(m, mc, qi, axis=2)
-            l = lax.dynamic_update_index_in_dim(l, lc, qi, axis=2)
+            acc = lax.dynamic_update_index_in_dim(acc, ac, qi, axis=1)
+            m = lax.dynamic_update_index_in_dim(m, mc, qi, axis=1)
+            l = lax.dynamic_update_index_in_dim(l, lc, qi, axis=1)
         return (
             (acc, m, l),
             lax.ppermute(k_cur, axis, perm),
@@ -308,7 +353,7 @@ def zigzag_ring_attention(
         1, g, hop,
         ((acc, m, l), lax.ppermute(kz, axis, perm), lax.ppermute(vz, axis, perm)),
     )
-    out = acc / jnp.maximum(l[..., None], 1e-30)
+    out = acc / denom(l)
     return out.reshape(b, h, sl, d).astype(q.dtype)
 
 
