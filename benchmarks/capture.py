@@ -109,7 +109,7 @@ def main():
             ("kernels_on_chip",
              [sys.executable, "benchmarks/kernels_on_chip.py"], 2400),
             ("allreduce_curve",
-             [sys.executable, "benchmarks/allreduce_curve.py"], 2400),
+             [sys.executable, "benchmarks/allreduce_curve.py", "--quant"], 2400),
             ("bucketing",
              [sys.executable, "benchmarks/bucketing_bench.py"], 1200),
         ]

@@ -191,10 +191,12 @@ def forward_local(params, tokens, cfg: TransformerConfig, sp: int, tp: int):
     h = (emb["tok"][tokens] + pos[None]).astype(cdt)
 
     if cfg.attention == "zigzag":
-        attn_fn = lambda q, k, v, ax, n, causal=True: (
-            zigzag_ring_attention(q, k, v, ax, n) if n > 1
-            else ring_attention(q, k, v, ax, n, causal=causal)
-        )
+        def attn_fn(q, k, v, ax, n, causal=True):
+            mlsl_assert(causal, "zigzag attention is causal-only "
+                                "(use attention='ring' for non-causal)")
+            if n > 1:
+                return zigzag_ring_attention(q, k, v, ax, n)
+            return ring_attention(q, k, v, ax, n, causal=True)
     else:
         attn_fn = ring_attention if cfg.attention == "ring" else ulysses_attention
     for i in range(cfg.n_blocks):

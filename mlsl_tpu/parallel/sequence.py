@@ -256,18 +256,16 @@ def zigzag_ring_attention(
         interpret = jax.default_backend() != "tpu"
         zoff = jnp.zeros((1,), jnp.int32)
 
-        def full_update(qc, kc, vc, ac, mc, lc):
-            # chunk fully visible: no mask, offsets irrelevant
-            return flash_block_update(
-                qc, kc, vc, ac, mc, lc, zoff, zoff, False, interpret
-            )
+        def _update(causal):
+            # causal=False: chunk fully visible (no mask, offsets irrelevant);
+            # causal=True: equal offsets = within-chunk lower triangle
+            def u(qc, kc, vc, ac, mc, lc):
+                return flash_block_update(
+                    qc, kc, vc, ac, mc, lc, zoff, zoff, causal, interpret
+                )
+            return u
 
-        def diag_update(qc, kc, vc, ac, mc, lc):
-            # equal offsets + causal = within-chunk lower triangle
-            return flash_block_update(
-                qc, kc, vc, ac, mc, lc, zoff, zoff, True, interpret
-            )
-
+        full_update, diag_update = _update(False), _update(True)
         as_chunks = lambda x: x.reshape(bh, 2, c, d)
         qz = as_chunks(q)
         m = _pvary(jnp.full((bh, 2, c, 128), NEG, jnp.float32), axis)
@@ -276,31 +274,26 @@ def zigzag_ring_attention(
     else:
         scale = 1.0 / jnp.sqrt(d).astype(jnp.float32)
 
-        def full_update(qc, kc, vc, ac, mc, lc):
-            """Unmasked (c x c) online-softmax update (chunk fully visible)."""
-            s = jnp.einsum("bqd,bkd->bqk", qc, kc) * scale
-            s_max = jnp.max(s, axis=-1)
-            m_new = jnp.maximum(mc, s_max)
-            p = jnp.exp(s - m_new[..., None])
-            corr = jnp.exp(mc - m_new)
-            l_new = lc * corr + jnp.sum(p, axis=-1)
-            a_new = ac * corr[..., None] + jnp.einsum("bqk,bkd->bqd", p, vc)
-            return a_new, m_new, l_new
+        def _update(causal):
+            """(c x c) online-softmax update; causal=True applies the
+            within-chunk lower triangle (self-hop diagonals only)."""
+            def u(qc, kc, vc, ac, mc, lc):
+                s = jnp.einsum("bqd,bkd->bqk", qc, kc) * scale
+                if causal:
+                    tri = jnp.arange(c)[None, :] <= jnp.arange(c)[:, None]
+                    s = jnp.where(tri[None], s, _NEG)
+                s_max = jnp.max(s, axis=-1)
+                m_new = jnp.maximum(mc, s_max)
+                p = jnp.exp(s - m_new[..., None])
+                if causal:
+                    p = jnp.where(s <= _NEG / 2, 0.0, p)
+                corr = jnp.exp(mc - m_new)
+                l_new = lc * corr + jnp.sum(p, axis=-1)
+                a_new = ac * corr[..., None] + jnp.einsum("bqk,bkd->bqd", p, vc)
+                return a_new, m_new, l_new
+            return u
 
-        def diag_update(qc, kc, vc, ac, mc, lc):
-            """Within-chunk causal (lower-triangular) update — self-hop only."""
-            s = jnp.einsum("bqd,bkd->bqk", qc, kc) * scale
-            tri = jnp.arange(c)[None, :] <= jnp.arange(c)[:, None]
-            s = jnp.where(tri[None], s, _NEG)
-            s_max = jnp.max(s, axis=-1)
-            m_new = jnp.maximum(mc, s_max)
-            p = jnp.exp(s - m_new[..., None])
-            p = jnp.where(s <= _NEG / 2, 0.0, p)
-            corr = jnp.exp(mc - m_new)
-            l_new = lc * corr + jnp.sum(p, axis=-1)
-            a_new = ac * corr[..., None] + jnp.einsum("bqk,bkd->bqd", p, vc)
-            return a_new, m_new, l_new
-
+        full_update, diag_update = _update(False), _update(True)
         as_chunks = lambda x: x.astype(jnp.float32).reshape(bh, 2, c, d)
         qz = as_chunks(q)
         m = _pvary(jnp.full((bh, 2, c), _NEG, jnp.float32), axis)
