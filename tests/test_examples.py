@@ -71,3 +71,10 @@ def test_compat_cpp_example_builds_and_runs():
                        env=_mesh_env(), cwd=REPO)
     assert r.returncode == 0, f"stdout:\n{r.stdout}\nstderr:\n{r.stderr[-2000:]}"
     assert "compat example OK" in r.stdout
+
+
+def test_long_context_example_runs():
+    r = _run_example("long_context.py")
+    assert r.returncode == 0, r.stderr[-2000:]
+    assert "long-context example OK" in r.stdout
+    assert "zigzag == ring trajectory (to rounding): OK" in r.stdout
