@@ -165,6 +165,63 @@ int main(void) {
     free(vcounts); free(vout); free(a2acnt); free(a2aout);
   }
 
+  /* general per-rank AlltoAllv through the dedicated full-matrix entry
+   * (mlsl_distribution_all_to_allv_full) on MODEL subgroups of a 2-model
+   * distribution, so world != group and the engine's true per-rank
+   * (world, group) table path runs (different instances exchange different
+   * geometries). S[w][j] = (3w + j) % 2 + 1 varies per WORLD rank; member j
+   * of w's instance is world rank base+j (model-minor layout, base = w -
+   * w%2); recv geometry R[w][j] = S[base+j][w%2] supplied explicitly.
+   * Payload: rank w's send buffer = w*100 + idx. */
+  if (world > 1 && world % 2 == 0) {
+    const int64_t G = 2;
+    mlsl_handle_t mdist =
+        mlsl_environment_create_distribution(world / G, G, 1);
+    CHECK(mdist != 0, "alltoallv_full distribution");
+    int64_t* S = malloc(sizeof(int64_t) * world * G);
+    int64_t* R = malloc(sizeof(int64_t) * world * G);
+    int64_t send_slot = 0, recv_slot = 0;
+    for (int64_t w = 0; w < world; ++w) {
+      int64_t base = w - (w % G), ssum = 0, rsum = 0;
+      for (int64_t j = 0; j < G; ++j) {
+        S[w * G + j] = (3 * w + j) % 2 + 1;
+        R[w * G + j] = (3 * (base + j) + (w % G)) % 2 + 1; /* = S[base+j][w%G] */
+        ssum += S[w * G + j];
+        rsum += R[w * G + j];
+      }
+      if (ssum > send_slot) send_slot = ssum;
+      if (rsum > recv_slot) recv_slot = rsum;
+    }
+    float* fsend = malloc(sizeof(float) * world * send_slot);
+    for (int64_t w = 0; w < world; ++w)
+      for (int64_t i = 0; i < send_slot; ++i)
+        fsend[w * send_slot + i] = (float)(w * 100 + i);
+    mlsl_handle_t fh = mlsl_distribution_all_to_allv_full(
+        mdist, fsend, send_slot, S, NULL, R, NULL, MLSL_DT_FLOAT,
+        MLSL_GT_MODEL);
+    float* fout = malloc(sizeof(float) * world * recv_slot);
+    CHECK(fh != 0 &&
+              mlsl_request_wait(fh, fout, recv_slot, MLSL_DT_FLOAT) == 0,
+          "alltoallv_full");
+    /* every rank's packed receive blocks: block from its instance member j
+     * (world rank q = base + j) has S[q][w%G] elems, values q*100 + (q's
+     * packed offset toward position w%G) + k */
+    for (int64_t w = 0; w < world; ++w) {
+      int64_t base = w - (w % G), roff = 0;
+      for (int64_t j = 0; j < G; ++j) {
+        int64_t q = base + j, qoff = 0;
+        for (int64_t t = 0; t < w % G; ++t) qoff += (3 * q + t) % 2 + 1;
+        for (int64_t k = 0; k < S[q * G + (w % G)]; ++k)
+          CHECK(fout[w * recv_slot + roff + k] == (float)(q * 100 + qoff + k),
+                "alltoallv_full value");
+        roff += S[q * G + (w % G)];
+      }
+    }
+    printf("alltoallv_full per-rank OK\n");
+    mlsl_handle_release(mdist);
+    free(S); free(R); free(fsend); free(fout);
+  }
+
   /* ---- model-parallel training through the activation API: the reference
    * cmlsl_test flow (pack via queried blocks -> StartComm -> peer WaitComm ->
    * unpack; case-1 ReduceScatter fwd / AllGather bwd) ---- */

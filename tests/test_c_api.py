@@ -33,6 +33,7 @@ def test_c_api_end_to_end():
     assert "world = 8" in run.stdout
     assert "allreduce OK (36)" in run.stdout
     assert "allgatherv/alltoallv OK" in run.stdout
+    assert "alltoallv_full per-rank OK" in run.stdout
     assert "activation fwd ReduceScatter OK" in run.stdout
     assert "activation bwd AllGather OK" in run.stdout
     assert "distributed-update increment AllGather OK" in run.stdout
