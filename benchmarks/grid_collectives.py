@@ -1,0 +1,75 @@
+"""BASELINE config 2: the Distribution(data x model) grid collective set.
+
+Times AllReduce + AllGather + Bcast + ReduceScatter over both the data and
+model groups of a hybrid grid (the reference's four grid collectives,
+BASELINE.json configs[1]) with the isolation methodology (best-of-blocks,
+d2h-synced). On one real chip the groups degenerate to the dispatch floor;
+on a mesh (virtual CPU or a real slice) the rows are group-wise algbw.
+
+Usage: MLSL_TPU_PLATFORM=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+       python benchmarks/grid_collectives.py
+Prints one JSON line per (collective, group).
+"""
+
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), ".."))
+
+
+def main():
+    from mlsl_tpu.sysinfo import apply_platform_override
+
+    apply_platform_override()
+
+    import numpy as np
+
+    import mlsl_tpu as mlsl
+    from mlsl_tpu.types import DataType, GroupType, ReductionType
+
+    env = mlsl.Environment.get_env().init()
+    world = env.get_process_count()
+    model = 2 if world % 2 == 0 and world > 1 else 1
+    dist = env.create_distribution(max(world // model, 1), model)
+    nbytes = 4 * 1024 * 1024  # 4 MiB fp32 per rank
+    count = nbytes // 4
+    buf = dist.make_buffer(
+        lambda p: p * 1.0 + np.arange(count, dtype=np.float64) % 977, count
+    )
+
+    from benchmarks._common import timed  # rtt-calibrated, 4-byte d2h sync
+
+    def run(kind, gt):
+        gsize = {GroupType.DATA: dist.get_process_count_data(),
+                 GroupType.MODEL: dist.get_process_count_model()}[gt]
+        if kind == "allreduce":
+            start = lambda: dist.all_reduce(
+                buf, count, DataType.FLOAT, ReductionType.SUM, gt)
+        elif kind == "allgather":
+            start = lambda: dist.all_gather(buf, count, DataType.FLOAT, gt)
+        elif kind == "bcast":
+            start = lambda: dist.bcast(buf, count, DataType.FLOAT, 0, gt)
+        else:  # reduce_scatter
+            per = max(count // max(gsize, 1), 1)
+            start = lambda: dist.reduce_scatter(
+                buf, per, DataType.FLOAT, ReductionType.SUM, gt)
+        ms = timed(lambda: start().wait(), iters=9, warmup=2, blocks=3)
+        row = {"metric": f"grid_{kind}", "group": gt.name.lower(),
+               "group_size": gsize, "us_per_op": round(ms * 1e3, 1),
+               "bytes": nbytes}
+        if gsize > 1:
+            row["algbw_gbs"] = round(nbytes / (ms / 1e3) / 1e9, 3)
+        else:
+            # one-member group: the request is the identity program — the row
+            # is the per-collective dispatch floor, not bandwidth
+            row["note"] = "degenerate group: dispatch floor"
+        return row
+
+    for kind in ("allreduce", "allgather", "bcast", "reduce_scatter"):
+        for gt in (GroupType.DATA, GroupType.MODEL):
+            print(json.dumps(run(kind, gt)))
+
+
+if __name__ == "__main__":
+    main()
