@@ -39,22 +39,32 @@ def main():
     )
 
     from benchmarks._common import timed  # rtt-calibrated, 4-byte d2h sync
+    from mlsl_tpu.comm.request import CommDesc, CommRequest
 
     def run(kind, gt):
         gsize = {GroupType.DATA: dist.get_process_count_data(),
                  GroupType.MODEL: dist.get_process_count_model()}[gt]
-        if kind == "allreduce":
-            start = lambda: dist.all_reduce(
-                buf, count, DataType.FLOAT, ReductionType.SUM, gt)
-        elif kind == "allgather":
-            start = lambda: dist.all_gather(buf, count, DataType.FLOAT, gt)
-        elif kind == "bcast":
-            start = lambda: dist.bcast(buf, count, DataType.FLOAT, 0, gt)
-        else:  # reduce_scatter
-            per = max(count // max(gsize, 1), 1)
-            start = lambda: dist.reduce_scatter(
-                buf, per, DataType.FLOAT, ReductionType.SUM, gt)
-        ms = timed(lambda: start().wait(), iters=9, warmup=2, blocks=3)
+        group = dist._group(gt)
+        # one prebuilt, reused request per row — the same steady-state the
+        # committed dispatch_floor metric measures (allreduce_curve.py), so
+        # degenerate-group rows stay comparable to it
+        kw = {}
+        if kind in ("allreduce", "reduce_scatter"):
+            kw["op"] = ReductionType.SUM
+        if kind == "bcast":
+            kw["root"] = 0
+        if kind == "reduce_scatter":
+            kw["recv_count"] = max(count // max(gsize, 1), 1)
+        req = CommRequest(
+            CommDesc(kind, group, count, DataType.FLOAT, **kw), env.dispatcher
+        )
+        req.setup()
+
+        def one():
+            req.start(buf)
+            return req.wait()
+
+        ms = timed(one, iters=9, warmup=2, blocks=3)
         row = {"metric": f"grid_{kind}", "group": gt.name.lower(),
                "group_size": gsize, "us_per_op": round(ms * 1e3, 1),
                "bytes": nbytes}

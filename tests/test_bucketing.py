@@ -201,6 +201,47 @@ def test_bucket_error_reaches_every_member(bucket_env):
         pss[1].wait_gradient_comm()
 
 
+def test_bucketing_with_priority_scheduler(bucket_env, monkeypatch):
+    """The bucket's coalesced request rides the newest-first deferral queue
+    like any large allreduce (MLSL_MSG_PRIORITY): training stays oracle-exact
+    with both features on, and the deferral path REALLY engages (the MLP
+    bucket's payload is 212 fp32 = 848 B, so the threshold sits below it)."""
+    import jax.numpy as jnp
+
+    from mlsl_tpu.comm.request import Dispatcher
+
+    env = bucket_env
+    env.config.msg_priority = True
+    env.config.msg_priority_threshold = 512  # < 848 B bucket payload: defers
+    deferrals = []
+    orig_note = Dispatcher._note_deferred_locked
+    monkeypatch.setattr(
+        Dispatcher, "_note_deferred_locked",
+        lambda self: (deferrals.append(1), orig_note(self))[1],
+    )
+    try:
+        x, y = _make_data(32)
+        t = _trainer(env)
+        pss = [t.ops[n].get_parameter_set(0) for n in LAYERS]
+        assert all(ps.bucket is not None for ps in pss)
+
+        ref = mlp_init(jax.random.PRNGKey(0))
+        for _ in range(2):
+            t.step(t.shard_batch(x, y))
+            g = jax.grad(mlp_loss)(ref, (jnp.asarray(x), jnp.asarray(y)))
+            ref = jax.tree.map(lambda p, gg: p - 0.1 * gg, ref, g)
+        assert deferrals, "bucketed request never entered the deferral queue"
+        for name in LAYERS:
+            for a, b in zip(
+                jax.tree.leaves(get_layer(jax.device_get(t.params), name)),
+                jax.tree.leaves(get_layer(jax.device_get(ref), name)),
+            ):
+                np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                           atol=2e-5, rtol=2e-4)
+    finally:
+        env.config.msg_priority = False
+
+
 def test_bucket_eligibility(bucket_env):
     """distributed_update and compressed sets stay individual; a singleton
     leftover is not bucketed (a 1-member bucket is pure overhead)."""
