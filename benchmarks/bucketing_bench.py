@@ -38,7 +38,7 @@ def main():
     world = env.get_process_count()
     dist = env.create_distribution(world, 1)
 
-    def build(nlayers, count, bucket_mb):
+    def build(nlayers, count, bucket_mb, du=False):
         env.config.grad_bucket_mb = bucket_mb
         s = env.create_session()
         s.set_global_minibatch_size(8)
@@ -47,7 +47,7 @@ def main():
             r = s.create_operation_reg_info(OpType.CC)
             r.add_input(8, 4)
             r.add_output(8, 4)
-            r.add_parameter_set(count, 1)
+            r.add_parameter_set(count, 1, distributed_update=du)
             ops.append(s.get_operation(s.add_operation(r, dist)))
         s.commit()
         env.config.grad_bucket_mb = 0
@@ -94,6 +94,44 @@ def main():
             "speedup": round(times["individual_ms"] / times["bucketed_ms"], 3),
             "unit": "ms",
         }))
+
+    # ZeRO-1: both phases (grad reduce_scatter + increment all_gather) bucket
+    cnt = 2048
+    bufs = [dist.make_buffer(lambda p: p + np.arange(cnt, dtype=np.float64), cnt)
+            for _ in range(NL)]
+    from benchmarks._common import device_sync
+
+    def du_step(pss):
+        owned = {}
+        for ps, b in zip(reversed(pss), reversed(bufs)):
+            ps.start_gradient_comm(b)
+        for ps in pss:
+            owned[ps] = ps.wait_gradient_comm()
+        for ps in pss:
+            ps.start_increment_comm(owned[ps])
+        outs = [ps.wait_increment_comm() for ps in pss]
+        device_sync(outs[-1])
+
+    times = {}
+    for label, mb in (("individual_ms", 0), ("bucketed_ms", 4)):
+        pss = build(NL, cnt, mb, du=True)
+        for _ in range(3):
+            du_step(pss)
+        best = float("inf")
+        for _ in range(5):
+            t0 = time.perf_counter()
+            for _ in range(5):
+                du_step(pss)
+            best = min(best, (time.perf_counter() - t0) / 5)
+        times[label] = round(best * 1e3, 3)
+    print(json.dumps({
+        "metric": "zero1_bucketing_step",
+        "layers": NL,
+        "grad_kib": cnt * 4 // 1024,
+        **times,
+        "speedup": round(times["individual_ms"] / times["bucketed_ms"], 3),
+        "unit": "ms",
+    }))
 
 
 if __name__ == "__main__":

@@ -36,7 +36,18 @@ from mlsl_tpu.types import CompressionType, ReductionType
 
 
 class GradBucket:
-    """One coalesced allreduce shared by several ParameterSets.
+    """One coalesced collective shared by several ParameterSets.
+
+    ``kind`` selects the phase being coalesced:
+      - "allreduce":      plain gradient sync (each member contributes its
+                          local gradient vector; receives the group sum slice)
+      - "reduce_scatter": ZeRO-1 gradient phase (member buffers are G chunks
+                          of owned elements; the pack interleaves chunks so
+                          one reduce_scatter delivers every member's owned
+                          shard inside this rank's chunk)
+      - "allgather":      ZeRO-1 increment phase (owned shards concatenate;
+                          one all_gather; the unpack reassembles each
+                          member's group-rank-major shard concatenation)
 
     Round lifecycle (all transitions under _lock):
       collecting --(all members registered)--> dispatched
@@ -48,39 +59,77 @@ class GradBucket:
     round (counts as consumed) and runs individually.
     """
 
-    def __init__(self, members: List, env):
+    def __init__(self, members: List, env, kind: str = "allreduce"):
         # members in START order (reverse creation = backward pass order)
         self.members = members
+        self.kind = kind
+        # which ParameterSet round flag / fallback request this bucket drives
+        self.round_attr = (
+            "_inc_bucket_round" if kind == "allgather" else "_bucket_round"
+        )
+        self.req_attr = "inc_req" if kind == "allgather" else "grad_req"
         self._idx = {id(ps): i for i, ps in enumerate(members)}
+        # owned elements per member (== local for the plain allreduce path)
         self.counts = [ps.owned_kernel_count * ps.kernel_size for ps in members]
         self.offsets = [0]
         for c in self.counts[:-1]:
             self.offsets.append(self.offsets[-1] + c)
         total = sum(self.counts)
         ps0 = members[0]
-        self.req = CommRequest(
-            CommDesc(
-                "allreduce",
-                ps0.dist.grad_group,
-                total,
-                ps0.data_type,
-                compute_type=ComputeType.PARAM_GRAD,
-                op=ReductionType.SUM,
-            ),
-            env.dispatcher,
-            name=f"bucket[{len(members)}x{total}]",
-        )
-        self.req.setup()
+        group = ps0.dist.grad_group
+        g = 1 if group.is_self else group.size
+        offsets, counts = self.offsets, self.counts
         # jitted pack/unpack: EAGER concatenate/slice on sharded arrays pays
         # one full dispatch per op (~2 ms each on the CPU mesh); one compiled
         # program for the whole pack and one for the whole unpack keeps the
         # bucket's overhead below a single member's dispatch cost
+        sl = lambda x, a, b: jax.lax.slice_in_dim(x, a, b, axis=x.ndim - 1)
+        # plain concat pack / offset-slice unpack are the defaults; each kind
+        # overrides only its genuinely different side
         self._concat = jax.jit(lambda *xs: jnp.concatenate(xs, axis=-1))
-        offsets, counts = self.offsets, self.counts
         self._split = jax.jit(lambda x: tuple(
-            jax.lax.slice_in_dim(x, o, o + c, axis=x.ndim - 1)
-            for o, c in zip(offsets, counts)
+            sl(x, o, o + c) for o, c in zip(offsets, counts)
         ))
+        if kind == "allreduce":
+            desc = CommDesc(
+                "allreduce", group, total, ps0.data_type,
+                compute_type=ComputeType.PARAM_GRAD, op=ReductionType.SUM,
+            )
+        elif kind == "reduce_scatter":
+            # member m's buffer is G chunks of counts[m]; chunk r of the
+            # PACKED buffer must hold every member's chunk r so the scatter
+            # hands rank r one contiguous (total,) block
+            desc = CommDesc(
+                "reduce_scatter", group, total * g, ps0.data_type,
+                compute_type=ComputeType.PARAM_GRAD, op=ReductionType.SUM,
+                recv_count=total,
+            )
+            self._concat = jax.jit(lambda *xs: jnp.concatenate(
+                [sl(x, r * c, (r + 1) * c)
+                 for r in range(g) for x, c in zip(xs, counts)],
+                axis=-1,
+            ))
+        elif kind == "allgather":
+            # result is G blocks of (total,); member m's shard concatenation
+            # = its offsets[m] slice of every block, in group-rank order
+            desc = CommDesc(
+                "allgather", group, total, ps0.data_type,
+                compute_type=ComputeType.PARAM_INC,
+            )
+            self._split = jax.jit(lambda x: tuple(
+                jnp.concatenate(
+                    [sl(x, r * total + o, r * total + o + c) for r in range(g)],
+                    axis=-1,
+                )
+                for o, c in zip(offsets, counts)
+            ))
+        else:  # pragma: no cover - kinds are closed
+            raise ValueError(kind)
+        self.req = CommRequest(
+            desc, env.dispatcher,
+            name=f"bucket-{kind}[{len(members)}x{total}]",
+        )
+        self.req.setup()
         self._lock = threading.Lock()
         self._bufs: dict = {}        # member index -> buffer (this round)
         self._dispatched = False
@@ -120,15 +169,15 @@ class GradBucket:
     def _fallback_locked(self) -> None:
         """A member was waited/tested before the bucket filled: dispatch every
         registered member's individual request and re-arm. Those members'
-        current round becomes individual (ps._bucket_round cleared)."""
+        current round becomes individual (their round flag cleared)."""
         log_debug(
-            "grad bucket fallback: %d/%d members started",
-            len(self._bufs), len(self.members),
+            "%s bucket fallback: %d/%d members started",
+            self.kind, len(self._bufs), len(self.members),
         )
         for j, buf in self._bufs.items():
             ps = self.members[j]
-            ps.grad_req.start(buf)
-            ps._bucket_round = False
+            getattr(ps, self.req_attr).start(buf)
+            setattr(ps, self.round_attr, False)
         self._bufs.clear()
         self._consumed.clear()
 
@@ -218,52 +267,88 @@ class GradBucket:
             return True, True, self._part_locked(out, i)
 
 
+def _pack_by_size(pss: List, limit: int, size_of) -> List[List]:
+    """Greedy packing in reverse creation (= backward start) order; singleton
+    groups are dropped (a 1-member bucket is pure overhead). ``size_of(ps)``
+    is the member's WIRE contribution — full local gradient bytes, so an
+    already-bandwidth-sized layer is excluded regardless of how its buffer is
+    chunked."""
+    cur: List = []
+    cur_bytes = 0
+    groups: List[List] = []
+    for ps in reversed(pss):
+        nbytes = size_of(ps)
+        if nbytes >= limit:
+            # bandwidth-sized already: bucketing adds only copy traffic
+            if len(cur) > 1:
+                groups.append(cur)
+            cur, cur_bytes = [], 0
+            continue
+        if cur_bytes + nbytes > limit and cur:
+            if len(cur) > 1:
+                groups.append(cur)
+            cur, cur_bytes = [], 0
+        cur.append(ps)
+        cur_bytes += nbytes
+    if len(cur) > 1:
+        groups.append(cur)
+    return groups
+
+
 def build_buckets(session, bucket_mb: int) -> int:
-    """Pack eligible ParameterSets into GradBuckets (called at Commit).
-    Returns the number of buckets formed."""
+    """Pack eligible ParameterSets into GradBuckets (called at Commit):
+    plain sets coalesce their gradient allreduce; distributed-update (ZeRO-1)
+    sets coalesce BOTH phases — the gradient reduce_scatter (uncompressed
+    only; quantized grads ride the compressed ring individually) and the
+    increment all_gather. Returns the number of buckets formed."""
     from mlsl_tpu.comm.collectives import _group_key
     from mlsl_tpu.types import dtype_size
 
-    eligible: dict = {}  # (group key, dtype) -> [ps] in creation order
+    plain: dict = {}  # (group key, dtype) -> [ps] in creation order
+    du: dict = {}
     for op in session.operations:
         for ps in op.parameter_sets:
+            if not ps.need_comm:
+                continue
+            key = (_group_key(ps.dist.grad_group), ps.data_type)
             if (
-                ps.need_comm
-                and not ps.distributed_update
+                not ps.distributed_update
                 and ps.compression == CompressionType.NONE
                 and ps.bucket is None
             ):
-                key = (_group_key(ps.dist.grad_group), ps.data_type)
-                eligible.setdefault(key, []).append(ps)
+                plain.setdefault(key, []).append(ps)
+            elif ps.distributed_update:
+                du.setdefault(key, []).append(ps)
 
     limit = bucket_mb * 1024 * 1024
     n_buckets = 0
-    for (_, dt), pss in eligible.items():
-        esize = dtype_size(dt)
-        cur: List = []
-        cur_bytes = 0
-        groups: List[List] = []
-        for ps in reversed(pss):  # backward-pass start order
-            nbytes = ps.owned_kernel_count * ps.kernel_size * esize
-            if nbytes >= limit:
-                # bandwidth-sized already: bucketing adds only copy traffic
-                if len(cur) > 1:
-                    groups.append(cur)
-                cur, cur_bytes = [], 0
-                continue
-            if cur_bytes + nbytes > limit and cur:
-                if len(cur) > 1:
-                    groups.append(cur)
-                cur, cur_bytes = [], 0
-            cur.append(ps)
-            cur_bytes += nbytes
-        if len(cur) > 1:
-            groups.append(cur)
-        for members in groups:
-            bucket = GradBucket(members, session.env)
+
+    def form(pss, kind, attr):
+        nonlocal n_buckets
+        if not pss:
+            return
+        esize = dtype_size(pss[0].data_type)
+        grp = pss[0].dist.grad_group
+        g = 1 if grp.is_self else grp.size
+        # member's wire contribution: full LOCAL gradient bytes — for the
+        # ZeRO-1 reduce_scatter that is owned * g (the whole chunked buffer),
+        # so bandwidth-sized layers are excluded consistently across kinds
+        mult = g if kind == "reduce_scatter" else 1
+        size_of = lambda ps: ps.owned_kernel_count * ps.kernel_size * esize * mult
+        for members in _pack_by_size(pss, limit, size_of):
+            bucket = GradBucket(members, session.env, kind=kind)
             for ps in members:
-                ps.bucket = bucket
+                setattr(ps, attr, bucket)
             n_buckets += 1
+
+    for pss in plain.values():
+        form(pss, "allreduce", "bucket")
+    for pss in du.values():
+        form([ps for ps in pss
+              if ps.compression == CompressionType.NONE and ps.bucket is None],
+             "reduce_scatter", "bucket")
+        form([ps for ps in pss if ps.inc_bucket is None],
+             "allgather", "inc_bucket")
     if n_buckets:
         log_debug("grad bucketing: %d bucket(s) formed", n_buckets)
     return n_buckets

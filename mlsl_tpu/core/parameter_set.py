@@ -61,11 +61,14 @@ class ParameterSet:
         self.grad_req: Optional[CommRequest] = None
         self.inc_req: Optional[CommRequest] = None
         # gradient bucketing (core/bucketing.py, assigned at Session.commit):
-        # the bucket opportunistically coalesces this set's grad allreduce
-        # with its neighbors'; _bucket_round tracks whether the CURRENT round
-        # is bucket-owned or individual (fallback)
+        # the buckets opportunistically coalesce this set's grad collective
+        # (allreduce, or ZeRO-1 reduce_scatter) and its increment all_gather
+        # with its neighbors'; the *_round flags track whether the CURRENT
+        # round is bucket-owned or individual (fallback)
         self.bucket = None
         self._bucket_round = False
+        self.inc_bucket = None
+        self._inc_bucket_round = False
         env = op.session.env
         if self.need_comm:
             n_owned = self.owned_kernel_count * self.kernel_size
@@ -189,7 +192,11 @@ class ParameterSet:
         """AllGather the locally updated owned shard (distributedUpdate only)."""
         self.op.session._stat_event(self, "start", is_param=True, is_increment=True)
         if self.need_comm and self.distributed_update:
-            self.inc_req.start(inc_buf)
+            if self.inc_bucket is not None and self.inc_bucket.start(self, inc_buf):
+                self._inc_bucket_round = True
+            else:
+                self._inc_bucket_round = False
+                self.inc_req.start(inc_buf)
         self.op.session._stat_event(
             self, "start_done", is_param=True, is_increment=True
         )
@@ -197,7 +204,12 @@ class ParameterSet:
     def wait_increment_comm(self):
         self.op.session._stat_event(self, "wait", is_param=True, is_increment=True)
         out = None
-        if self.need_comm and self.distributed_update and self.inc_req.is_started:
+        if self.need_comm and self.distributed_update and self._inc_bucket_round:
+            handled, out = self.inc_bucket.wait(self)
+            if not handled:
+                self._inc_bucket_round = False
+                out = self.inc_req.wait()
+        elif self.need_comm and self.distributed_update and self.inc_req.is_started:
             out = self.inc_req.wait()
         self.op.session._stat_event(self, "wait_done", is_param=True, is_increment=True)
         return out

@@ -24,7 +24,7 @@ def bucket_env(env):
     env.config.grad_bucket_mb = 0
 
 
-def _trainer(env, overlap_updates=False):
+def _trainer(env, overlap_updates=False, distributed_update=False):
     from mlsl_tpu.models.train import DataParallelTrainer
 
     params = mlp_init(jax.random.PRNGKey(0))
@@ -34,6 +34,7 @@ def _trainer(env, overlap_updates=False):
     return DataParallelTrainer(
         env, dist, sess, params, mlp_loss, LAYERS, get_layer, lr=0.1,
         force_graph_path=True, overlap_updates=overlap_updates,
+        distributed_update=distributed_update,
     )
 
 
@@ -67,6 +68,61 @@ def test_bucketed_training_matches_unbucketed(env, overlap_updates):
             np.testing.assert_array_equal(np.asarray(g), np.asarray(w))
 
 
+def test_zero1_bucketed_matches_unbucketed(env):
+    """ZeRO-1: BOTH phases coalesce (gradient reduce_scatter + increment
+    all_gather) and training matches the unbucketed run exactly."""
+    x, y = _make_data(32)
+
+    env.config.grad_bucket_mb = 0
+    t_plain = _trainer(env, distributed_update=True)
+    env.config.grad_bucket_mb = 4
+    t_bucket = _trainer(env, distributed_update=True)
+    env.config.grad_bucket_mb = 0
+
+    pss = [t_bucket.ops[n].get_parameter_set(0) for n in LAYERS]
+    assert all(ps.bucket is not None and ps.bucket.kind == "reduce_scatter"
+               for ps in pss)
+    assert all(ps.inc_bucket is not None and ps.inc_bucket.kind == "allgather"
+               for ps in pss)
+
+    for _ in range(3):
+        t_plain.step(t_plain.shard_batch(x, y))
+        t_bucket.step(t_bucket.shard_batch(x, y))
+    for name in LAYERS:
+        for g, w in zip(
+            jax.tree.leaves(get_layer(jax.device_get(t_bucket.params), name)),
+            jax.tree.leaves(get_layer(jax.device_get(t_plain.params), name)),
+        ):
+            np.testing.assert_array_equal(np.asarray(g), np.asarray(w))
+
+
+def test_zero1_bucket_dispatch_count(bucket_env):
+    """One ZeRO-1 step = exactly TWO bucket dispatches (one reduce_scatter +
+    one all_gather) instead of two per layer."""
+    from mlsl_tpu.comm.request import CommRequest
+
+    t = _trainer(bucket_env, distributed_update=True)
+    x, y = _make_data(32)
+    batch = t.shard_batch(x, y)
+    t.step(batch)  # warm
+
+    started = []
+    orig = CommRequest.start
+
+    def rec(self, buf):
+        started.append(self.name or self.uid)
+        return orig(self, buf)
+
+    try:
+        CommRequest.start = rec
+        t.step(batch)
+    finally:
+        CommRequest.start = orig
+    assert sorted(str(s).split("[")[0] for s in started) == [
+        "bucket-allgather", "bucket-reduce_scatter",
+    ], started
+
+
 def test_bucket_coalesces_dispatches(bucket_env):
     """One step = ONE bucket allreduce dispatch instead of one per layer."""
     from mlsl_tpu.comm.request import CommRequest
@@ -88,7 +144,8 @@ def test_bucket_coalesces_dispatches(bucket_env):
         t.step(batch)
     finally:
         CommRequest.start = orig
-    bucket_starts = [s for s in started if str(s).startswith("bucket[")]
+    bucket_starts = [s for s in started
+                     if str(s).startswith("bucket-allreduce[")]
     assert len(bucket_starts) == 1, started
     # no individual grad request fired
     assert len(started) == 1, started
