@@ -320,3 +320,34 @@ def test_bucket_eligibility(bucket_env):
     s.commit()
     assert op1.get_parameter_set(0).bucket is None  # singleton: not bucketed
     assert op2.get_parameter_set(0).bucket is None  # distributed_update path
+
+
+def test_hybrid_transformer_bucketed_matches_oracle(bucket_env):
+    """Bucketing through the HybridTrainer's per-layer graph path: TP-sharded
+    layers coalesce their data x seq gradient sync, the bucket rounds actually
+    serve each step (no silent fallback), and training matches the
+    single-device oracle."""
+    from mlsl_tpu.models import transformer as tfm
+    from tests.test_transformer import _assert_params_close, _oracle_steps
+
+    env = bucket_env
+    cfg = tfm.TransformerConfig(vocab=64, d_model=32, n_heads=4, head_dim=8,
+                                n_blocks=2, seq_len=32, dtype="float32")
+    tr = tfm.HybridTrainer(env, cfg, dp=2, sp=2, tp=2, batch=4, lr=0.5,
+                           devices=env.devices[:8])
+    names = tfm.layer_names(cfg)
+    bucketed = [n for n in names
+                if tr.ops[n].get_parameter_set(0).bucket is not None]
+    assert len(bucketed) >= 2, "no transformer layers coalesced"
+
+    rng = np.random.default_rng(0)
+    toks = rng.integers(0, 64, size=(4, 32)).astype(np.int32)
+    labels = rng.integers(0, 64, size=(4, 32)).astype(np.int32)
+    st, sl = tr.shard_tokens(toks, labels)
+    ref = tfm.init_params(jax.random.PRNGKey(0), cfg)
+    for _ in range(2):
+        tr.step(st, sl)
+    # the bucket rounds actually served the steps (no silent fallback)
+    assert all(tr.ops[n].get_parameter_set(0)._bucket_round for n in bucketed)
+    ref, _ = _oracle_steps(ref, toks, labels, 0.5, 2, cfg=cfg)
+    _assert_params_close(tr, ref)
