@@ -213,11 +213,10 @@ def _body_alltoallv(x, *, axes, sizes, S=None, Soff=None, Roff=None, recv_len=No
     per (j, me) pair, so slices use a static max length with a validity mask.
     """
     if S_tab is not None:
-        me_w = _group_rank(ALL_AXES, sizes)
-        sel = lambda t: jnp.take(jnp.asarray(t, dtype=jnp.int32), me_w, axis=0)
-        return _alltoallv_core(
-            _gather_group(x, axes), _group_rank(axes, sizes), x.dtype,
-            sel(S_tab), sel(Soff_tab), sel(Roff_tab), recv_len, lmax=lmax,
+        return _alltoallv_per_rank(
+            _gather_group(x, axes), _group_rank(ALL_AXES, sizes),
+            _group_rank(axes, sizes), x.dtype,
+            S_tab, Soff_tab, Roff_tab, recv_len, lmax,
         )
     return _alltoallv_core(
         _gather_group(x, axes), _group_rank(axes, sizes), x.dtype,
@@ -318,6 +317,18 @@ def _per_rank_alltoallv_tables(group: ProcessGroup, kw: dict) -> dict:
     out["Roff_tab"] = to3(Rwoff[M])
     out["lmax"] = max(int(Sw.max()), 1) if Sw.size else 1
     return out
+
+
+def _alltoallv_per_rank(g_members, me_w, me_pos, x_dtype,
+                        S_tab, Soff_tab, Roff_tab, recv_len, lmax):
+    """Select this world rank's instance matrices from the (W, G, G) tables by
+    the traced index ``me_w`` and run the shared merge — the one helper behind
+    the axis-aligned, flat-subgroup, and single-member per-rank paths."""
+    sel = lambda t: jnp.take(jnp.asarray(t, dtype=jnp.int32), me_w, axis=0)
+    return _alltoallv_core(
+        g_members, me_pos, x_dtype,
+        sel(S_tab), sel(Soff_tab), sel(Roff_tab), recv_len, lmax=lmax,
+    )
 
 
 def _alltoallv_core(g_members, me_pos, x_dtype, S, Soff, Roff, recv_len, lmax=None):
@@ -428,17 +439,10 @@ def _make_subgroup_body(kind: str, groups: Tuple[Tuple[int, ...], ...], *,
     if kind == "alltoallv":
         if S_tab is not None:
             # per-rank tables: select this world rank's instance matrices
-            def body_a2av(v):
-                me_w = lax.axis_index("world")
-                sel = lambda t: jnp.take(
-                    jnp.asarray(t, dtype=jnp.int32), me_w, axis=0
-                )
-                return _alltoallv_core(
-                    gather_group(v), mypos(), v.dtype,
-                    sel(S_tab), sel(Soff_tab), sel(Roff_tab), recv_len,
-                    lmax=lmax,
-                )
-            return body_a2av
+            return lambda v: _alltoallv_per_rank(
+                gather_group(v), lax.axis_index("world"), mypos(), v.dtype,
+                S_tab, Soff_tab, Roff_tab, recv_len, lmax,
+            )
         return lambda v: _alltoallv_core(
             gather_group(v), mypos(), v.dtype, S, Soff, Roff, recv_len
         )
@@ -650,14 +654,10 @@ def build_collective(kind: str, group: ProcessGroup, dtype, **kw) -> Callable:
             # per-rank mode on a 1-member group: a local repack (each rank moves
             # its own soff-segment to its roff slot)
             def body(x, _kw=kw):
-                me_w = _group_rank(ALL_AXES, sizes)
-                sel = lambda t: jnp.take(
-                    jnp.asarray(t, dtype=jnp.int32), me_w, axis=0
-                )
-                return _alltoallv_core(
-                    x[None], jnp.int32(0), x.dtype,
-                    sel(_kw["S_tab"]), sel(_kw["Soff_tab"]), sel(_kw["Roff_tab"]),
-                    _kw["recv_len"], lmax=_kw["lmax"],
+                return _alltoallv_per_rank(
+                    x[None], _group_rank(ALL_AXES, sizes), jnp.int32(0),
+                    x.dtype, _kw["S_tab"], _kw["Soff_tab"], _kw["Roff_tab"],
+                    _kw["recv_len"], _kw["lmax"],
                 )
         else:
             def body(x, _kind=kind, _kw=kw):

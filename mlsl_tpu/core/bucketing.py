@@ -136,6 +136,8 @@ class GradBucket:
         self._parts = None           # split bucket result (this round)
         self._consumed: set = set()
         self._last: dict = {}        # member index -> last delivered result
+        self._round = 0              # bumped at every re-arm: detects a round
+                                     # completing under an out-of-lock wait
         # a failed bucket dispatch must raise at EVERY member's wait/test —
         # like the per-layer path, where each request raises its own error —
         # not only at the first waiter (CommRequest consumes its error once)
@@ -149,6 +151,12 @@ class GradBucket:
         round for ps; False = run this start on ps's individual request."""
         i = self._idx[id(ps)]
         with self._lock:
+            if self._error is not None:
+                # THIS member's restart supersedes its undelivered error (the
+                # CommRequest.start contract); other members still collect it
+                self._error_left.discard(i)
+                if not self._error_left:
+                    self._error = None
             if self._dispatched:
                 # restart while the bucket is in flight: abandon the slot for
                 # this round and run individually (well-defined supersede
@@ -180,6 +188,7 @@ class GradBucket:
             setattr(ps, self.round_attr, False)
         self._bufs.clear()
         self._consumed.clear()
+        self._round += 1
 
     def _consume_locked(self, i: int) -> None:
         self._consumed.add(i)
@@ -188,6 +197,7 @@ class GradBucket:
             self._consumed.clear()
             self._dispatched = False
             self._parts = None
+            self._round += 1
 
     def _part_locked(self, out, i: int):
         if self._parts is None:
@@ -204,6 +214,7 @@ class GradBucket:
         self._consumed.clear()
         self._dispatched = False
         self._parts = None
+        self._round += 1
 
     def _raise_error_locked(self, i: int) -> None:
         err = self._error
@@ -228,6 +239,12 @@ class GradBucket:
                     return True, self._last.get(i)
                 self._fallback_locked()
                 return False, None
+            if i in self._consumed:
+                # duplicate wait on an already-consumed member: MPI no-op —
+                # MUST not touch req.wait again (the round may re-arm under a
+                # second out-of-lock wait and stale parts would be installed)
+                return True, self._last.get(i)
+            r0 = self._round
         # Blocking wait OUTSIDE the lock: a concurrent Test on another member
         # must stay a non-blocking poll. Safe on success: the round cannot
         # re-arm (or the request restart) until THIS member consumes, and
@@ -243,6 +260,14 @@ class GradBucket:
                     self._record_error_locked(e)
                 self._raise_error_locked(i)
         with self._lock:
+            if self._round != r0:
+                # the round completed (or failed over) under us — a concurrent
+                # duplicate wait consumed this member; its delivered result is
+                # cached, and splitting the stale `out` would poison the NEW
+                # round's _parts
+                if self._error is not None and i in self._error_left:
+                    self._raise_error_locked(i)
+                return True, self._last.get(i)
             return True, self._part_locked(out, i)
 
     def test(self, ps):
@@ -256,6 +281,8 @@ class GradBucket:
                     return True, True, self._last.get(i)
                 self._fallback_locked()
                 return False, False, None
+            if i in self._consumed:  # duplicate poll: MPI no-op
+                return True, True, self._last.get(i)
             try:
                 done, out = self.req.test()
             except Exception as e:

@@ -257,6 +257,21 @@ def test_bucket_error_reaches_every_member(bucket_env):
     with pytest.raises(RuntimeError, match="bucket dispatch failed"):
         pss[1].wait_gradient_comm()
 
+    # a member that never collected its error and RESTARTS supersedes it
+    # (the CommRequest.start contract): its wait must run the fallback, not
+    # re-raise the dead round's failure
+    try:
+        type(bucket.req).wait = lambda self: (_ for _ in ()).throw(boom)
+        pss[0].start_gradient_comm(buf)
+        pss[1].start_gradient_comm(buf)
+        with pytest.raises(RuntimeError, match="bucket dispatch failed"):
+            pss[0].wait_gradient_comm()     # consumes member 0's error
+    finally:
+        type(bucket.req).wait = orig_wait
+    pss[1].start_gradient_comm(buf)         # member 1 restarts instead
+    out = pss[1].wait_gradient_comm()       # partial round -> fallback
+    np.testing.assert_allclose(np.asarray(out)[0, 0, 0, 0], want, rtol=1e-6)
+
 
 def test_bucketing_with_priority_scheduler(bucket_env, monkeypatch):
     """The bucket's coalesced request rides the newest-first deferral queue
