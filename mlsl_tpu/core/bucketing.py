@@ -165,10 +165,8 @@ class GradBucket:
                 return False
             self._bufs[i] = buf  # a pre-dispatch restart supersedes
             if len(self._bufs) == len(self.members):
-                # a fresh round supersedes an undelivered error (the same
-                # contract as CommRequest.start resetting _dispatch_error)
-                self._error = None
-                self._error_left.clear()
+                # _error is necessarily None here: every member passed the
+                # per-member supersede block above on its way into this round
                 ordered = [self._bufs[j] for j in range(len(self.members))]
                 self.req.start(self._concat(*ordered))
                 self._dispatched = True

@@ -99,17 +99,22 @@ dist = env.create_distribution(8 // model_parts, model_parts)
 
 # DCN/ICI hierarchy contract (SURVEY aux: model groups must ride intra-host
 # links, only the data axis crosses hosts): every model group's devices live
-# in ONE process; every gradient (data) group spans ALL processes.
+# in ONE process; every gradient (data) group spans processes. With ONE
+# device per process (the reference's -ppn 1 extreme) intra-host model
+# groups are impossible by construction — every collective is cross-process
+# — so only the spanning half applies there.
 devs = dist.topology.mesh.devices
 for p in range(8):
     _, members = model_members(dist, p)
     mprocs = {devs[dist.topology.coords(q)].process_index for q in members}
-    assert len(mprocs) == 1, f"model group of {p} crosses hosts: {mprocs}"
+    if ndev >= model_parts:
+        assert len(mprocs) == 1, f"model group of {p} crosses hosts: {mprocs}"
     gmembers = [q for q in range(8)
                 if dist.topology.coords(q)[0] == dist.topology.coords(p)[0]
                 and dist.topology.coords(q)[3] == dist.topology.coords(p)[3]]
     gprocs = {devs[dist.topology.coords(q)].process_index for q in gmembers}
-    assert len(gprocs) == nproc, f"grad group of {p} spans {gprocs}, want all {nproc}"
+    want = min(nproc, len(gmembers))
+    assert len(gprocs) == want, f"grad group of {p} spans {gprocs}, want {want}"
 print(f"proc {pid} hierarchy OK", flush=True)
 
 # Rooted host-delivered gather across processes (docs/DESIGN.md 'Rooted
@@ -280,3 +285,12 @@ def test_four_process_e2e_graph_matrix(tmp_path):
     tests/examples/mlsl_test/Makefile:56-105): 4 processes x 2 devices,
     model groups intra-process, data/grad groups spanning all four."""
     _run_matrix(tmp_path, nproc=4)
+
+
+@pytest.mark.slow
+@pytest.mark.filterwarnings("ignore")
+def test_eight_process_e2e_graph_matrix(tmp_path):
+    """One device per process — the true -ppn 1 extreme: EVERY collective
+    crosses process boundaries (model groups included), the closest analog to
+    the reference's per-rank MPI processes."""
+    _run_matrix(tmp_path, nproc=8)
