@@ -366,3 +366,40 @@ def test_hybrid_transformer_bucketed_matches_oracle(bucket_env):
     assert all(tr.ops[n].get_parameter_set(0)._bucket_round for n in bucketed)
     ref, _ = _oracle_steps(ref, toks, labels, 0.5, 2, cfg=cfg)
     _assert_params_close(tr, ref)
+
+
+def test_stats_attribution_with_bucketing(bucket_env):
+    """Statistics stay per-layer under bucketing: each op's comm bytes are its
+    OWN gradient's bytes (from its request descriptor), not the coalesced
+    wire message's."""
+    env = bucket_env
+    env.config.enable_stats = True
+    try:
+        dist = env.create_distribution(8, 1)
+        s = env.create_session()
+        s.set_global_minibatch_size(8)
+        ops = []
+        counts = [64, 192]
+        for c in counts:
+            r = s.create_operation_reg_info(OpType.CC)
+            r.add_input(8, 4)
+            r.add_output(8, 4)
+            r.add_parameter_set(c, 1)
+            ops.append(s.get_operation(s.add_operation(r, dist)))
+        s.commit()
+        pss = [op.get_parameter_set(0) for op in ops]
+        assert all(ps.bucket is not None for ps in pss)
+        st = s.get_stats()
+        st.reset()
+        st.start()
+        for c, ps in zip(reversed(counts), reversed(pss)):
+            ps.start_gradient_comm(dist.make_buffer(
+                lambda p: p + np.arange(c, dtype=np.float64), c))
+        for ps in pss:
+            ps.wait_gradient_comm()
+        st.stop()
+        assert st.get_comm_size(ops[0].op_idx) == 64 * 4
+        assert st.get_comm_size(ops[1].op_idx) == 192 * 4
+        assert st.get_total_comm_size() == (64 + 192) * 4
+    finally:
+        env.config.enable_stats = False
