@@ -403,3 +403,52 @@ def test_stats_attribution_with_bucketing(bucket_env):
         assert st.get_total_comm_size() == (64 + 192) * 4
     finally:
         env.config.enable_stats = False
+
+
+def test_bucket_random_round_patterns(bucket_env):
+    """Property test for the round state machine: random per-round subsets of
+    members start (sometimes twice), in random order, and every started member
+    waits — results must always match the closed-form oracle, no matter which
+    rounds bucket and which fall back."""
+    env = bucket_env
+    dist = env.create_distribution(8, 1)
+    s = env.create_session()
+    s.set_global_minibatch_size(8)
+    ops = []
+    for _ in range(4):
+        r = s.create_operation_reg_info(OpType.CC)
+        r.add_input(8, 4)
+        r.add_output(8, 4)
+        r.add_parameter_set(32, 1)
+        ops.append(s.get_operation(s.add_operation(r, dist)))
+    s.commit()
+    pss = [op.get_parameter_set(0) for op in ops]
+    assert all(ps.bucket is not None for ps in pss)
+
+    def buf(scale):
+        return dist.make_buffer(
+            lambda p: scale * (p + 1.0) + np.arange(32, dtype=np.float64), 32)
+
+    def oracle(scale):
+        return sum(scale * (p + 1.0) + np.arange(32, dtype=np.float32)
+                   for p in range(8))
+
+    rng = np.random.default_rng(42)
+    for round_no in range(12):
+        k = int(rng.integers(1, 5))           # how many members start
+        members = list(rng.choice(4, size=k, replace=False))
+        scales = {}
+        for m in members:
+            sc = float(round_no * 10 + m + 1)
+            scales[m] = sc
+            pss[m].start_gradient_comm(buf(sc))
+            if rng.random() < 0.25:           # occasional restart
+                sc = sc + 0.5
+                scales[m] = sc
+                pss[m].start_gradient_comm(buf(sc))
+        rng.shuffle(members)
+        for m in members:
+            out = pss[m].wait_gradient_comm()
+            np.testing.assert_allclose(
+                np.asarray(out)[0, 0, 0, 0], oracle(scales[m]), rtol=1e-6,
+                err_msg=f"round {round_no} member {m}")

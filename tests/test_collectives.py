@@ -657,3 +657,45 @@ def test_self_group_identity(env):
         dist.all_reduce(buf, N, DataType.FLOAT, ReductionType.SUM, GroupType.MODEL)
     )
     np.testing.assert_allclose(np.asarray(out), np.asarray(buf))
+
+
+@pytest.mark.parametrize("seed", [0, 1, 2])
+def test_alltoallv_per_rank_random_matrices(env, seed):
+    """Property test: random per-world-rank count matrices (including zero
+    counts and non-packed offsets) against the numpy oracle, on the 2-instance
+    MODEL grid."""
+    W, G = 8, 4
+    rng = np.random.default_rng(seed)
+    dist = env.create_distribution(2, G)
+    g = dist._group(GroupType.MODEL)
+    members = group_members(dist, GroupType.MODEL, W)
+    pos = np.array([g.group_idx_of(p) for p in range(W)])
+    S = rng.integers(0, 5, size=(W, G))
+    # non-packed send offsets: packed layout plus random per-segment gaps
+    gaps = rng.integers(0, 3, size=(W, G))
+    soff = np.zeros((W, G), dtype=int)
+    for w in range(W):
+        off = 0
+        for j in range(G):
+            off += gaps[w, j]
+            soff[w, j] = off
+            off += S[w, j]
+    R = np.array([[S[members[w][j], pos[w]] for j in range(G)]
+                  for w in range(W)])
+    roff = np.hstack([np.zeros((W, 1), int), np.cumsum(R, axis=1)[:, :-1]])
+    send_len = int((soff + S).max()) + 1
+    buf = dist.make_buffer(
+        lambda p: p * 1000.0 + np.arange(send_len, dtype=np.float64), send_len
+    )
+    out = env.wait(
+        dist.all_to_allv(buf, S, soff, R, roff, DataType.FLOAT, GroupType.MODEL)
+    )
+    for p in range(W):
+        recv_len = np.asarray(out).shape[-1]
+        expected = np.zeros(recv_len, dtype=np.float32)
+        for jpos, q in enumerate(members[p]):
+            src = np.asarray(q * 1000.0 + np.arange(send_len), dtype=np.float32)
+            seg = src[soff[q, pos[p]]: soff[q, pos[p]] + S[q, pos[p]]]
+            expected[roff[p, jpos]: roff[p, jpos] + len(seg)] = seg
+        np.testing.assert_allclose(dist.local_part(out, p), expected,
+                                   err_msg=f"rank {p} seed {seed}")
