@@ -34,6 +34,15 @@ def main():
     import jax.numpy as jnp
     import numpy as np
 
+    if jax.default_backend() != "tpu":
+        # the compiled (non-interpret) Pallas timings this script exists for
+        # are TPU-only; interpret-mode numbers would be meaningless — skip
+        # gracefully instead of crashing a misconfigured run
+        print(json.dumps({"kernel": "all", "ok": True,
+                          "skipped": "needs a TPU backend "
+                                     f"(got {jax.default_backend()})"}))
+        return
+
     cache = os.path.join(os.path.dirname(os.path.abspath(__file__)), "..", ".jax_cache")
     try:
         jax.config.update("jax_compilation_cache_dir", cache)
