@@ -84,8 +84,14 @@ def device_sync(tree):
     import numpy as np
     import jax
 
-    leaf = jax.tree.leaves(tree)[0]
-    return float(np.asarray(jax.numpy.ravel(leaf)[0]))
+    leaves = jax.tree.leaves(tree)
+    if not leaves:
+        # degenerate result (e.g. wait on a 1-member group returns None): no
+        # output to read back, so this is only a host round trip — it does NOT
+        # order against in-flight device work; callers timing real work must
+        # sync on a tree that depends on it
+        leaves = [jax.numpy.zeros((1,))]
+    return float(np.asarray(jax.numpy.ravel(leaves[0])[0]))
 
 
 MEASURED_PATH = os.path.join(REPO_ROOT, "BENCH_MEASURED.json")
