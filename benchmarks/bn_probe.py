@@ -4,9 +4,12 @@ Compares the real model against (a) single-pass E[x^2]-E[x]^2 variance and
 (b) a no-stats affine-only variant (identity stats — NOT valid training, just
 an upper bound on what BN tuning could ever recover).
 
-Measured (v5e, batch 32): two-pass ~16.5 ms, one-pass ~17.1 ms, no-stats
-~14.2 ms — BN statistics cost <=2 ms and the one-pass rewrite does not pay,
-so the model keeps the numerically safer two-pass form.
+Measured (v5e, batch 32, round 2): two-pass ~16.5 ms, one-pass ~17.1 ms —
+at small batch the rewrite did not pay. Re-measured at batch 128 (round 5,
+BN_PROBE_BATCH=128): two-pass 58.8 ms, one-pass 49.2-54.2 ms, no-stats
+40.8 ms — at the MXU-saturating batch the two-pass form's second activation
+read dominates, so the model now uses the one-pass form with a clamped
+variance (see mlsl_tpu/models/resnet.py _bn).
 """
 
 import os
@@ -69,12 +72,25 @@ def timed_step(bn_impl, params, batch, tag):
         resnet._bn = orig
 
 
+def bn_twopass(x, p, eps=1e-5):
+    # the pre-round-5 model form (resnet._bn is one-pass now): centered
+    # variance, second full read of the activation
+    mean = jnp.mean(x, axis=(0, 1, 2), dtype=jnp.float32)
+    var = jnp.mean(lax.square(x.astype(jnp.float32) - mean), axis=(0, 1, 2))
+    a = lax.rsqrt(var + eps) * p["scale"]
+    b = p["bias"] - mean * a
+    return (x * a + b).astype(x.dtype)
+
+
 def main():
+    BATCH = int(os.environ.get("BN_PROBE_BATCH", "32"))
+    print("batch:", BATCH)
     params = jax.device_put(resnet.init_resnet50(jax.random.PRNGKey(0), 1000))
     rng = np.random.default_rng(0)
-    x = jax.device_put(jnp.asarray(rng.normal(size=(32, 224, 224, 3)), jnp.float32))
-    y = jax.device_put(jnp.asarray(rng.integers(0, 1000, size=(32,)), jnp.int32))
-    timed_step(resnet._bn, params, (x, y), "two-pass")
+    x = jax.device_put(jnp.asarray(rng.normal(size=(BATCH, 224, 224, 3)), jnp.float32))
+    y = jax.device_put(jnp.asarray(rng.integers(0, 1000, size=(BATCH,)), jnp.int32))
+    timed_step(bn_twopass, params, (x, y), "two-pass")
+    timed_step(resnet._bn, params, (x, y), "model(1p)")
     timed_step(bn_onepass, params, (x, y), "one-pass")
     timed_step(bn_nostats, params, (x, y), "no-stats")
 
