@@ -248,7 +248,7 @@ def main():
     # Achieved TFLOP/s and MFU for the framework step. FLOPs come from XLA's own
     # cost model on the compiled baseline step (identical math to the framework
     # step); peak from the device kind.
-    tflops = mfu = None
+    tflops = mfu = tflops_best = mfu_best = None
     device_kind = jax.devices()[0].device_kind
     try:
         compiled = raw_step.lower(raw_params, xb, yb).compile()
@@ -258,9 +258,13 @@ def main():
         flops = float(ca.get("flops", 0.0))
         if flops > 0:
             tflops = flops / (fw_ms / 1e3) / 1e12
+            # best-of-blocks is the capability estimate on the shared tunnel
+            # (load spikes inflate the median 4-5x for minutes; TUNING.md §0)
+            tflops_best = flops / (fw_best / 1e3) / 1e12
             peak = _peak_tflops(device_kind)
             if peak:
                 mfu = tflops / peak
+                mfu_best = tflops_best / peak
     except Exception as e:  # cost_analysis unsupported on some backends
         print(f"bench: cost_analysis unavailable ({e})", file=sys.stderr)
 
@@ -287,6 +291,8 @@ def main():
         "images_per_s": round(batch / (pipe_ms / 1e3)) if pipe_ms else None,
         "tflops": round(tflops, 3) if tflops else None,
         "mfu": round(mfu, 4) if mfu else None,
+        "tflops_best": round(tflops_best, 3) if tflops_best else None,
+        "mfu_best": round(mfu_best, 4) if mfu_best else None,
         "transformer_tok_s": round(tfm_tok_s) if tfm_tok_s else None,
         "transformer_step_ms": round(tfm_ms, 3) if tfm_ms else None,
         "device": device_kind,

@@ -323,3 +323,37 @@ def test_bn_fused_matches_two_pass_oracle():
         np.testing.assert_allclose(
             np.asarray(got, np.float32), want, atol=tol, rtol=tol
         )
+
+
+def test_s2d_stem_matches_direct_conv():
+    """MLSL_RESNET_S2D stem rewrite == the direct 7x7-stride-2 'SAME' conv
+    (trace-time reparametrization; params stay (7,7,3,64)). Checked in f32
+    on uneven spatial content and through the full apply in bf16."""
+    import os
+
+    from mlsl_tpu.models import resnet
+
+    rng = np.random.default_rng(3)
+    x = jnp.asarray(rng.normal(size=(2, 32, 32, 3)).astype(np.float32))
+    w = jnp.asarray((rng.normal(size=(7, 7, 3, 8)) * 0.2).astype(np.float32))
+    direct = resnet._conv(x, w, stride=2)
+    os.environ["MLSL_RESNET_S2D"] = "1"
+    try:
+        s2d = resnet._stem_conv(x, w)
+    finally:
+        os.environ.pop("MLSL_RESNET_S2D")
+    assert s2d.shape == direct.shape
+    np.testing.assert_allclose(
+        np.asarray(s2d), np.asarray(direct), atol=1e-4, rtol=1e-4
+    )
+
+    # full apply: logits must agree between stems within bf16 tolerance
+    params = resnet.init_resnet50(jax.random.PRNGKey(0), num_classes=10)
+    xb = jnp.asarray(rng.normal(size=(2, 64, 64, 3)).astype(np.float32))
+    base = np.asarray(resnet.apply_resnet50(params, xb), np.float32)
+    os.environ["MLSL_RESNET_S2D"] = "1"
+    try:
+        alt = np.asarray(resnet.apply_resnet50(params, xb), np.float32)
+    finally:
+        os.environ.pop("MLSL_RESNET_S2D")
+    np.testing.assert_allclose(alt, base, atol=5e-2, rtol=5e-2)
