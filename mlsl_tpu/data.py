@@ -105,13 +105,18 @@ def file_source(paths, epochs: Optional[int] = 1):
         e += 1
 
 
-def synthetic_source(batch: int, shape, num_classes: int, seed: int = 0, steps: Optional[int] = None):
+def synthetic_source(batch: int, shape, num_classes: int, seed: int = 0,
+                     steps: Optional[int] = None, dtype=np.float32):
     """Deterministic synthetic (x, y) batches (the reference tests likewise use
-    generated algebraic data rather than real datasets)."""
+    generated algebraic data rather than real datasets). Pass
+    dtype=ml_dtypes.bfloat16 to cast on the host: models that immediately
+    cast inputs to bf16 on device see identical math, and the host->device
+    transfer halves — on the tunneled bench that transfer is the pipeline
+    bottleneck (~26 MB/s effective; BENCH_MEASURED round-5 pipeline rows)."""
     rng = np.random.default_rng(seed)
     produced = 0
     while steps is None or produced < steps:
-        x = rng.normal(size=(batch, *shape)).astype(np.float32)
+        x = rng.normal(size=(batch, *shape)).astype(dtype)
         y = rng.integers(0, num_classes, size=(batch,)).astype(np.int32)
         produced += 1
         yield x, y
