@@ -114,6 +114,8 @@ def main():
              [sys.executable, "benchmarks/bucketing_bench.py"], 1200),
             ("grid_collectives",
              [sys.executable, "benchmarks/grid_collectives.py"], 1200),
+            ("transformer",
+             [sys.executable, "benchmarks/transformer_bench.py"], 2400),
         ]
 
     record = {

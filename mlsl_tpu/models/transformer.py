@@ -443,6 +443,19 @@ class HybridTrainer:
 
     # -- compiled programs -------------------------------------------------
 
+    def compiled_step(self, tokens, labels):
+        """Lower+compile the fused train step for (tokens, labels) and return
+        the jax Compiled object (cost_analysis, memory_analysis, as_text) —
+        the profiling surface for benchmarks. None on the per-layer graph
+        path, where the step is many programs, not one."""
+        if self._fused_fn is None:
+            return None
+        if self.optimizer is None:
+            return self._fused_fn.lower(self.params, tokens, labels).compile()
+        return self._fused_fn.lower(
+            self.params, self._opt_state, tokens, labels
+        ).compile()
+
     def _token_spec(self):
         return P((DATA_AXIS,), (SEQ_AXIS,))
 
