@@ -100,6 +100,14 @@ def main():
             ("gpt-medium-2k", tfm.TransformerConfig(
                 vocab=32768, d_model=1024, n_heads=16, head_dim=64,
                 n_blocks=12, seq_len=2048), 8),
+            ("gpt-medium-2k-remat", tfm.TransformerConfig(
+                vocab=32768, d_model=1024, n_heads=16, head_dim=64,
+                n_blocks=12, seq_len=2048, remat=True), 8),
+            # long-context single-chip row: at seq 8k the plain step's saved
+            # activations overflow a 16 GiB v5e — remat makes it fit
+            ("gpt-medium-8k-remat", tfm.TransformerConfig(
+                vocab=32768, d_model=1024, n_heads=16, head_dim=64,
+                n_blocks=12, seq_len=8192, remat=True), 2),
             ("d512-8blk-512", tfm.TransformerConfig(
                 vocab=32768, d_model=512, n_heads=8, head_dim=64,
                 n_blocks=8, seq_len=512), 32),

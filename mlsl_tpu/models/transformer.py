@@ -60,6 +60,10 @@ class TransformerConfig:
     # follow, so training is mathematically identical to 'ring' at ~2x fewer
     # attention block-FLOPs on the ring hops.
     dtype: str = "bfloat16"  # MXU compute dtype; 'float32' for exactness tests
+    remat: bool = False      # jax.checkpoint each block: save only the block
+    # input, recompute internals (incl. ring-attention hops' collectives) in
+    # the backward — O(n_blocks) residual streams instead of O(n_blocks *
+    # per-block intermediates) of saved activations; the long-context trade
     n_experts: int = 0       # >0: MoE FFN with expert parallelism over 'model'
     moe_top_k: int = 1       # 1 = switch routing; 2 = GShard-style top-2
     moe_aux_weight: float = 0.01
@@ -199,11 +203,7 @@ def forward_local(params, tokens, cfg: TransformerConfig, sp: int, tp: int):
             return ring_attention(q, k, v, ax, n, causal=True)
     else:
         attn_fn = ring_attention if cfg.attention == "ring" else ulysses_attention
-    for i in range(cfg.n_blocks):
-        lnp = params[f"blk{i}.ln"]
-        ap = params[f"blk{i}.attn"]
-        mp = params[f"blk{i}.mlp"]
-
+    def block_body(h, lnp, ap, mp):
         a = _ln(h.astype(jnp.float32), lnp["ln1_scale"], lnp["ln1_bias"]).astype(cdt)
         qkv = jnp.einsum("bsd,dchx->bcshx", a, ap["wqkv"].astype(cdt))
         q, k, v = (
@@ -224,9 +224,9 @@ def forward_local(params, tokens, cfg: TransformerConfig, sp: int, tp: int):
                 mp, MODEL_AXIS, tp, cfg.capacity_factor, cfg.moe_top_k,
                 compute_dtype=cdt,
             )
-            aux_total = aux_total + aux
             h = (h.astype(jnp.float32) + o2d.reshape(bl, sl_, dm)).astype(cdt)
         else:
+            aux = jnp.float32(0.0)
             f = jax.nn.gelu(
                 jnp.einsum("bsd,df->bsf", a, mp["w1"].astype(cdt))
                 + mp["b1"].astype(cdt)
@@ -234,6 +234,18 @@ def forward_local(params, tokens, cfg: TransformerConfig, sp: int, tp: int):
             o = mxu_einsum("bsf,fd->bsd", f, mp["w2"].astype(cdt))
             o = lax.psum(o, MODEL_AXIS) if tp > 1 else o
             h = (h.astype(jnp.float32) + o + mp["b2"]).astype(cdt)
+        return h, aux
+
+    # cfg.remat: save only each block's input residual stream; the backward
+    # replays the block (incl. the ring hops' collectives) instead of keeping
+    # qkv/attn/gelu intermediates alive — the O(sqrt)-style memory trade that
+    # makes long sequences fit (docs/DESIGN.md long-context section)
+    blk = jax.checkpoint(block_body) if cfg.remat else block_body
+    for i in range(cfg.n_blocks):
+        h, aux = blk(
+            h, params[f"blk{i}.ln"], params[f"blk{i}.attn"], params[f"blk{i}.mlp"]
+        )
+        aux_total = aux_total + aux
 
     fin = params["final"]
     h = _ln(h.astype(jnp.float32), fin["ln_scale"], fin["ln_bias"])

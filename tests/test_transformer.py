@@ -238,3 +238,64 @@ def test_hybrid_zigzag_matches_oracle(env, dp, sp, tp):
     assert np.isfinite(losses).all()
     # loss at the post-2-update parameters must equal the oracle's
     np.testing.assert_allclose(float(trainer.step(st, sl_)), ref_loss, rtol=1e-3)
+
+
+@pytest.mark.parametrize("dp,sp,tp", [(2, 2, 2), (8, 1, 1)])
+def test_remat_matches_no_remat(env, dp, sp, tp):
+    """cfg.remat wraps each block in jax.checkpoint — the backward replays the
+    block (incl. ring-hop collectives) instead of saving intermediates. The
+    replayed ops are the same deterministic programs, so the trajectory must
+    match the non-remat run to f32 tolerance across the hybrid grid."""
+    cfg_r = dataclasses.replace(CFG, remat=True)
+    b = 2 * dp
+    toks, labels = _data(b)
+    results = []
+    for cfg in (CFG, cfg_r):
+        trainer = tfm.HybridTrainer(env, cfg, dp, sp, tp, batch=b, lr=0.5,
+                                    devices=env.devices[: dp * sp * tp])
+        st, sl_ = trainer.shard_tokens(toks, labels)
+        losses = [float(trainer.step(st, sl_)) for _ in range(2)]
+        results.append((losses, jax.device_get(trainer.params)))
+    (l0, p0), (l1, p1) = results
+    np.testing.assert_allclose(l0, l1, atol=1e-6, rtol=1e-6)
+    for a, b_ in zip(jax.tree.leaves(p0), jax.tree.leaves(p1)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b_),
+                                   atol=1e-6, rtol=1e-6)
+
+
+def test_remat_replays_forward(env):
+    """cfg.remat must actually re-run the block forwards in the backward:
+    the compiled fused step's cost-model FLOPs grow by roughly the one extra
+    forward (+1/4 to +1/3 of the plain 3x-forward step). The MEMORY win is a
+    TPU-backend liveness property — XLA:CPU's temp accounting does not
+    reflect it (measured: remat temp slightly LARGER on CPU at d128 x 8blk x
+    s512), so on-chip evidence comes from transformer_bench, not this test."""
+    cfg = dataclasses.replace(
+        CFG, n_blocks=8, seq_len=512, d_model=128, n_heads=4, head_dim=32
+    )
+    cfg_r = dataclasses.replace(cfg, remat=True)
+    b = 4
+    toks, labels = _data_cfg(b, cfg)
+    flops = {}
+    for key, c in (("plain", cfg), ("remat", cfg_r)):
+        trainer = tfm.HybridTrainer(env, c, 1, 1, 1, batch=b, lr=0.5,
+                                    devices=env.devices[:1])
+        st, sl_ = trainer.shard_tokens(toks, labels)
+        compiled = trainer.compiled_step(st, sl_)
+        assert compiled is not None
+        try:
+            ca = compiled.cost_analysis()
+            ca = ca[0] if isinstance(ca, (list, tuple)) else ca
+            flops[key] = float(ca.get("flops", 0.0))
+        except Exception as e:  # pragma: no cover - backend-dependent surface
+            pytest.skip(f"cost_analysis unavailable: {e}")
+    assert flops["plain"] > 0
+    ratio = flops["remat"] / flops["plain"]
+    assert 1.15 < ratio < 1.45, flops
+
+
+def _data_cfg(b, cfg, seed=0):
+    rng = np.random.default_rng(seed)
+    toks = rng.integers(0, cfg.vocab, size=(b, cfg.seq_len)).astype(np.int32)
+    labels = rng.integers(0, cfg.vocab, size=(b, cfg.seq_len)).astype(np.int32)
+    return toks, labels
