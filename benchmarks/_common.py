@@ -138,54 +138,74 @@ def append_measurement(record: dict) -> None:
     os.replace(tmp, path)
 
 
-_RTT_CACHE = {}
-
-
-def sync_rtt(samples: int = 6) -> float:
-    """Calibrated d2h readback round-trip (seconds, min of samples): through
-    the axon tunnel a single device_sync costs ~65 ms regardless of payload,
-    which would otherwise ride inside every timed block (the bias is
-    RTT/per_block per call). Cached per process."""
+def timed_scan(step, carry0, iters=100, blocks=3):
+    """Per-iteration ms for a carry→carry `step`, executed as a lax.scan
+    inside ONE device computation, using a PAIRED-length estimate: best time
+    at 2*iters minus best time at iters, divided by iters. For sub-ms kernels
+    the per-dispatch `timed` path is unusable through the tunnel: subtracting
+    a CALIBRATED ~65 ms RTT from a few-ms signal lets multi-ms RTT drift
+    swing the result 0.7x-13x run-to-run (observed on flash_fwd_causal), and
+    a cached calibration can even exceed a later block's total time. The
+    paired difference cancels the RTT and dispatch cost exactly — no
+    calibration to drift. Blocks alternate short/long so slow drift hits
+    both arms equally. The carry dependency serializes iterations and
+    defeats CSE; callers must make `step` keep its values bounded."""
     import time
 
-    if "rtt" not in _RTT_CACHE:
-        import jax
-        import jax.numpy as jnp
+    import jax
+    from jax import lax
 
-        z = jax.device_put(jnp.zeros((8, 128)))
-        device_sync(z)
-        best = float("inf")
-        for _ in range(samples):
-            t0 = time.perf_counter()
-            device_sync(z)
-            best = min(best, time.perf_counter() - t0)
-        _RTT_CACHE["rtt"] = best
-    return _RTT_CACHE["rtt"]
+    def make(n):
+        return jax.jit(
+            lambda c: lax.scan(lambda c, _: (step(c), None), c, None, length=n)[0]
+        )
+
+    run1, run2 = make(iters), make(2 * iters)
+    device_sync(run1(carry0))  # compile + warm
+    device_sync(run2(carry0))
+    best1 = best2 = float("inf")
+    for _ in range(blocks):
+        t0 = time.perf_counter()
+        device_sync(run1(carry0))
+        best1 = min(best1, time.perf_counter() - t0)
+        t0 = time.perf_counter()
+        device_sync(run2(carry0))
+        best2 = min(best2, time.perf_counter() - t0)
+    return max((best2 - best1) / iters * 1e3, 1e-3)
 
 
 def timed(fn, *args, iters=30, warmup=5, blocks=3):
-    """Best-of-blocks per-call ms with a TRUE device sync: through the axon
-    tunnel block_until_ready can return before the device finishes (memory:
-    axon-tunnel-timing), so every block ends with a d2h readback of one element
-    of the final result — and the readback's own ~65 ms round trip is
-    calibrated out (sync_rtt), otherwise it adds RTT/per_block to every call.
-    The minimum across blocks is the capability estimate — shared-tunnel load
-    spikes inflate the mean by 2x+ on a seconds timescale."""
+    """Best-of-blocks per-call ms with a TRUE device sync (through the axon
+    tunnel block_until_ready can return before the device finishes — memory:
+    axon-tunnel-timing), using a PAIRED-block estimate: each round times a
+    block of K calls and a block of 2K calls; the reported value is
+    (best_2K - best_K) / K, which cancels the sync's ~65 ms tunnel RTT and
+    the dispatch cost exactly instead of subtracting a cached calibration
+    that the tunnel's multi-ms RTT drift can invalidate (a drifted
+    calibration produced negative signals on sub-ms kernels). Minima across
+    rounds are taken per arm — shared-tunnel load spikes inflate the mean by
+    2x+ on a seconds timescale, and a spike hits one arm of one round, not
+    the independent minima."""
     import time
 
     r = fn(*args)  # also covers warmup=0: r must exist for the first sync
     for _ in range(max(0, warmup - 1)):
         r = fn(*args)
     device_sync(r)
-    rtt = sync_rtt()
-    per_block = max(1, iters // blocks)
-    best = float("inf")
+    # each round runs K + 2K calls; keep the TOTAL near the caller's iters
+    # budget so existing call sites don't silently triple their wall time
+    per_block = max(1, iters // (3 * blocks))
+    best1 = best2 = float("inf")
     for _ in range(blocks):
         t0 = time.perf_counter()
         for _ in range(per_block):
             r = fn(*args)
         device_sync(r)
-        best = min(best, (time.perf_counter() - t0 - rtt) / per_block * 1e3)
-    # floor at 1 µs: a sub-RTT workload can land at/below 0 after calibration,
-    # and callers derive rates by dividing by this
-    return max(best, 1e-3)
+        best1 = min(best1, time.perf_counter() - t0)
+        t0 = time.perf_counter()
+        for _ in range(2 * per_block):
+            r = fn(*args)
+        device_sync(r)
+        best2 = min(best2, time.perf_counter() - t0)
+    # floor at 1 µs: callers derive rates by dividing by this
+    return max((best2 - best1) / per_block * 1e3, 1e-3)

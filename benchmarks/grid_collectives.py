@@ -38,7 +38,7 @@ def main():
         lambda p: p * 1.0 + np.arange(count, dtype=np.float64) % 977, count
     )
 
-    from benchmarks._common import timed  # rtt-calibrated, 4-byte d2h sync
+    from benchmarks._common import timed  # paired-block estimate, 4-byte d2h sync
     from mlsl_tpu.comm.request import CommDesc, CommRequest
 
     def run(kind, gt):

@@ -24,6 +24,8 @@ from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
 ROW_TILE = 32  # int8 min sublane count
+PACK_ROWS = 1024  # rows per grid step on the packed-scale path: the scale
+# tile is (rows/128, 128) and Mosaic needs >= 8 sublanes there
 
 
 def _on_tpu() -> bool:
@@ -66,6 +68,27 @@ def _dequant_kernel(q_ref, s_ref, x_ref):
     x_ref[:] = q_ref[:].astype(jnp.float32) * s_ref[:]
 
 
+def _quant_kernel_packed(x_ref, q_ref, s_ref):
+    # Blocks are (g, 128, block): rows ride the (leading, sublane) dims and
+    # the quant block rides the lanes, so the per-row amax is a lane
+    # reduction landing directly in the packed (g, 128) scale shape. A
+    # (rows, 1) scale output would be lane-padded 128x in HBM, which turned
+    # "n floats" of scale traffic into 128 MiB on a 256 MiB buffer and
+    # capped both kernels near half roofline (measured on v5e; an in-kernel
+    # (r,1)->(r/128,128) reshape is an unsupported Mosaic shape cast).
+    x = x_ref[:]
+    amax = jnp.max(jnp.abs(x), axis=2)                         # (g, 128)
+    scale = jnp.where(amax == 0.0, 1.0, amax / 127.0)
+    q_ref[:] = jnp.clip(
+        jnp.round(x / scale[:, :, None]), -127, 127
+    ).astype(jnp.int8)
+    s_ref[:] = scale
+
+
+def _dequant_kernel_packed(q_ref, s_ref, x_ref):
+    x_ref[:] = q_ref[:].astype(jnp.float32) * s_ref[:][:, :, None]
+
+
 def _step_rows(n: int) -> int:
     """Rows per grid step: big steps amortize grid overhead; tiles stay int8-legal
     (multiples of ROW_TILE = 32 sublanes)."""
@@ -78,10 +101,27 @@ def _step_rows(n: int) -> int:
 @functools.partial(jax.jit, static_argnames=("interpret",))
 def _quantize_pallas(x2d, interpret=False):
     n, block = x2d.shape
+    if n % PACK_ROWS == 0:
+        g = PACK_ROWS // 128
+        x3 = x2d.reshape(n // 128, 128, block)
+        q, s = pl.pallas_call(
+            _quant_kernel_packed,
+            grid=(n // PACK_ROWS,),
+            in_specs=[pl.BlockSpec((g, 128, block), lambda i: (i, 0, 0))],
+            out_specs=[
+                pl.BlockSpec((g, 128, block), lambda i: (i, 0, 0)),
+                pl.BlockSpec((g, 128), lambda i: (i, 0)),
+            ],
+            out_shape=[
+                jax.ShapeDtypeStruct((n // 128, 128, block), jnp.int8),
+                jax.ShapeDtypeStruct((n // 128, 128), jnp.float32),
+            ],
+            interpret=interpret,
+        )(x3)
+        return q.reshape(n, block), s.reshape(-1)
+    # ragged row counts: (n, 1) scales (lane-padded HBM layout — slower, but
+    # any row multiple of ROW_TILE is legal)
     r = _step_rows(n)
-    # Scales ride as (n, 1): lane-padded inside VMEM but only n floats of HBM
-    # traffic (the old (n, 128) broadcast moved 128x the bytes and capped the
-    # roundtrip below the XLA reference's throughput).
     q, s = pl.pallas_call(
         _quant_kernel,
         grid=(n // r,),
@@ -102,6 +142,20 @@ def _quantize_pallas(x2d, interpret=False):
 @functools.partial(jax.jit, static_argnames=("interpret",))
 def _dequantize_pallas(q2d, scales, interpret=False):
     n, block = q2d.shape
+    if n % PACK_ROWS == 0:
+        g = PACK_ROWS // 128
+        out = pl.pallas_call(
+            _dequant_kernel_packed,
+            grid=(n // PACK_ROWS,),
+            in_specs=[
+                pl.BlockSpec((g, 128, block), lambda i: (i, 0, 0)),
+                pl.BlockSpec((g, 128), lambda i: (i, 0)),
+            ],
+            out_specs=pl.BlockSpec((g, 128, block), lambda i: (i, 0, 0)),
+            out_shape=jax.ShapeDtypeStruct((n // 128, 128, block), jnp.float32),
+            interpret=interpret,
+        )(q2d.reshape(n // 128, 128, block), scales.reshape(n // 128, 128))
+        return out.reshape(n, block)
     r = _step_rows(n)
     return pl.pallas_call(
         _dequant_kernel,
@@ -120,13 +174,24 @@ def _dequantize_pallas(q2d, scales, interpret=False):
 
 
 def quantize(x: jax.Array, block: int = 256, use_pallas: bool | None = None):
-    """1-D f32 -> (q int8 (padded n,), scales f32, orig_len). Pads to block*ROW_TILE."""
+    """1-D f32 -> (q int8 (padded n,), scales f32, orig_len).
+
+    Pads to block*ROW_TILE rows, except large pallas-path buffers
+    (>= 8*block*PACK_ROWS elements), which pad to block*PACK_ROWS rows so the
+    packed-scale kernels engage — scales then pack densely as (rows/128, 128)
+    instead of the lane-padded-128x (rows, 1) HBM layout that capped both
+    kernels near half roofline (see the kernels). The coarser padding wastes
+    <= 12.5% at the threshold, asymptotically ~0; callers must treat the
+    returned q length as opaque and slice with orig_len.
+    """
     n = x.shape[0]
-    n_pad = -(-n // (block * ROW_TILE)) * (block * ROW_TILE)
-    xp = jnp.pad(x.astype(jnp.float32), (0, n_pad - n))
-    x2d = xp.reshape(-1, block)
     if use_pallas is None:
         use_pallas = _on_tpu() and block % 128 == 0
+    big = n >= 8 * block * PACK_ROWS
+    row_mult = PACK_ROWS if (use_pallas and big) else ROW_TILE
+    n_pad = -(-n // (block * row_mult)) * (block * row_mult)
+    xp = jnp.pad(x.astype(jnp.float32), (0, n_pad - n))
+    x2d = xp.reshape(-1, block)
     if use_pallas:
         q, s = _quantize_pallas(x2d)
     else:

@@ -39,6 +39,25 @@ def test_pallas_matches_reference_interpret():
     np.testing.assert_allclose(np.asarray(d_pl), np.asarray(d_ref), rtol=1e-6)
 
 
+@pytest.mark.parametrize("rows", [1024, 2048])
+def test_pallas_packed_scales_match_reference_interpret(rows):
+    # rows % PACK_ROWS == 0 takes the 3-D packed-scale kernels (dense (g,128)
+    # scale layout in HBM — the (rows,1) form is lane-padded 128x); pin both
+    # the single-step and multi-step grids against the reference
+    from mlsl_tpu.ops import quant_kernels as qk
+
+    assert rows % qk.PACK_ROWS == 0
+    rng = np.random.default_rng(2)
+    x = jnp.asarray(rng.normal(size=(rows, 256)).astype(np.float32))
+    q_ref, s_ref = qk.quantize_blocks_ref(x)
+    q_pl, s_pl = qk._quantize_pallas(x, interpret=True)
+    np.testing.assert_array_equal(np.asarray(q_pl), np.asarray(q_ref))
+    np.testing.assert_allclose(np.asarray(s_pl), np.asarray(s_ref), rtol=1e-6)
+    d_ref = qk.dequantize_blocks_ref(q_ref, s_ref)
+    d_pl = qk._dequantize_pallas(q_pl, s_pl, interpret=True)
+    np.testing.assert_allclose(np.asarray(d_pl), np.asarray(d_ref), rtol=1e-6)
+
+
 @pytest.mark.parametrize("grid,gt", [((8, 1), GroupType.DATA), ((2, 4), GroupType.MODEL)])
 def test_quantized_allreduce_close_to_exact(env, grid, gt):
     n = 4096
