@@ -26,7 +26,7 @@ def main():
     ap.add_argument("--iters", type=int, default=54)
     ap.add_argument("--warmup", type=int, default=6)
     ap.add_argument("--batch", type=int, default=None,
-                    help="global minibatch (default 128 full / 8 quick); on "
+                    help="global minibatch (default 256 full / 8 quick); on "
                          "device OOM the bench re-launches itself at half")
     args = ap.parse_args()
 
@@ -81,9 +81,10 @@ def main():
     if args.quick:
         batch, hw, classes = args.batch or 8, 64, 10
     else:
-        # batch >= 128: the MXU wants large batched matmuls; 32 left the chip
-        # latency-bound (MFU 0.13). OOM falls back by re-exec (see below).
-        batch, hw, classes = args.batch or 128, 224, 1000
+        # Large batch: the MXU wants large batched matmuls; 32 left the chip
+        # latency-bound (MFU 0.13), 128 -> 256 bought another ~6% median MFU
+        # on v5e. OOM falls back by re-exec (see below).
+        batch, hw, classes = args.batch or 256, 224, 1000
 
     n_dev = len(jax.devices())
     env = mlsl.Environment.get_env().init()

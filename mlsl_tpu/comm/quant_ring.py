@@ -48,6 +48,20 @@ def _dequant(q, s, use_pallas):
     return qk.dequantize_blocks_ref(q, s)
 
 
+def _chunk_unit(rc: int, use_pallas: bool, block: int) -> int:
+    """Ring-chunk alignment unit (elements). On the pallas path chunks align
+    to tile-legal rows (ROW_TILE); large per-rank slices align to PACK_ROWS
+    rows instead so every per-hop quant/dequant takes the packed-scale
+    kernels (dense (g, 128) scales — see quant_kernels; ~1.6x at streaming
+    sizes). The coarse unit engages only where its padding waste is bounded
+    by 12.5% (same 8*block*PACK_ROWS threshold as quantize())."""
+    if not use_pallas:
+        return block
+    if rc >= 8 * block * qk.PACK_ROWS:
+        return block * qk.PACK_ROWS
+    return block * qk.ROW_TILE
+
+
 def _to_chunks(x, G, rc, chunk):
     """(n_orig,) -> (G, chunk): slice j of the logical partition (length rc) sits at
     the START of padded chunk j, so ring chunk ownership == MPI slice placement."""
@@ -135,7 +149,7 @@ def build_quantized_collective(
         rc = count // g
     else:
         rc = -(-count // g)
-    unit = block * (qk.ROW_TILE if use_pallas else 1)
+    unit = _chunk_unit(rc, use_pallas, block)
     chunk = -(-rc // unit) * unit
     err_len = g * chunk
 

@@ -111,9 +111,9 @@ def _stem_conv(x, w):
     trace-time reparametrization, so checkpoints and grad sync see the
     same tree either way.
     """
-    if not _use_s2d_stem():
-        return _conv(x, w, stride=2)
     n, h, wd, c = x.shape
+    if not _use_s2d_stem() or h % 2 or wd % 2:
+        return _conv(x, w, stride=2)
     x2 = x.reshape(n, h // 2, 2, wd // 2, 2, c)
     x2 = x2.transpose(0, 1, 3, 2, 4, 5).reshape(n, h // 2, wd // 2, 4 * c)
     wp = jnp.pad(w, ((0, 1), (0, 1), (0, 0), (0, 0)))
@@ -130,9 +130,20 @@ def _stem_conv(x, w):
 
 
 def _use_s2d_stem() -> bool:
+    """MLSL_RESNET_S2D: '1' forces the space-to-depth stem, '0' forces the
+    direct conv; unset defaults to on for TPU backends (measured on v5e at
+    batch 256: median MFU 0.2835 -> 0.287; identical math, pinned by
+    test_s2d_stem_matches_direct_conv)."""
     import os
 
-    return os.environ.get("MLSL_RESNET_S2D", "0") == "1"
+    v = os.environ.get("MLSL_RESNET_S2D", "").strip().lower()
+    if v in ("0", "false", "off"):
+        return False
+    if v in ("1", "true", "on"):
+        return True
+    from mlsl_tpu.ops.quant_kernels import _on_tpu
+
+    return _on_tpu()
 
 
 def _bottleneck(x, block, stride):

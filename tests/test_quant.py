@@ -58,6 +58,25 @@ def test_pallas_packed_scales_match_reference_interpret(rows):
     np.testing.assert_allclose(np.asarray(d_pl), np.asarray(d_ref), rtol=1e-6)
 
 
+def test_ring_chunk_unit_geometry():
+    # large per-rank slices align chunks to PACK_ROWS rows so per-hop quant
+    # takes the packed-scale kernels; small ones keep the fine ROW_TILE unit
+    from mlsl_tpu.comm.quant_ring import _chunk_unit
+    from mlsl_tpu.ops import quant_kernels as qk
+
+    block = 256
+    assert _chunk_unit(10**9, use_pallas=False, block=block) == block
+    small = _chunk_unit(block * qk.ROW_TILE, True, block)
+    assert small == block * qk.ROW_TILE
+    big_rc = 8 * block * qk.PACK_ROWS
+    big = _chunk_unit(big_rc, True, block)
+    assert big == block * qk.PACK_ROWS
+    chunk = -(-big_rc // big) * big
+    assert (chunk // block) % qk.PACK_ROWS == 0  # rows hit the packed path
+    # waste bound at the threshold: one unit of padding on >= 8 units of data
+    assert big / big_rc <= 0.125
+
+
 @pytest.mark.parametrize("grid,gt", [((8, 1), GroupType.DATA), ((2, 4), GroupType.MODEL)])
 def test_quantized_allreduce_close_to_exact(env, grid, gt):
     n = 4096
