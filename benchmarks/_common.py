@@ -105,11 +105,22 @@ def measured_path() -> str:
 
 
 def git_sha() -> str:
+    """Short HEAD sha, suffixed '-dirty' when the tree has uncommitted
+    changes — a record claiming a clean sha while measuring workspace code
+    misattributes evidence (it happened; see the r5 dots-config record)."""
     try:
-        return subprocess.run(
+        sha = subprocess.run(
             ["git", "rev-parse", "--short", "HEAD"], cwd=REPO_ROOT,
             capture_output=True, text=True,
         ).stdout.strip() or "unknown"
+        porcelain = subprocess.run(
+            ["git", "status", "--porcelain"], cwd=REPO_ROOT,
+            capture_output=True, text=True,
+        ).stdout.strip()
+        # the evidence file itself is always mid-append during a capture
+        entries = [ln for ln in porcelain.splitlines()
+                   if not ln.endswith("BENCH_MEASURED.json")]
+        return sha + ("-dirty" if entries else "")
     except Exception:
         return "unknown"
 

@@ -64,6 +64,11 @@ class TransformerConfig:
     # input, recompute internals (incl. ring-attention hops' collectives) in
     # the backward — O(n_blocks) residual streams instead of O(n_blocks *
     # per-block intermediates) of saved activations; the long-context trade
+    remat_policy: str = "full"  # 'full' | 'dots' (with remat=True): 'dots'
+    # applies jax.checkpoint_policies.checkpoint_dots — matmul/attention
+    # outputs are saved and only elementwise/softmax work replays in the
+    # backward, trading O(blocks * S * d) extra saved bytes for nearly all
+    # of full remat's recomputed MXU FLOPs
     n_experts: int = 0       # >0: MoE FFN with expert parallelism over 'model'
     moe_top_k: int = 1       # 1 = switch routing; 2 = GShard-style top-2
     moe_aux_weight: float = 0.01
@@ -240,7 +245,17 @@ def forward_local(params, tokens, cfg: TransformerConfig, sp: int, tp: int):
     # replays the block (incl. the ring hops' collectives) instead of keeping
     # qkv/attn/gelu intermediates alive — the O(sqrt)-style memory trade that
     # makes long sequences fit (docs/DESIGN.md long-context section)
-    blk = jax.checkpoint(block_body) if cfg.remat else block_body
+    if cfg.remat:
+        if cfg.remat_policy == "dots":
+            blk = jax.checkpoint(
+                block_body, policy=jax.checkpoint_policies.checkpoint_dots
+            )
+        else:
+            mlsl_assert(cfg.remat_policy == "full",
+                        "unknown remat_policy %r", cfg.remat_policy)
+            blk = jax.checkpoint(block_body)
+    else:
+        blk = block_body
     for i in range(cfg.n_blocks):
         h, aux = blk(
             h, params[f"blk{i}.ln"], params[f"blk{i}.attn"], params[f"blk{i}.mlp"]

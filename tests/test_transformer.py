@@ -263,6 +263,36 @@ def test_remat_matches_no_remat(env, dp, sp, tp):
                                    atol=1e-6, rtol=1e-6)
 
 
+def test_remat_dots_policy_matches_full(env):
+    """remat_policy='dots' (checkpoint_dots: matmul outputs saved, elementwise
+    replayed) must stay on the identical trajectory — only the memory/FLOP
+    trade differs; unknown policies fail loudly."""
+    b = 4
+    toks, labels = _data(b)
+    results = []
+    for cfg in (dataclasses.replace(CFG, remat=True),
+                dataclasses.replace(CFG, remat=True, remat_policy="dots")):
+        trainer = tfm.HybridTrainer(env, cfg, 2, 2, 2, batch=b, lr=0.5,
+                                    devices=env.devices[:8])
+        st, sl_ = trainer.shard_tokens(toks, labels)
+        losses = [float(trainer.step(st, sl_)) for _ in range(2)]
+        results.append((losses, jax.device_get(trainer.params)))
+    (l0, p0), (l1, p1) = results
+    np.testing.assert_allclose(l0, l1, atol=1e-6, rtol=1e-6)
+    for a, b_ in zip(jax.tree.leaves(p0), jax.tree.leaves(p1)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b_),
+                                   atol=1e-6, rtol=1e-6)
+
+    import mlsl_tpu
+
+    with pytest.raises(mlsl_tpu.MLSLError):
+        bad = dataclasses.replace(CFG, remat=True, remat_policy="nope")
+        tr = tfm.HybridTrainer(env, bad, 2, 2, 2, batch=b, lr=0.5,
+                               devices=env.devices[:8])
+        st, sl_ = tr.shard_tokens(toks, labels)
+        tr.step(st, sl_)
+
+
 def test_remat_replays_forward(env):
     """cfg.remat must actually re-run the block forwards in the backward:
     the compiled fused step's cost-model FLOPs grow by roughly the one extra
