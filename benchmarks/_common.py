@@ -117,9 +117,12 @@ def git_sha() -> str:
             ["git", "status", "--porcelain"], cwd=REPO_ROOT,
             capture_output=True, text=True,
         ).stdout.strip()
-        # the evidence file itself is always mid-append during a capture
+        # the evidence file itself is always mid-append during a capture;
+        # compare exact repo-relative paths, not a suffix (a stray
+        # OLD_BENCH_MEASURED.json must still mark the tree dirty)
+        evidence = os.path.relpath(measured_path(), REPO_ROOT)
         entries = [ln for ln in porcelain.splitlines()
-                   if not ln.endswith("BENCH_MEASURED.json")]
+                   if ln[3:].strip() != evidence]
         return sha + ("-dirty" if entries else "")
     except Exception:
         return "unknown"
@@ -149,7 +152,7 @@ def append_measurement(record: dict) -> None:
     os.replace(tmp, path)
 
 
-def timed_scan(step, carry0, iters=100, blocks=3):
+def timed_scan(step, carry0, iters=100, blocks=5):
     """Per-iteration ms for a carry→carry `step`, executed as a lax.scan
     inside ONE device computation, using a PAIRED-length estimate: best time
     at 2*iters minus best time at iters, divided by iters. For sub-ms kernels

@@ -126,16 +126,36 @@ def main():
     # same (qv, s) on both sides: isolates the dequant kernel under test from
     # any one-ulp quantizer divergence
     err = float(jnp.max(jnp.abs(dp(qv, s) - dr(qv, s))))
-    p_ms, x_ms = _time_multi(qp, x, iters=150), _time_multi(qr, x, iters=150)
-    results.append({"kernel": "quant_int8_256MiB", "ok": q_ok,
-                    "max_err": 0.0 if q_ok else 1.0,
-                    "pallas_ms": round(p_ms, 3), "xla_ms": round(x_ms, 3),
-                    "speedup": round(x_ms / p_ms, 3)})
-    p_ms = _time_multi(dp, qv, s, iters=150)
-    x_ms = _time_multi(dr, qv, s, iters=150)
-    results.append({"kernel": "dequant_int8_256MiB", "ok": err < 1e-6,
-                    "max_err": round(err, 8), "pallas_ms": round(p_ms, 3),
-                    "xla_ms": round(x_ms, 3), "speedup": round(x_ms / p_ms, 3)})
+
+    def _t(f, *a):
+        # long arms (600 calls -> ~100 ms paired diff on a ~0.5 ms kernel)
+        # ride out sustained tunnel drift; a floored result (1 µs) means the
+        # paired difference went negative under a load spike — remeasure
+        for _ in range(3):
+            ms = _time_multi(f, *a, iters=600)
+            if ms > 2e-3:
+                return ms
+        return None  # all retries floored: no credible measurement
+
+    def _quant_row(name, ok, err, p_ms, x_ms):
+        row = {"kernel": name, "ok": ok, "max_err": err,
+               "pallas_ms": None if p_ms is None else round(p_ms, 3),
+               "xla_ms": None if x_ms is None else round(x_ms, 3)}
+        if p_ms is None or x_ms is None:
+            # floored timing under sustained load: never fabricate a ratio
+            row["speedup"] = None
+            row["floored"] = True
+        else:
+            row["speedup"] = round(x_ms / p_ms, 3)
+        return row
+
+    p_ms, x_ms = _t(qp, x), _t(qr, x)
+    results.append(_quant_row("quant_int8_256MiB", q_ok,
+                              0.0 if q_ok else 1.0, p_ms, x_ms))
+    p_ms = _t(dp, qv, s)
+    x_ms = _t(dr, qv, s)
+    results.append(_quant_row("dequant_int8_256MiB", err < 1e-6,
+                              round(err, 8), p_ms, x_ms))
 
     for r in results:
         print(json.dumps(r))

@@ -245,14 +245,14 @@ def forward_local(params, tokens, cfg: TransformerConfig, sp: int, tp: int):
     # replays the block (incl. the ring hops' collectives) instead of keeping
     # qkv/attn/gelu intermediates alive — the O(sqrt)-style memory trade that
     # makes long sequences fit (docs/DESIGN.md long-context section)
+    mlsl_assert(cfg.remat_policy in ("full", "dots"),
+                "unknown remat_policy %r", cfg.remat_policy)
     if cfg.remat:
         if cfg.remat_policy == "dots":
             blk = jax.checkpoint(
                 block_body, policy=jax.checkpoint_policies.checkpoint_dots
             )
         else:
-            mlsl_assert(cfg.remat_policy == "full",
-                        "unknown remat_policy %r", cfg.remat_policy)
             blk = jax.checkpoint(block_body)
     else:
         blk = block_body
