@@ -127,6 +127,10 @@ def main():
             ("gpt-medium-2k-remat", tfm.TransformerConfig(
                 vocab=32768, d_model=1024, n_heads=16, head_dim=64,
                 n_blocks=12, seq_len=2048, remat=True), 8),
+            ("gpt-medium-2k-remat-dots", tfm.TransformerConfig(
+                vocab=32768, d_model=1024, n_heads=16, head_dim=64,
+                n_blocks=12, seq_len=2048, remat=True,
+                remat_policy="dots"), 8),
             # long-context single-chip row: at seq 8k the plain step's saved
             # activations overflow a 16 GiB v5e — remat makes it fit
             ("gpt-medium-8k-remat", tfm.TransformerConfig(
