@@ -43,18 +43,24 @@ def _quant(x2d, use_pallas):
 
 
 def _dequant(q, s, use_pallas):
-    if use_pallas:
-        return qk._dequantize_pallas(q, s)
+    # Always the XLA form here, even when use_pallas enables the quantize
+    # kernel: on bare 2-D blocks (exactly the ring's case) the pallas dequant
+    # measured 0.88-1.01x of this (never a win) at 256 MiB streaming, and the
+    # jnp multiply fuses into the ring's accumulate adds, which an opaque
+    # pallas_call cannot. (The public 1-D dequantize() wrapper is the
+    # opposite case — see quant_kernels.dequantize.)
+    del use_pallas
     return qk.dequantize_blocks_ref(q, s)
 
 
 def _chunk_unit(rc: int, use_pallas: bool, block: int) -> int:
     """Ring-chunk alignment unit (elements). On the pallas path chunks align
     to tile-legal rows (ROW_TILE); large per-rank slices align to PACK_ROWS
-    rows instead so every per-hop quant/dequant takes the packed-scale
-    kernels (dense (g, 128) scales — see quant_kernels; ~1.6x at streaming
-    sizes). The coarse unit engages only where its padding waste is bounded
-    by 12.5% (same 8*block*PACK_ROWS threshold as quantize())."""
+    rows instead so every per-hop QUANTIZE takes the packed-scale kernel
+    (dense (g, 128) scales — see quant_kernels; ~1.6x at streaming sizes;
+    the dequant direction always uses the XLA form, see _dequant). The
+    coarse unit engages only where its padding waste is bounded by 12.5%
+    (same 8*block*PACK_ROWS threshold as quantize())."""
     if not use_pallas:
         return block
     if rc >= 8 * block * qk.PACK_ROWS:
