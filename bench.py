@@ -277,10 +277,10 @@ def main():
 
     # Secondary evidence: transformer training throughput (tokens/s) through
     # the HybridTrainer on the same chip — the long-context workload family.
-    tfm_tok_s = tfm_ms = None
+    tfm_tok_s = tfm_ms = tfm_mfu_model = None
     if not args.quick:
         try:
-            tfm_tok_s, tfm_ms = _transformer_throughput(env)
+            tfm_tok_s, tfm_ms, tfm_mfu_model = _transformer_throughput(env)
         except Exception as e:
             print(f"bench: transformer throughput skipped ({e})", file=sys.stderr)
 
@@ -302,6 +302,8 @@ def main():
         "mfu_best": round(mfu_best, 4) if mfu_best else None,
         "transformer_tok_s": round(tfm_tok_s) if tfm_tok_s else None,
         "transformer_step_ms": round(tfm_ms, 3) if tfm_ms else None,
+        "transformer_mfu_model": (round(tfm_mfu_model, 4)
+                                  if tfm_mfu_model else None),
         "device": device_kind,
     }
     print(json.dumps(result))
@@ -379,7 +381,16 @@ def _transformer_throughput(env):
     from benchmarks._common import timed
 
     ms = timed(lambda: trainer.step(tb, lb), iters=36, warmup=4, blocks=6)
-    return batch * cfg.seq_len / (ms / 1e3), ms
+    mfu_model = None
+    try:
+        from benchmarks.transformer_bench import model_flops
+
+        peak = _peak_tflops(env.devices[0].device_kind)
+        if peak:
+            mfu_model = model_flops(cfg, batch) / (ms / 1e3) / 1e12 / peak
+    except Exception as e:
+        print(f"bench: transformer mfu skipped ({e})", file=sys.stderr)
+    return batch * cfg.seq_len / (ms / 1e3), ms, mfu_model
 
 
 def _peak_tflops(device_kind: str) -> float:

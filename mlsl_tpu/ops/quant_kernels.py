@@ -216,4 +216,6 @@ def dequantize(q: jax.Array, scales: jax.Array, block: int = 256, orig_len=None,
     else:
         x = dequantize_blocks_ref(q2d, scales)
     x = x.reshape(-1)
-    return x if orig_len is None else x[:orig_len]
+    if orig_len is None or orig_len == x.shape[0]:
+        return x
+    return x[:orig_len]
