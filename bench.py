@@ -198,7 +198,7 @@ def main():
     # trainer with fresh batches each step (the reference's endpoint-server
     # file-IO offload streaming into shm while the trainer computes) — the
     # steady-state number a real training job sees, input pipeline included.
-    pipe_ms = None
+    pipe_ms = h2d_mbps = None
     loader = None
     try:
         import ml_dtypes
@@ -223,6 +223,23 @@ def main():
             trainer.step(next(it))
         _sync(trainer.params)
         pipe_ms = (time.perf_counter() - t0) / n_pipe * 1e3
+        # h2d bandwidth context: one timed device_put of a batch. When
+        # pipeline_step_ms >> step time, THIS is the bottleneck — through the
+        # axon tunnel h2d runs at tens of MB/s, ~3 orders below the PCIe/DMA
+        # path of a directly-attached chip, so the pipeline row measures the
+        # transport, not the loader design.
+        try:
+            bx, _ = next(iter(synthetic_source(
+                batch, (hw, hw, 3), classes, seed=2, dtype=ml_dtypes.bfloat16)))
+            h2d_s = float("inf")
+            for _ in range(2):  # best-of-2: skip a cold-path draw
+                t0 = time.perf_counter()
+                _sync(jax.device_put(bx))
+                h2d_s = min(h2d_s, time.perf_counter() - t0)
+            h2d_mbps = bx.nbytes / 1e6 / h2d_s
+        except Exception as e:
+            h2d_mbps = None
+            print(f"bench: h2d probe skipped ({e})", file=sys.stderr)
     except Exception as e:
         print(f"bench: pipeline measurement skipped ({e})", file=sys.stderr)
     finally:
@@ -296,6 +313,7 @@ def main():
         "batch": batch,
         "pipeline_step_ms": round(pipe_ms, 3) if pipe_ms is not None else None,
         "images_per_s": round(batch / (pipe_ms / 1e3)) if pipe_ms else None,
+        "h2d_mbps": round(h2d_mbps, 1) if h2d_mbps else None,
         "tflops": round(tflops, 3) if tflops else None,
         "mfu": round(mfu, 4) if mfu else None,
         "tflops_best": round(tflops_best, 3) if tflops_best else None,
@@ -383,7 +401,10 @@ def _transformer_throughput(env):
     ms = timed(lambda: trainer.step(tb, lb), iters=36, warmup=4, blocks=6)
     mfu_model = None
     try:
-        from benchmarks.transformer_bench import model_flops
+        # _common is side-effect-free; transformer_bench probes the tunnel at
+        # import (setup_chip) and sys.exit(3)s on failure, which would escape
+        # the except-Exception guards at the END of an expensive run
+        from benchmarks._common import model_flops
 
         peak = _peak_tflops(env.devices[0].device_kind)
         if peak:

@@ -104,6 +104,24 @@ def measured_path() -> str:
     return os.environ.get("MLSL_BENCH_MEASURED_PATH", MEASURED_PATH)
 
 
+def model_flops(cfg, batch):
+    """Analytic model FLOPs per train step (fwd + bwd = 3x fwd, the standard
+    MFU denominator): per token per block 8*d^2 qkvo + 4*mlp_ratio*d^2 MLP
+    matmul FLOPs + 2*S*d causal attention (4*S*d full halved by the mask),
+    plus the 2*d*V head. Unlike the executed-program cost model this does NOT
+    count remat recompute, so remat variants' mfu_model is comparable: a
+    faster wall clock is a higher mfu_model, full stop. Returns None for MoE
+    configs (active FLOPs depend on routing/capacity; the executed-program
+    row is the honest one there)."""
+    if cfg.n_experts > 0:
+        return None
+    t = batch * cfg.seq_len
+    d = cfg.d_model
+    per_tok_blk = (8 + 4 * cfg.mlp_ratio) * d * d + 2 * cfg.seq_len * d
+    fwd = t * (cfg.n_blocks * per_tok_blk + 2 * d * cfg.vocab)
+    return 3.0 * fwd
+
+
 def git_sha() -> str:
     """Short HEAD sha, suffixed '-dirty' when the tree has uncommitted
     changes — a record claiming a clean sha while measuring workspace code
