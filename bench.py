@@ -223,30 +223,35 @@ def main():
             trainer.step(next(it))
         _sync(trainer.params)
         pipe_ms = (time.perf_counter() - t0) / n_pipe * 1e3
-        # h2d bandwidth context: one timed device_put of a batch. When
-        # pipeline_step_ms >> step time, THIS is the bottleneck — through the
-        # axon tunnel h2d runs at tens of MB/s, ~3 orders below the PCIe/DMA
-        # path of a directly-attached chip, so the pipeline row measures the
-        # transport, not the loader design.
-        try:
-            bx, _ = next(iter(synthetic_source(
-                batch, (hw, hw, 3), classes, seed=2, dtype=ml_dtypes.bfloat16)))
-            h2d_s = float("inf")
-            for _ in range(2):  # best-of-2: skip a cold-path draw
-                t0 = time.perf_counter()
-                _sync(jax.device_put(bx))
-                h2d_s = min(h2d_s, time.perf_counter() - t0)
-            h2d_mbps = bx.nbytes / 1e6 / h2d_s
-        except Exception as e:
-            h2d_mbps = None
-            print(f"bench: h2d probe skipped ({e})", file=sys.stderr)
     except Exception as e:
         print(f"bench: pipeline measurement skipped ({e})", file=sys.stderr)
     finally:
         if loader is not None:
             # the prefetch thread must not keep issuing transfers under the
-            # overlap measurement below
+            # h2d probe and overlap measurements below
             loader.close()
+
+    # h2d bandwidth context: a timed device_put of one batch, AFTER the
+    # loader is closed so no prefetch transfer contends for the transport.
+    # When pipeline_step_ms >> step time, THIS is the bottleneck — through
+    # the axon tunnel h2d runs at tens of MB/s, ~3 orders below the PCIe/DMA
+    # path of a directly-attached chip, so the pipeline row measures the
+    # transport, not the loader design.
+    try:
+        import ml_dtypes
+
+        from mlsl_tpu.data import synthetic_source
+
+        bx, _ = next(iter(synthetic_source(
+            batch, (hw, hw, 3), classes, seed=2, dtype=ml_dtypes.bfloat16)))
+        h2d_s = float("inf")
+        for _ in range(2):  # best-of-2: skip a cold-path draw
+            t0 = time.perf_counter()
+            _sync(jax.device_put(bx))
+            h2d_s = min(h2d_s, time.perf_counter() - t0)
+        h2d_mbps = bx.nbytes / 1e6 / h2d_s
+    except Exception as e:
+        print(f"bench: h2d probe skipped ({e})", file=sys.stderr)
 
     # Overlap quantification (the point of the async Start/Wait engine —
     # reference eplib newest-first allreduce, eplib/allreduce_pr.c:76-79):

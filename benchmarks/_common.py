@@ -106,8 +106,9 @@ def measured_path() -> str:
 
 def model_flops(cfg, batch):
     """Analytic model FLOPs per train step (fwd + bwd = 3x fwd, the standard
-    MFU denominator): per token per block 8*d^2 qkvo + 4*mlp_ratio*d^2 MLP
-    matmul FLOPs + 2*S*d causal attention (4*S*d full halved by the mask),
+    MFU denominator): per token per block 8*d*ad qkvo (ad = n_heads*head_dim,
+    which the config does NOT require to equal d_model) + 4*mlp_ratio*d^2 MLP
+    matmul FLOPs + 2*S*ad causal attention (4*S*ad full halved by the mask),
     plus the 2*d*V head. Unlike the executed-program cost model this does NOT
     count remat recompute, so remat variants' mfu_model is comparable: a
     faster wall clock is a higher mfu_model, full stop. Returns None for MoE
@@ -117,7 +118,9 @@ def model_flops(cfg, batch):
         return None
     t = batch * cfg.seq_len
     d = cfg.d_model
-    per_tok_blk = (8 + 4 * cfg.mlp_ratio) * d * d + 2 * cfg.seq_len * d
+    ad = cfg.n_heads * cfg.head_dim
+    per_tok_blk = (8 * d * ad + 4 * cfg.mlp_ratio * d * d
+                   + 2 * cfg.seq_len * ad)
     fwd = t * (cfg.n_blocks * per_tok_blk + 2 * d * cfg.vocab)
     return 3.0 * fwd
 
@@ -225,8 +228,10 @@ def timed(fn, *args, iters=30, warmup=5, blocks=3):
         r = fn(*args)
     device_sync(r)
     # each round runs K + 2K calls; keep the TOTAL near the caller's iters
-    # budget so existing call sites don't silently triple their wall time
-    per_block = max(1, iters // (3 * blocks))
+    # budget so existing call sites don't silently triple their wall time —
+    # but never below 2 calls per arm, where the paired difference would ride
+    # on a single dispatch's RTT jitter
+    per_block = max(2, iters // (3 * blocks))
     best1 = best2 = float("inf")
     for _ in range(blocks):
         t0 = time.perf_counter()

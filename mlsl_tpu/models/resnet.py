@@ -141,9 +141,9 @@ def _use_s2d_stem() -> bool:
         return False
     if v in ("1", "true", "on"):
         return True
-    from mlsl_tpu.ops.quant_kernels import _on_tpu
+    from mlsl_tpu.sysinfo import on_tpu
 
-    return _on_tpu()
+    return on_tpu()
 
 
 def _bottleneck(x, block, stride):

@@ -29,10 +29,9 @@ PACK_ROWS = 1024  # rows per grid step on the packed-scale path: the scale
 
 
 def _on_tpu() -> bool:
-    try:
-        return jax.default_backend() == "tpu"
-    except Exception:  # pragma: no cover
-        return False
+    from mlsl_tpu.sysinfo import on_tpu
+
+    return on_tpu()
 
 
 # -- reference (jnp) implementation: the semantic oracle ---------------------

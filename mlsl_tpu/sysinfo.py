@@ -37,6 +37,15 @@ def apply_platform_override() -> None:
         jax.config.update("jax_platforms", platform)
 
 
+def on_tpu() -> bool:
+    """True when the active JAX backend is a TPU — the one platform probe
+    model/kernel code should key fast-path defaults on."""
+    try:
+        return jax.default_backend() == "tpu"
+    except Exception:  # pragma: no cover
+        return False
+
+
 @functools.lru_cache(maxsize=1)
 def probe() -> SysInfo:
     devices = jax.devices()
