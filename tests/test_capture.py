@@ -74,3 +74,4 @@ def test_once_cpu_backend_captures_record(tmp_path):
     assert row["metric"] == "resnet50_dp_train_step_time"
     assert row["value"] > 0
     assert rec.get("partial") is False
+

@@ -1,0 +1,48 @@
+"""Unit tests for the shared benchmark helpers (benchmarks/_common.py)."""
+
+import os
+import sys
+
+REPO = os.path.join(os.path.dirname(os.path.abspath(__file__)), "..")
+if REPO not in sys.path:
+    sys.path.insert(0, REPO)
+
+from benchmarks._common import model_flops
+
+
+def test_model_flops_denominator_pinned():
+    """model_flops is the denominator of every published mfu_model number
+    (bench.py, transformer_bench.py, README); pin its value so a refactor
+    cannot silently shift the metric. Hand computation for the d512 config:
+    ad = 8*64 = 512; per-token-block = 8*512*512 (qkvo) + 16*512*512 (MLP)
+    + 2*512*512 (causal attn) = 26*512^2; fwd = B*S*(8 blocks*26*512^2
+    + 2*512*32768); train = 3x fwd."""
+
+    class Cfg:
+        n_experts = 0
+        seq_len = 512
+        d_model = 512
+        n_heads = 8
+        head_dim = 64
+        mlp_ratio = 4
+        n_blocks = 8
+        vocab = 32768
+
+    t = 32 * 512
+    per_tok_blk = 26 * 512 * 512
+    fwd = t * (8 * per_tok_blk + 2 * 512 * 32768)
+    assert model_flops(Cfg(), 32) == 3.0 * fwd
+
+    # ad != d_model configs must use ad, not d^2 (found by review: the
+    # original formula inflated qkvo/attention ~2x for such configs)
+    class Half(Cfg):
+        n_heads = 4  # ad = 256
+
+    per_tok_blk_h = (8 * 512 * 256) + (16 * 512 * 512) + (2 * 512 * 256)
+    fwd_h = t * (8 * per_tok_blk_h + 2 * 512 * 32768)
+    assert model_flops(Half(), 32) == 3.0 * fwd_h
+
+    class MoE(Cfg):
+        n_experts = 4
+
+    assert model_flops(MoE(), 32) is None
