@@ -18,7 +18,7 @@ from mlsl_tpu.types import (
     CompressionType,
     QuantParams,
 )
-from mlsl_tpu.log import MLSLError
+from mlsl_tpu.log import MLSLError, MLSLTimeoutError
 from mlsl_tpu.core.environment import Environment
 from mlsl_tpu.core.distribution import Distribution
 from mlsl_tpu.core.session import Session, Operation, OperationRegInfo
@@ -46,4 +46,5 @@ __all__ = [
     "ParameterSet",
     "Statistics",
     "MLSLError",
+    "MLSLTimeoutError",
 ]

@@ -1,0 +1,248 @@
+"""Process-wide fault injection: named sites threaded through the real stack.
+
+The reference is fail-stop (SURVEY.md §5.3: MLSL_ASSERT -> Finalize + _exit(1));
+its recovery story is untestable because there is nothing to test. Here every
+layer that can fail in production — request dispatch, collective launch, the
+quantized codec round-trip, checkpoint IO, data prefetch — passes a named
+injection *site*, and this registry decides whether that pass raises, stalls,
+hangs, or rots bytes. Tests (tests/test_chaos.py) and the ``MLSL_CHAOS`` env
+var arm faults without touching the code under test, so the recovery paths in
+``mlsl_tpu.resilience`` are exercised as a matrix rather than one happy path.
+
+Sites (see ``SITES``) are compiled into the registry, not discovered, so a
+typo in a plan is an error instead of a fault that never fires.
+
+Python API::
+
+    chaos.plan("checkpoint.save", "error", exc=OSError, after=2, times=1)
+    with chaos.injected("request.wait", "delay", seconds=0.1):
+        ...
+    chaos.clear()
+
+Env config (comma-separated)::
+
+    MLSL_CHAOS="request.wait:error@6,collective.dispatch:hang=30,data.prefetch:delay=0.05x*"
+
+Grammar per entry: ``site:kind[=value][@after][xN]`` — *value* is the
+exception name for ``error`` (oserror, runtimeerror, mlslerror, ...) or
+seconds for ``delay``/``hang``; ``@after`` skips the first N hits; ``xN``
+fires at most N times (default 1; ``x*`` = unlimited).
+
+Hot-path contract: instrumented code guards with ``if chaos._plans:`` (one
+dict truthiness test when idle) or calls ``inject`` directly (one call + one
+check). Nothing else happens until a plan is armed.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+import threading
+import time
+from typing import Dict, List, Optional
+
+from mlsl_tpu.log import MLSLError, log_info, log_warning
+
+
+class ChaosError(RuntimeError):
+    """Default injected fault: recoverable (RuntimeError) by FaultTolerantLoop."""
+
+
+#: Every legal injection site and where it lives in the stack.
+SITES: Dict[str, str] = {
+    "request.start": "CommRequest.start (comm/request.py): before dispatch",
+    "request.wait": "CommRequest.wait (comm/request.py): before completion wait",
+    "request.test": "CommRequest.test (comm/request.py): before completion poll",
+    "collective.dispatch": "compiled collective invocation (comm/collectives.py)",
+    "codec.roundtrip": "quantized ring codec round-trip (comm/quant_ring.py)",
+    "checkpoint.save": "CheckpointManager.save (checkpoint.py); supports bitrot",
+    "checkpoint.restore": "CheckpointManager.restore (checkpoint.py)",
+    "data.prefetch": "AsyncLoader worker batch read (data.py)",
+}
+
+KINDS = ("error", "delay", "hang", "bitrot")
+
+_EXC_NAMES = {
+    "chaoserror": ChaosError,
+    "runtimeerror": RuntimeError,
+    "mlslerror": MLSLError,
+    "oserror": OSError,
+    "ioerror": OSError,
+    "valueerror": ValueError,
+    "timeouterror": TimeoutError,
+}
+
+
+@dataclasses.dataclass
+class Plan:
+    """One armed fault. ``after`` hits are skipped, then it fires ``times``
+    times (None = unlimited). ``hits``/``fires`` are the observable counters."""
+
+    site: str
+    kind: str = "error"
+    exc: type = ChaosError
+    seconds: float = 0.1
+    after: int = 0
+    times: Optional[int] = 1
+    hits: int = 0
+    fires: int = 0
+    cancelled: bool = False
+
+    def _should_fire(self) -> bool:
+        # caller holds _lock
+        self.hits += 1
+        if self.cancelled or self.hits <= self.after:
+            return False
+        if self.times is not None and self.fires >= self.times:
+            return False
+        self.fires += 1
+        return True
+
+
+_lock = threading.Lock()
+_plans: Dict[str, List[Plan]] = {}  # site -> armed plans (empty dict = idle)
+
+
+def plan(
+    site: str,
+    kind: str = "error",
+    exc: type = ChaosError,
+    seconds: float = 0.1,
+    after: int = 0,
+    times: Optional[int] = 1,
+) -> Plan:
+    """Arm a fault at ``site``. Returns the Plan (counters readable by tests)."""
+    if site not in SITES:
+        raise ValueError(f"unknown chaos site {site!r}; known: {sorted(SITES)}")
+    if kind not in KINDS:
+        raise ValueError(f"unknown chaos kind {kind!r}; known: {KINDS}")
+    p = Plan(site=site, kind=kind, exc=exc, seconds=seconds, after=after, times=times)
+    with _lock:
+        _plans.setdefault(site, []).append(p)
+    log_info("chaos armed: %s %s after=%d times=%s", site, kind, after, times)
+    return p
+
+
+class injected:
+    """Context manager: arm a plan on entry, remove it (and wake any hang) on
+    exit. ``with chaos.injected("request.wait", "delay", seconds=0.1): ...``"""
+
+    def __init__(self, site: str, kind: str = "error", **kw):
+        self._args = (site, kind)
+        self._kw = kw
+        self.plan: Optional[Plan] = None
+
+    def __enter__(self) -> Plan:
+        self.plan = plan(*self._args, **self._kw)
+        return self.plan
+
+    def __exit__(self, *exc) -> None:
+        remove(self.plan)
+
+
+def remove(p: Plan) -> None:
+    p.cancelled = True
+    with _lock:
+        site_plans = _plans.get(p.site)
+        if site_plans is not None:
+            try:
+                site_plans.remove(p)
+            except ValueError:
+                pass
+            if not site_plans:
+                del _plans[p.site]
+
+
+def clear() -> None:
+    """Disarm everything and wake any in-progress hang sleeps."""
+    with _lock:
+        for plans_ in _plans.values():
+            for p in plans_:
+                p.cancelled = True
+        _plans.clear()
+
+
+def active() -> bool:
+    return bool(_plans)
+
+
+def inject(site: str, **ctx) -> Optional[Plan]:
+    """Pass ``site``. No-op (one dict check) unless a plan is armed there.
+
+    ``error`` raises the plan's exception, ``delay`` sleeps, ``hang`` sleeps
+    until its duration elapses or the plan is cancelled (clear()/remove()).
+    Site-specific kinds (``bitrot``) don't act here — the fired Plan is
+    returned and the call site applies the effect (checkpoint.py corrupts the
+    committed files). ``ctx`` is free-form, logged for diagnosis.
+    """
+    if not _plans:
+        return None
+    site_plans = _plans.get(site)
+    if not site_plans:
+        return None
+    fired: Optional[Plan] = None
+    for p in list(site_plans):
+        with _lock:
+            go = p._should_fire()
+        if not go:
+            continue
+        log_warning("chaos fired: %s %s (hit %d) ctx=%s", site, p.kind, p.hits, ctx)
+        if p.kind == "error":
+            raise p.exc(f"chaos injected at {site} (hit {p.hits})")
+        if p.kind == "delay":
+            time.sleep(p.seconds)
+        elif p.kind == "hang":
+            end = time.monotonic() + p.seconds
+            while time.monotonic() < end and not p.cancelled:
+                time.sleep(0.01)
+        fired = p
+    return fired
+
+
+def refresh_from_env(spec: Optional[str] = None) -> List[Plan]:
+    """(Re)arm plans from ``MLSL_CHAOS`` (or an explicit spec). Replaces any
+    previously env-armed plans; API-armed plans are cleared too — the env spec
+    is authoritative when used."""
+    if spec is None:
+        spec = os.environ.get("MLSL_CHAOS", "")
+    clear()
+    out = []
+    for entry in filter(None, (e.strip() for e in spec.split(","))):
+        out.append(plan(**_parse_entry(entry)))
+    return out
+
+
+def _parse_entry(entry: str) -> dict:
+    """``site:kind[=value][@after][xN]`` -> plan() kwargs."""
+    site, sep, rest = entry.partition(":")
+    if not sep:
+        raise ValueError(f"bad MLSL_CHAOS entry {entry!r}: expected site:kind[...]")
+    kw: dict = {"site": site}
+    times: Optional[int] = 1
+    if "x" in rest:
+        rest, _, t = rest.rpartition("x")
+        times = None if t == "*" else int(t)
+    kw["times"] = times
+    if "@" in rest:
+        rest, _, a = rest.partition("@")
+        kw["after"] = int(a)
+    kind, _, value = rest.partition("=")
+    kw["kind"] = kind
+    if value:
+        if kind == "error":
+            try:
+                kw["exc"] = _EXC_NAMES[value.lower()]
+            except KeyError:
+                raise ValueError(
+                    f"unknown exception {value!r} in MLSL_CHAOS entry {entry!r}; "
+                    f"known: {sorted(_EXC_NAMES)}"
+                ) from None
+        else:
+            kw["seconds"] = float(value)
+    return kw
+
+
+# Arm from the environment at import: instrumented modules import this module,
+# so MLSL_CHAOS=... on the launch command works with no code changes.
+if os.environ.get("MLSL_CHAOS"):
+    refresh_from_env()

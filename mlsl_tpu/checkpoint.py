@@ -5,14 +5,35 @@ closest artifact is the endpoint-server async file-IO offload). A *framework* ne
 one, so this module provides it TPU-natively: async orbax saves (the save executes in
 the background while training continues — the same overlap idea as eplib's offloaded
 file reads), sharding-preserving restore, and trainer integration.
+
+Hardened for production faults (the chaos layer exercises every path below,
+tests/test_chaos.py):
+
+- **Async errors surface.** A failed background save must never be mistaken for
+  a committed resume point: ``save()``/``wait()`` run orbax's
+  ``check_for_errors`` and re-raise.
+- **Checksum manifests.** Every committed step gets a ``manifest-<step>.json``
+  of per-file sha256 sums written alongside it; ``verify()`` detects bit-rot.
+- **Verified fallback.** ``restore_trainer`` walks steps newest-first and skips
+  any step that fails verification (or whose restore raises), resuming from the
+  newest *verified* step instead of dying on a corrupt latest.
+- **Save retry.** Transient IO errors (OSError) during save dispatch retry with
+  exponential backoff (MLSL_CKPT_SAVE_RETRIES / MLSL_CKPT_RETRY_BACKOFF_S).
 """
 
 from __future__ import annotations
 
+import hashlib
+import json
 import os
-from typing import Any, Optional
+import time
+from typing import Any, List, Optional
 
 import jax
+
+from mlsl_tpu import chaos
+from mlsl_tpu.config import _env_float, _env_int
+from mlsl_tpu.log import MLSLError, log_info, log_warning
 
 try:
     import orbax.checkpoint as ocp
@@ -25,11 +46,29 @@ except ImportError:  # pragma: no cover
 class CheckpointManager:
     """Save/restore pytrees of (possibly sharded) jax.Arrays by step number."""
 
-    def __init__(self, directory: str, max_to_keep: int = 3):
+    def __init__(
+        self,
+        directory: str,
+        max_to_keep: int = 3,
+        save_retries: Optional[int] = None,
+        retry_backoff_s: Optional[float] = None,
+    ):
         if not _HAVE_ORBAX:
             raise RuntimeError("orbax-checkpoint is not available")
         self.directory = os.path.abspath(directory)
         os.makedirs(self.directory, exist_ok=True)
+        self.save_retries = (
+            _env_int("MLSL_CKPT_SAVE_RETRIES", 3)
+            if save_retries is None
+            else save_retries
+        )
+        self.retry_backoff_s = (
+            _env_float("MLSL_CKPT_RETRY_BACKOFF_S", 0.05)
+            if retry_backoff_s is None
+            else retry_backoff_s
+        )
+        self._unverified: set = set()  # steps saved but not yet checksummed
+        self._bitrot: set = set()      # chaos: steps to corrupt post-manifest
         self._mgr = ocp.CheckpointManager(
             self.directory,
             options=ocp.CheckpointManagerOptions(
@@ -37,11 +76,52 @@ class CheckpointManager:
             ),
         )
 
+    # -- async-error surfacing --------------------------------------------
+
+    def check_errors(self) -> None:
+        """Surface a failed background save (orbax ``check_for_errors``) — a
+        silent async failure would otherwise let the caller believe the step
+        is a committed resume point."""
+        chk = getattr(self._mgr, "check_for_errors", None)
+        if chk is not None:
+            chk()
+
+    # -- save/restore ------------------------------------------------------
+
     def save(self, step: int, state: Any, wait: bool = False) -> None:
-        """Dispatch an async save of ``state`` (any pytree of arrays)."""
-        self._mgr.save(step, args=ocp.args.StandardSave(state))
+        """Dispatch an async save of ``state`` (any pytree of arrays).
+
+        Transient IO errors (OSError) at dispatch retry with exponential
+        backoff; anything else propagates (recoverable by FaultTolerantLoop).
+        """
+        self.check_errors()
+        delay = self.retry_backoff_s
+        for attempt in range(self.save_retries + 1):
+            try:
+                # chaos first: an injected OSError exercises the same retry
+                # path a flaky filesystem would
+                plan = chaos.inject("checkpoint.save", step=step, attempt=attempt)
+                if plan is not None and plan.kind == "bitrot":
+                    self._bitrot.add(step)
+                self._mgr.save(step, args=ocp.args.StandardSave(state))
+                break
+            except OSError as e:
+                if attempt >= self.save_retries:
+                    raise
+                log_warning(
+                    "checkpoint save of step %d failed (%s: %s); "
+                    "retry %d/%d in %.2fs",
+                    step, type(e).__name__, e,
+                    attempt + 1, self.save_retries, delay,
+                )
+                time.sleep(delay)
+                delay *= 2
+        self._unverified.add(step)
         if wait:
-            self._mgr.wait_until_finished()
+            self.wait()
+        # async path: manifests are checksummed at the next drain point
+        # (wait()/close()/restore) — never inline on the training hot path,
+        # which would stall exactly the overlap the async save buys
 
     def restore(self, step: Optional[int] = None, template: Any = None) -> Any:
         """Restore the given (or latest) step. ``template`` — a pytree of arrays or
@@ -50,6 +130,7 @@ class CheckpointManager:
             step = self.latest_step()
         if step is None:
             return None
+        chaos.inject("checkpoint.restore", step=step)
         if template is not None:
             target = jax.tree.map(
                 lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype, sharding=getattr(x, "sharding", None))
@@ -63,12 +144,145 @@ class CheckpointManager:
     def latest_step(self) -> Optional[int]:
         return self._mgr.latest_step()
 
+    def all_steps(self) -> List[int]:
+        return sorted(self._mgr.all_steps())
+
     def wait(self) -> None:
         self._mgr.wait_until_finished()
+        self.check_errors()
+        self._flush_manifests()
 
     def close(self) -> None:
         self._mgr.wait_until_finished()
+        self.check_errors()  # a failed final save must not vanish at close
+        self._flush_manifests()
         self._mgr.close()
+
+    # -- checksum manifests ------------------------------------------------
+
+    def _manifest_path(self, step: int) -> str:
+        return os.path.join(self.directory, f"manifest-{step}.json")
+
+    def _step_dir(self, step: int) -> Optional[str]:
+        """The committed step directory, or None while the save is in flight
+        (orbax renames the tmp dir into place only on commit)."""
+        cand = os.path.join(self.directory, str(step))
+        if os.path.isdir(cand):
+            return cand
+        for name in os.listdir(self.directory):  # non-default step formats
+            p = os.path.join(self.directory, name)
+            if (
+                os.path.isdir(p)
+                and "tmp" not in name
+                and name.rsplit("_", 1)[-1] == str(step)
+            ):
+                return p
+        return None
+
+    @staticmethod
+    def _file_sha256(path: str) -> str:
+        h = hashlib.sha256()
+        with open(path, "rb") as f:
+            for block in iter(lambda: f.read(1 << 20), b""):
+                h.update(block)
+        return h.hexdigest()
+
+    def _checksum_tree(self, root: str) -> dict:
+        files = {}
+        for base, _, names in os.walk(root):
+            for n in sorted(names):
+                p = os.path.join(base, n)
+                files[os.path.relpath(p, root)] = self._file_sha256(p)
+        return files
+
+    def _flush_manifests(self) -> None:
+        """Write ``manifest-<step>.json`` for every save that has committed
+        since the last flush, then apply any chaos bit-rot (after the manifest,
+        as real rot happens: the manifest records the good bytes, so verify()
+        catches the corruption)."""
+        live = set(self._mgr.all_steps())
+        newest = max(live) if live else None
+        for step in sorted(self._unverified):
+            d = self._step_dir(step)
+            if (
+                step not in live
+                and d is None
+                and newest is not None
+                and step < newest
+            ):
+                # only an OLDER step missing from both the registry and the
+                # filesystem was reaped by max_to_keep; the newest save may
+                # simply not be listed/committed yet
+                self._unverified.discard(step)
+                continue
+            if d is None:
+                continue  # still in flight
+            manifest = {"step": step, "written_at": time.time(),
+                        "files": self._checksum_tree(d)}
+            tmp = self._manifest_path(step) + ".tmp"
+            with open(tmp, "w") as f:
+                json.dump(manifest, f)
+            os.replace(tmp, self._manifest_path(step))
+            self._unverified.discard(step)
+            if step in self._bitrot:
+                self._bitrot.discard(step)
+                self._apply_bitrot(step, d)
+        # drop manifests whose step was garbage-collected
+        for name in os.listdir(self.directory):
+            if name.startswith("manifest-") and name.endswith(".json"):
+                try:
+                    s = int(name[len("manifest-"):-len(".json")])
+                except ValueError:
+                    continue
+                if s not in live and s not in self._unverified:
+                    try:
+                        os.remove(os.path.join(self.directory, name))
+                    except OSError:
+                        pass
+
+    def _apply_bitrot(self, step: int, step_dir: str) -> None:
+        """Chaos 'bitrot' kind: flip bytes in the largest payload file of a
+        committed checkpoint, simulating on-disk corruption after a clean
+        write. verify() must subsequently fail for this step."""
+        target, size = None, -1
+        for base, _, names in os.walk(step_dir):
+            for n in names:
+                p = os.path.join(base, n)
+                sz = os.path.getsize(p)
+                if sz > size:
+                    target, size = p, sz
+        if target is None:
+            return
+        with open(target, "r+b") as f:
+            f.seek(size // 2)
+            chunk = f.read(64) or b"\0"
+            f.seek(size // 2)
+            f.write(bytes(b ^ 0xFF for b in chunk))
+        log_warning("chaos: bit-rot injected into step %d (%s)", step, target)
+
+    def verify(self, step: int) -> Optional[bool]:
+        """True: manifest present and every file matches. False: corrupt
+        (mismatch, missing file, or unreadable manifest). None: no manifest
+        (pre-manifest checkpoint or a save that never committed cleanly)."""
+        mp = self._manifest_path(step)
+        if not os.path.exists(mp):
+            return None
+        try:
+            with open(mp) as f:
+                manifest = json.load(f)
+        except (OSError, ValueError):
+            return False
+        d = self._step_dir(step)
+        if d is None:
+            return False
+        for rel, want in manifest.get("files", {}).items():
+            p = os.path.join(d, rel)
+            try:
+                if self._file_sha256(p) != want:
+                    return False
+            except OSError:
+                return False
+        return True
 
 
 def _trainer_state(trainer, step: int) -> dict:
@@ -83,6 +297,15 @@ def _trainer_state(trainer, step: int) -> dict:
     return state
 
 
+def _apply_state(trainer, state) -> int:
+    trainer.params = state["params"]
+    if "opt_state" in state:
+        trainer._opt_state = state["opt_state"]
+    if "du_opt_state" in state:
+        trainer._du_opt_state = state["du_opt_state"]
+    return int(state["step"])
+
+
 def save_trainer(mgr: CheckpointManager, trainer, step: int, wait: bool = False) -> None:
     """Persist a DataParallelTrainer/HybridTrainer's parameters (and optimizer
     state, when the trainer carries one)."""
@@ -91,13 +314,44 @@ def save_trainer(mgr: CheckpointManager, trainer, step: int, wait: bool = False)
 
 def restore_trainer(mgr: CheckpointManager, trainer, step: Optional[int] = None) -> Optional[int]:
     """Restore parameters (and optimizer state) in place; returns the restored
-    step or None."""
-    state = mgr.restore(step, template=_trainer_state(trainer, 0))
-    if state is None:
+    step or None when the directory holds no checkpoints.
+
+    With ``step=None`` the steps are tried newest-first: a step that fails
+    checksum verification, or whose restore raises, is skipped with a warning
+    and the next older step is tried — a corrupt latest checkpoint costs a
+    longer replay, not the run. If checkpoints exist but none restores, raise
+    (silently restarting from scratch would discard the entire run's
+    progress)."""
+    template = _trainer_state(trainer, 0)
+    if step is not None:
+        state = mgr.restore(step, template=template)
+        return None if state is None else _apply_state(trainer, state)
+    steps = mgr.all_steps()
+    if not steps:
         return None
-    trainer.params = state["params"]
-    if "opt_state" in state:
-        trainer._opt_state = state["opt_state"]
-    if "du_opt_state" in state:
-        trainer._du_opt_state = state["du_opt_state"]
-    return int(state["step"])
+    mgr._flush_manifests()  # checksum anything committed-but-unverified
+    for s in sorted(steps, reverse=True):
+        verdict = mgr.verify(s)
+        if verdict is False:
+            log_warning(
+                "checkpoint step %d fails checksum verification; falling back", s
+            )
+            continue
+        try:
+            state = mgr.restore(s, template=template)
+        except Exception as e:
+            log_warning(
+                "restore of checkpoint step %d failed (%s: %s); falling back",
+                s, type(e).__name__, e,
+            )
+            continue
+        if state is None:
+            continue
+        if s != steps[-1]:
+            log_info("restored fallback step %d (latest step %d unusable)",
+                     s, steps[-1])
+        return _apply_state(trainer, state)
+    raise MLSLError(
+        f"no restorable checkpoint in {mgr.directory}: all {len(steps)} steps "
+        "are corrupt or unreadable"
+    )

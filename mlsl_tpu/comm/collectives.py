@@ -621,6 +621,34 @@ def clear_cache() -> None:
     _cache.clear()
 
 
+class _ChaosDispatch:
+    """Wraps a compiled collective so every invocation passes the
+    'collective.dispatch' chaos site (one armed-check when idle — the
+    injection point for hangs/faults at the XLA launch layer, which the
+    request watchdog and FaultTolerantLoop must survive). Attribute access
+    (lower/compile/...) delegates to the underlying jitted fn."""
+
+    __slots__ = ("_fn", "_kind")
+
+    def __init__(self, fn: Callable, kind: str):
+        self._fn = fn
+        self._kind = kind
+
+    def __call__(self, *bufs):
+        from mlsl_tpu import chaos
+
+        if chaos._plans:
+            chaos.inject("collective.dispatch", kind=self._kind)
+        return self._fn(*bufs)
+
+    def __getattr__(self, name):
+        return getattr(self._fn, name)
+
+
+def _chaos_dispatch(fn: Callable, kind: str) -> Callable:
+    return _ChaosDispatch(fn, kind)
+
+
 def _group_key(group: ProcessGroup):
     # Stable identity: mesh shape + device ids (NOT id(mesh) — a GC'd mesh's address
     # can be reused by a different mesh, which would alias cache entries).
@@ -671,9 +699,12 @@ def build_collective(kind: str, group: ProcessGroup, dtype, **kw) -> Callable:
 
     elif group.colors is not None:
         if group.is_uniform:
-            fn = _build_flat(
-                _make_subgroup_body(kind, _color_groups_tbl(group), **kw),
-                topo, kind, "color",
+            fn = _chaos_dispatch(
+                _build_flat(
+                    _make_subgroup_body(kind, _color_groups_tbl(group), **kw),
+                    topo, kind, "color",
+                ),
+                kind,
             )
             _cache[key] = fn
             return fn
@@ -686,9 +717,12 @@ def build_collective(kind: str, group: ProcessGroup, dtype, **kw) -> Callable:
         # multi-axis groups have no single named axis for the native op; compile
         # against the flat world mesh with explicit subgroup rows instead of the
         # O(G*n) gather+select emulation
-        fn = _build_flat(
-            _make_subgroup_body(kind, _axis_groups_tbl(group), **kw),
-            topo, kind, group.axes,
+        fn = _chaos_dispatch(
+            _build_flat(
+                _make_subgroup_body(kind, _axis_groups_tbl(group), **kw),
+                topo, kind, group.axes,
+            ),
+            kind,
         )
         _cache[key] = fn
         return fn
@@ -704,7 +738,7 @@ def build_collective(kind: str, group: ProcessGroup, dtype, **kw) -> Callable:
         return out[None, None, None, None]
 
     sm = _shard_map(local_fn, mesh=mesh, in_specs=_BUF_SPEC, out_specs=_BUF_SPEC)
-    fn = jax.jit(sm)
+    fn = _chaos_dispatch(jax.jit(sm), kind)
     _cache[key] = fn
     return fn
 
@@ -779,6 +813,6 @@ def build_barrier(group: ProcessGroup) -> Callable:
             in_specs=_BUF_SPEC,
             out_specs=_BUF_SPEC,
         )
-        fn = jax.jit(sm)
+        fn = _chaos_dispatch(jax.jit(sm), "barrier")
         _cache[key] = fn
     return fn

@@ -196,6 +196,22 @@ def build_quantized_collective(
 
     from mlsl_tpu.comm.collectives import build_stateful_collective
 
-    fn = build_stateful_collective(body, mesh)
+    fn = _chaos_roundtrip(build_stateful_collective(body, mesh))
     _cache[key] = fn
     return fn, err_len
+
+
+def _chaos_roundtrip(fn: Callable) -> Callable:
+    """Wrap the compiled ring so every (buf, err) round-trip passes the
+    'codec.roundtrip' chaos site — faults at the compressed-wire layer must be
+    recoverable (EQuARX/THC pair compressed collectives with correctness
+    safeguards; ours is the tested recovery path)."""
+    from mlsl_tpu import chaos
+
+    def roundtrip(buf, err):
+        if chaos._plans:
+            chaos.inject("codec.roundtrip")
+        return fn(buf, err)
+
+    roundtrip.__wrapped__ = fn
+    return roundtrip

@@ -21,15 +21,23 @@ from __future__ import annotations
 import dataclasses
 import enum
 import threading
+import time
 from typing import Callable, List, Optional
 
 import numpy as np
 import jax
 import jax.numpy as jnp
 
+from mlsl_tpu import chaos
 from mlsl_tpu.comm.mesh import NUM_GRID_AXES, ProcessGroup
+from mlsl_tpu.log import (
+    MLSLTimeoutError,
+    mlsl_assert,
+    log_debug,
+    log_error,
+    log_warning,
+)
 from mlsl_tpu.comm import collectives
-from mlsl_tpu.log import mlsl_assert, log_debug, log_error
 from mlsl_tpu.types import (
     CompressionType,
     DataType,
@@ -107,6 +115,8 @@ class CommRequest:
         # dispatch floor low — no per-dispatch string building / re-derivation)
         self._trace_name = f"mlsl:{desc.kind}:{name or self.uid}"
         self._payload = desc.payload_bytes()
+        # watchdog stamp: monotonic Start time of the current in-flight epoch
+        self._started_at: Optional[float] = None
 
     # -- setup ------------------------------------------------------------
 
@@ -237,6 +247,9 @@ class CommRequest:
 
     def start(self, buf: jax.Array) -> "CommRequest":
         mlsl_assert(self.is_setup, "request must be setup() before start()")
+        if chaos._plans:
+            chaos.inject("request.start", request=self.name or self.uid,
+                         kind=self.desc.kind)
         from mlsl_tpu import checker  # module cached after first call
 
         chkp = checker.level()
@@ -252,6 +265,7 @@ class CommRequest:
             self._result = None
             self._dispatch_error = None
             self.is_started = True
+            self._started_at = time.monotonic()  # watchdog stamp
         self.dispatcher.submit(self, buf)
         return self
 
@@ -330,20 +344,73 @@ class CommRequest:
                 self._result = jnp.concatenate(self._results, axis=-1)
         return self._result
 
-    def wait(self) -> jax.Array:
+    # -- watchdog ---------------------------------------------------------
+
+    def _watchdog_deadline(self, timeout: Optional[float]) -> Optional[float]:
+        """Absolute deadline for this wait, measured from the Start stamp (the
+        watchdog bounds total in-flight time, not time inside wait())."""
+        t = timeout
+        if t is None:
+            t = getattr(self.dispatcher.config, "watchdog_timeout_s", 0.0)
+        if not t or t <= 0:
+            return None
+        return (self._started_at or time.monotonic()) + t
+
+    def describe(self) -> str:
+        """One-line stuck-request descriptor for the watchdog log."""
+        d = self.desc
+        return (
+            f"{d.kind} name={self.name or self.uid} count={d.count} "
+            f"dtype={d.data_type.name} axes={d.group.axes} "
+            f"payload={self._payload}B epoch={self._epoch}"
+        )
+
+    def _watchdog_trip(self, phase: str) -> None:
+        """Log the stuck descriptor (core/stats.py keeps the event record) and
+        raise the recoverable timeout."""
+        waited = time.monotonic() - (self._started_at or time.monotonic())
+        desc = self.describe()
+        from mlsl_tpu.core import stats as stats_mod
+
+        stats_mod.record_watchdog_event(desc, phase, waited)
+        raise MLSLTimeoutError(
+            f"watchdog: request stuck in {phase} for {waited:.2f}s: {desc}"
+        )
+
+    def _block_ready(self, out: jax.Array, deadline: Optional[float]) -> None:
+        if deadline is None:
+            jax.block_until_ready(out)
+            return
+        # exponential-backoff poll: fast completions (the common case) pay
+        # ~10 µs over plain block_until_ready, a genuine hang converges to
+        # 1 ms polls until the deadline trips
+        delay = 1e-5
+        while not _array_is_ready(out):
+            if time.monotonic() > deadline:
+                self._watchdog_trip("wait")
+            time.sleep(delay)
+            delay = min(delay * 2, 1e-3)
+
+    # -- wait/test --------------------------------------------------------
+
+    def wait(self, timeout: Optional[float] = None) -> jax.Array:
         # A completed request can be wait()ed any number of times, whether it
         # completed via wait() or test() (MPI semantics: MPI_Wait on a completed
         # request returns immediately).
         if not self.is_started and self._result is not None:
             return self._result
         mlsl_assert(self.is_started, "request was not started")
-        self.dispatcher.wait_dispatched(self)
+        if chaos._plans:
+            chaos.inject("request.wait", request=self.name or self.uid,
+                         kind=self.desc.kind)
+        deadline = self._watchdog_deadline(timeout)
+        self.dispatcher.wait_dispatched(self, deadline)
         if self._dispatch_error is not None:
             err, self._dispatch_error = self._dispatch_error, None
             self.is_started = False
             raise err
         out = self._assemble()
-        jax.block_until_ready(out)
+        self._block_ready(out, deadline)
         self.is_started = False
         return out
 
@@ -351,6 +418,9 @@ class CommRequest:
         """Non-blocking completion poll -> (is_completed, result_or_None)."""
         if not self.is_started:
             return True, self._result
+        if chaos._plans:
+            chaos.inject("request.test", request=self.name or self.uid,
+                         kind=self.desc.kind)
         self.dispatcher.flush()
         if self._dispatch_error is not None:
             err, self._dispatch_error = self._dispatch_error, None
@@ -620,6 +690,16 @@ class Dispatcher:
             self._cv.notify_all()
         if self._thread is not None:
             self._thread.join(timeout=5)
+            if self._thread.is_alive():
+                # A still-alive progress thread means a dispatch is wedged (or
+                # a chaos hang is armed) — abandoning it silently would make
+                # the eventual symptom undiagnosable.
+                log_warning(
+                    "dispatch progress thread %s still alive after 5s join "
+                    "(%d deferred requests pending); abandoning it",
+                    self._thread.name,
+                    self.pending_count,
+                )
             self._thread = None
 
     def flush(self) -> None:
@@ -676,16 +756,26 @@ class Dispatcher:
         # completes (per-poll lock acquisition would dominate the test() floor)
         return uid in self._in_flight
 
-    def wait_dispatched(self, req: CommRequest) -> None:
+    def wait_dispatched(
+        self, req: CommRequest, deadline: Optional[float] = None
+    ) -> None:
         """Ensure req's programs have been launched: flush the queue, then wait out
         a dispatch racing on the progress thread (its _results would otherwise be
-        read half-built)."""
+        read half-built). ``deadline`` (monotonic) is the request watchdog's
+        bound: a dispatch wedged on the progress thread past it trips the
+        recoverable MLSLTimeoutError instead of blocking forever."""
         self.flush()
         if req.uid not in self._in_flight:  # hot path: nothing racing
             return
         with self._cv:
             while req.uid in self._in_flight:
-                self._cv.wait()
+                if deadline is None:
+                    self._cv.wait()
+                    continue
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    req._watchdog_trip("dispatch")
+                self._cv.wait(min(remaining, 0.05))
 
     @property
     def pending_count(self) -> int:

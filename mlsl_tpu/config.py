@@ -91,6 +91,23 @@ class Config:
     # Environment.set_quantization_params; None = built-in Pallas int8 kernels
     custom_codec: object = None
 
+    # --- robustness tier (chaos layer + watchdog + checkpoint retry) ---
+    # Request watchdog: wait() on an async request raises MLSLTimeoutError
+    # (recoverable) once the request has been in flight longer than this,
+    # instead of blocking forever on a hung collective. 0 = off.
+    watchdog_timeout_s: float = 0.0   # MLSL_WATCHDOG_TIMEOUT (seconds)
+    # Checkpoint save retry on transient IO errors (OSError): attempts beyond
+    # the first, with exponential backoff starting at the base below. Recorded
+    # here for discoverability/printing only (like chaos_spec): CheckpointManager
+    # has no Config handle and reads the SAME env vars at construction —
+    # override programmatically via its save_retries/retry_backoff_s ctor args,
+    # not by mutating these fields.
+    ckpt_save_retries: int = 3          # MLSL_CKPT_SAVE_RETRIES
+    ckpt_retry_backoff_s: float = 0.05  # MLSL_CKPT_RETRY_BACKOFF_S
+    # Fault-injection spec; parsed by mlsl_tpu.chaos (site:kind[=v][@after][xN],
+    # comma-separated). Kept here for discoverability/printing only.
+    chaos_spec: str = ""            # MLSL_CHAOS
+
     # --- accepted-for-parity no-ops (MPI/shm specific) ---
     server_affinity: str = ""       # MLSL_SERVER_AFFINITY
     heap_size_gb: int = 0           # MLSL_HEAP_SIZE_GB
@@ -135,6 +152,12 @@ class Config:
         )
         c.quant_block_elems = _env_int("MLSL_QUANT_BLOCK_ELEMS", c.quant_block_elems)
         c.topk_ratio = _env_float("MLSL_TOPK_RATIO", c.topk_ratio)
+        c.watchdog_timeout_s = _env_float("MLSL_WATCHDOG_TIMEOUT", c.watchdog_timeout_s)
+        c.ckpt_save_retries = _env_int("MLSL_CKPT_SAVE_RETRIES", c.ckpt_save_retries)
+        c.ckpt_retry_backoff_s = _env_float(
+            "MLSL_CKPT_RETRY_BACKOFF_S", c.ckpt_retry_backoff_s
+        )
+        c.chaos_spec = os.environ.get("MLSL_CHAOS", c.chaos_spec)
         c.server_affinity = os.environ.get("MLSL_SERVER_AFFINITY", c.server_affinity)
         c.heap_size_gb = _env_int("MLSL_HEAP_SIZE_GB", c.heap_size_gb)
         c.alltoall_split = _env_int("MLSL_ALLTOALL_SPLIT", c.alltoall_split)

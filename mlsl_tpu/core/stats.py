@@ -20,17 +20,49 @@ src/mlsl_impl_stats.cpp):
 
 from __future__ import annotations
 
+import collections
 import time
-from typing import Dict, List, Optional, Tuple
+from typing import Deque, Dict, List, Optional, Tuple
 
 import numpy as np
 import jax
 
+from mlsl_tpu.log import log_warning
 from mlsl_tpu.types import dtype_size, jnp_dtype
 
 ISOLATION_ITERS = 10
 ISOLATION_SKIP = 4
 STATS_OUTPUT_FILE = "mlsl_stats.log"
+
+# Watchdog event record: every request the watchdog declared stuck, with its
+# descriptor and how long it had been in flight. Process-wide (the watchdog
+# fires from the request layer, which has no Session handle); bounded so a
+# recurrently flaky interconnect cannot grow memory across recoveries — the
+# full history lives in STATS_OUTPUT_FILE, appended per event below.
+WATCHDOG_EVENTS: Deque[dict] = collections.deque(maxlen=256)
+
+
+def record_watchdog_event(descriptor: str, phase: str, waited_s: float) -> None:
+    """Called by CommRequest._watchdog_trip just before it raises
+    MLSLTimeoutError."""
+    evt = {
+        "descriptor": descriptor,
+        "phase": phase,
+        "waited_s": waited_s,
+        "at": time.time(),
+    }
+    WATCHDOG_EVENTS.append(evt)
+    log_warning(
+        "watchdog: request stuck in %s for %.2fs: %s", phase, waited_s, descriptor
+    )
+    try:
+        with open(STATS_OUTPUT_FILE, "a") as f:
+            f.write(
+                f"{'WATCHDOG':<16} {phase:<8} waited {waited_s:>10.2f} s  "
+                f"{descriptor}\n"
+            )
+    except OSError:
+        pass
 
 
 class _Slot:

@@ -17,6 +17,9 @@ from typing import Callable, Iterator, Optional
 import numpy as np
 import jax
 
+from mlsl_tpu import chaos
+from mlsl_tpu.log import log_warning
+
 
 class AsyncLoader:
     """Wraps a host batch source with prefetch-to-device.
@@ -35,7 +38,10 @@ class AsyncLoader:
         self._stop = threading.Event()
         self._done = False
         self._exc: Optional[BaseException] = None
-        self._thread = threading.Thread(target=self._worker, daemon=True)
+        self._batches = 0  # descriptor for the join-timeout warning in close()
+        self._thread = threading.Thread(
+            target=self._worker, daemon=True, name=f"mlsl-prefetch-{id(self):x}"
+        )
         self._thread.start()
 
     def _next_host_batch(self):
@@ -46,11 +52,14 @@ class AsyncLoader:
     def _worker(self):
         try:
             while not self._stop.is_set():
+                if chaos._plans:
+                    chaos.inject("data.prefetch", batch=self._batches)
                 try:
                     host = self._next_host_batch()
                 except StopIteration:
                     self._q.put(_SENTINEL)
                     return
+                self._batches += 1
                 # device_put dispatches the transfer asynchronously; holding the
                 # resulting arrays in the queue keeps `depth` transfers in flight
                 dev = self._place(*host) if isinstance(host, tuple) else self._place(host)
@@ -85,6 +94,16 @@ class AsyncLoader:
         except queue.Empty:
             pass
         self._thread.join(timeout=5)
+        if self._thread.is_alive():
+            # The worker is wedged in the source or the device transfer —
+            # abandoning it silently would hide the leak until HBM or file
+            # handles run out.
+            log_warning(
+                "prefetch thread %s still alive after 5s join "
+                "(was serving batch %d); abandoning it",
+                self._thread.name,
+                self._batches,
+            )
 
 
 _SENTINEL = object()

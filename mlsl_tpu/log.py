@@ -30,6 +30,13 @@ class MLSLError(RuntimeError):
     """Raised on MLSL_ASSERT failure (reference aborts via _exit; we raise)."""
 
 
+class MLSLTimeoutError(MLSLError):
+    """Raised by the request watchdog when an async request exceeds
+    MLSL_WATCHDOG_TIMEOUT. Subclasses MLSLError (-> RuntimeError), so
+    FaultTolerantLoop treats a hung collective as recoverable: tear down,
+    rebuild, restore — instead of blocking forever."""
+
+
 def set_log_level(level: int | LogLevel) -> None:
     global _level
     _level = LogLevel(int(level))
@@ -39,23 +46,31 @@ def get_log_level() -> LogLevel:
     return _level
 
 
-def _emit(level: LogLevel, msg: str, *args) -> None:
+def _emit(level: LogLevel, msg: str, *args, label: str | None = None) -> None:
     if level > _level:
         return
     frame = sys._getframe(2)  # cheap caller lookup; inspect.stack() walks everything
     text = msg % args if args else msg
     ts = time.strftime("%H:%M:%S", time.localtime())
     print(
-        f"[{ts}] mlsl_tpu {level.name} {frame.f_code.co_name}:{frame.f_lineno} {text}",
+        f"[{ts}] mlsl_tpu {label or level.name} "
+        f"{frame.f_code.co_name}:{frame.f_lineno} {text}",
         file=sys.stderr,
         flush=True,
     )
-    if level == LogLevel.ERROR:
+    if level == LogLevel.ERROR and label is None:
         traceback.print_stack(file=sys.stderr)
 
 
 def log_error(msg: str, *args) -> None:
     _emit(LogLevel.ERROR, msg, *args)
+
+
+def log_warning(msg: str, *args) -> None:
+    """Always surfaces (gated like ERROR) but without the backtrace dump —
+    for suppressed-but-diagnosable conditions (teardown failures during
+    recovery, threads outliving their join timeout, checkpoint fallbacks)."""
+    _emit(LogLevel.ERROR, msg, *args, label="WARNING")
 
 
 def log_info(msg: str, *args) -> None:

@@ -12,18 +12,62 @@ reference never had.
 
 from __future__ import annotations
 
-import time
+import contextlib
+import signal
+import threading
 from typing import Callable, Optional
 
 import jax
 
 from mlsl_tpu.checkpoint import CheckpointManager, restore_trainer, save_trainer
-from mlsl_tpu.log import MLSLError, log_info
+from mlsl_tpu.log import MLSLError, log_info, log_warning
 
 
 # MLSLError subclasses RuntimeError; ValueError is deliberately NOT recoverable
-# (caller bugs should surface, not trigger teardown/rebuild cycles)
+# (caller bugs should surface, not trigger teardown/rebuild cycles).
+# MLSLTimeoutError (the request watchdog) is RuntimeError too: a hung
+# collective tears down and resumes like any other device fault.
 RECOVERABLE = (RuntimeError,)
+
+_NULL_GUARD = contextlib.nullcontext()
+
+
+class PreemptionGuard:
+    """SIGTERM -> graceful drain: the handler only sets a flag; the training
+    loop checks it between steps, drains in-flight async saves, writes a final
+    checkpoint, and returns — the TPU-pod preemption contract (the reference's
+    signal handlers just killed the endpoint servers, SURVEY §5.3).
+
+    Installed only on the main thread (CPython restricts signal.signal);
+    elsewhere it degrades to an inert flag the embedder can set directly."""
+
+    SIGNALS = (signal.SIGTERM,)
+
+    def __init__(self):
+        self.triggered = False
+        self._old: dict = {}
+        self._installed = False
+
+    def __enter__(self) -> "PreemptionGuard":
+        if threading.current_thread() is threading.main_thread():
+            for s in self.SIGNALS:
+                self._old[s] = signal.signal(s, self._on_signal)
+            self._installed = True
+        return self
+
+    def _on_signal(self, signum, frame) -> None:
+        # async-signal context: flag only, no IO beyond the (line-buffered) log
+        self.triggered = True
+        log_warning(
+            "received signal %d: draining saves and checkpointing before exit",
+            signum,
+        )
+
+    def __exit__(self, *exc) -> None:
+        if self._installed:
+            for s, h in self._old.items():
+                signal.signal(s, h)
+            self._installed = False
 
 
 class FaultTolerantLoop:
@@ -41,7 +85,12 @@ class FaultTolerantLoop:
     on_step fires exactly once per step: replayed steps below the furthest
         reported step are recomputed silently.
     fault_hook(step, attempt): optional test hook, called before each step attempt;
-        raise from it to inject a fault.
+        raise from it to inject a fault (the chaos layer, mlsl_tpu.chaos, injects
+        at specific sites INSIDE the stack instead — use it for layer faults).
+    handle_preemption: install a SIGTERM handler for the duration of run()
+        (main thread only): on signal the loop finishes the current step,
+        drains in-flight saves, writes a final checkpoint, and returns early
+        with ``self.preempted`` set.
     """
 
     def __init__(
@@ -52,6 +101,7 @@ class FaultTolerantLoop:
         max_retries: int = 2,
         max_total_recoveries: int = 20,
         fault_hook: Optional[Callable] = None,
+        handle_preemption: bool = True,
     ):
         self.make_trainer = make_trainer
         self.ckpt = CheckpointManager(ckpt_dir)
@@ -62,6 +112,8 @@ class FaultTolerantLoop:
         # cap the loop would recover/replay forever
         self.max_total_recoveries = max_total_recoveries
         self.fault_hook = fault_hook
+        self.handle_preemption = handle_preemption
+        self.preempted = False
         self.recoveries = 0
 
     def _recover(self, trainer, error) -> tuple:
@@ -73,20 +125,35 @@ class FaultTolerantLoop:
         # the resume point
         try:
             self.ckpt.wait()
-        except Exception:
-            pass
+        except Exception as e:
+            # suppressed (the restore below decides what is usable) but logged:
+            # an invisible drain failure makes the eventual double-fault
+            # undiagnosable
+            log_warning(
+                "checkpoint drain during recovery failed: %s: %s",
+                type(e).__name__, e,
+            )
         from mlsl_tpu.core.environment import Environment
 
         try:
             Environment.get_env().finalize()
-        except Exception:
-            pass
+        except Exception as e:
+            # teardown of an already-faulted environment may fail; continue to
+            # the rebuild, but keep the evidence
+            log_warning(
+                "environment teardown during recovery failed "
+                "(continuing with rebuild): %s: %s",
+                type(e).__name__, e,
+            )
         trainer = self.make_trainer()
         restored = restore_trainer(self.ckpt, trainer)
         return trainer, (restored + 1 if restored is not None else 0)
 
     def run(self, batch_fn: Callable, steps: int, on_step: Optional[Callable] = None):
-        """Train for ``steps`` steps; returns the final trainer."""
+        """Train for ``steps`` steps; returns the final trainer.
+
+        Returns early (with ``self.preempted`` set and a final checkpoint on
+        disk) when a handled preemption signal arrives mid-run."""
         trainer = self.make_trainer()
         restored = restore_trainer(self.ckpt, trainer)
         step = restored + 1 if restored is not None else 0
@@ -96,33 +163,62 @@ class FaultTolerantLoop:
         failed_step = None
         attempts = 0
         reported = step - 1  # on_step fires once per step, replays stay silent
-        while step < steps:
-            try:
-                if self.fault_hook is not None:
-                    self.fault_hook(
-                        step, attempts if step == failed_step else 0
-                    )
-                loss = trainer.step(batch_fn(trainer, step))
-                jax.block_until_ready(trainer.params)
-                if step % self.save_every == 0:
-                    # inside the try: a device fault surfacing during the save's
-                    # device read must take the recovery path too
-                    save_trainer(self.ckpt, trainer, step=step)
-            except RECOVERABLE as e:
-                if step == failed_step:
-                    attempts += 1
-                else:
-                    failed_step, attempts = step, 1
-                if (
-                    attempts > self.max_retries
-                    or self.recoveries >= self.max_total_recoveries
-                ):
-                    raise
-                trainer, step = self._recover(trainer, e)
-                continue
-            if on_step is not None and step > reported:
-                on_step(step, loss)
-                reported = step
-            step += 1
+        last_saved = restored
+        self.preempted = False
+        guard = PreemptionGuard() if self.handle_preemption else None
+        with guard if guard is not None else _NULL_GUARD:
+            while step < steps:
+                try:
+                    if self.fault_hook is not None:
+                        self.fault_hook(
+                            step, attempts if step == failed_step else 0
+                        )
+                    loss = trainer.step(batch_fn(trainer, step))
+                    jax.block_until_ready(trainer.params)
+                    if step % self.save_every == 0:
+                        # inside the try: a device fault surfacing during the save's
+                        # device read must take the recovery path too
+                        save_trainer(self.ckpt, trainer, step=step)
+                        last_saved = step
+                except RECOVERABLE as e:
+                    if step == failed_step:
+                        attempts += 1
+                    else:
+                        failed_step, attempts = step, 1
+                    if (
+                        attempts > self.max_retries
+                        or self.recoveries >= self.max_total_recoveries
+                    ):
+                        raise
+                    trainer, step = self._recover(trainer, e)
+                    last_saved = step - 1 if step > 0 else None
+                    continue
+                if on_step is not None and step > reported:
+                    on_step(step, loss)
+                    reported = step
+                if guard is not None and guard.triggered:
+                    # drain in-flight saves and leave a final resume point; a
+                    # failure here must not abort the graceful exit — the last
+                    # cadence checkpoint remains the resume point
+                    self.preempted = True
+                    try:
+                        if last_saved != step:
+                            log_info(
+                                "preemption: writing final checkpoint at step %d",
+                                step,
+                            )
+                            save_trainer(self.ckpt, trainer, step=step, wait=True)
+                        self.ckpt.wait()
+                        log_info(
+                            "preemption drain complete; stopping at step %d", step
+                        )
+                    except Exception as e:
+                        log_warning(
+                            "preemption drain failed (%s: %s); resume point is "
+                            "the last committed checkpoint",
+                            type(e).__name__, e,
+                        )
+                    break
+                step += 1
         self.ckpt.wait()
         return trainer

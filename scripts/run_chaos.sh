@@ -1,0 +1,10 @@
+#!/usr/bin/env bash
+# Run the fault-injection / recovery suite (pytest -m chaos) standalone, so CI
+# can wire it as its own job separately from tier-1. The suite covers the full
+# fault matrix: an injected fault at every registered chaos site recovered by
+# FaultTolerantLoop, corrupt-checkpoint fallback, watchdog hang detection,
+# save retry, and SIGTERM drain (tests/test_chaos.py).
+set -euo pipefail
+cd "$(dirname "$0")/.."
+exec env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m chaos \
+    -p no:cacheprovider "$@"
