@@ -256,9 +256,12 @@ def main():
     # Overlap quantification (the point of the async Start/Wait engine —
     # reference eplib newest-first allreduce, eplib/allreduce_pr.c:76-79):
     # isolation-replay each grad collective, then account a few UN-TIMED steps
-    # and report the fraction of pure-comm time hidden behind compute. None on
-    # a single device (groups degenerate, no comm to overlap).
-    overlap = None
+    # and report the fraction of pure-comm time hidden behind compute. On a
+    # single attached chip the gradient group is degenerate (no comm at all,
+    # previously emitted null), so the per-layer overlap trajectory is instead
+    # tracked on the 8-device CPU proof mesh in a subprocess — same per-layer
+    # Start/Test engine, tagged with overlap_backend so rows stay comparable.
+    overlap = overlap_backend = None
     try:
         st = sess_pl.get_stats()
         if not st._isolation_slot_ns:  # MLSL_STATS=1 already replayed at commit
@@ -270,9 +273,13 @@ def main():
         _sync(trainer_pl.params)
         st.stop()
         overlap = st.get_overlap_fraction()
+        if overlap is not None:
+            overlap_backend = "device"
         st.print_()
     except Exception as e:
         print(f"bench: overlap report skipped ({e})", file=sys.stderr)
+    if overlap is None:
+        overlap, overlap_backend = _overlap_probe_cpu_mesh()
 
     # Achieved TFLOP/s and MFU for the framework step. FLOPs come from XLA's own
     # cost model on the compiled baseline step (identical math to the framework
@@ -315,6 +322,7 @@ def main():
         "per_layer_ms": round(pl_ms, 3),
         "per_layer_vs_fused": round(fw_ms / pl_ms, 4),
         "overlap_fraction": round(overlap, 4) if overlap is not None else None,
+        "overlap_backend": overlap_backend,
         "batch": batch,
         "pipeline_step_ms": round(pipe_ms, 3) if pipe_ms is not None else None,
         "images_per_s": round(batch / (pipe_ms / 1e3)) if pipe_ms else None,
@@ -332,6 +340,85 @@ def main():
     print(json.dumps(result))
     if not args.quick:  # --quick CPU runs are smoke tests, not evidence
         _persist_measurement(result)
+
+
+_OVERLAP_PROBE_SRC = """\
+import jax
+jax.config.update("jax_platforms", "cpu")
+import numpy as np
+import mlsl_tpu as mlsl
+from mlsl_tpu.models.mlp import LAYERS, get_layer, init, loss_fn
+from mlsl_tpu.models.train import DataParallelTrainer
+env = mlsl.Environment.get_env().init()
+dist = env.create_distribution(8, 1)
+sess = env.create_session()
+sess.set_global_minibatch_size(32)
+t = DataParallelTrainer(env, dist, sess, init(jax.random.PRNGKey(0)), loss_fn,
+                        LAYERS, get_layer, lr=0.1, force_graph_path=True,
+                        overlap_updates=True)
+rng = np.random.default_rng(0)
+x = rng.normal(size=(32, 8)).astype(np.float32)
+y = rng.integers(0, 4, size=(32,)).astype(np.int32)
+b = t.shard_batch(x, y)
+st = sess.get_stats()
+for _ in range(5):
+    t.step(b)
+fracs = []
+for _ in range(5):
+    st.collect_isolation_stats()  # contemporaneous replay: load drift on the
+    st.reset()                    # shared box must hit both sides of the ratio
+    st.start()
+    for _ in range(8):
+        t.step(b)
+    st.stop()
+    f = st.get_overlap_fraction()
+    if f is not None:
+        fracs.append(f)
+# best-of-trials: the schedule's demonstrated hiding capability — one load
+# spike zeroes a trial (exposed > iso), the same reason bench.py reports
+# fw_best/tflops_best alongside medians (TUNING.md section 0)
+import json
+print("OVERLAP=" + json.dumps(max(fracs) if fracs else None))
+"""
+
+
+def _overlap_probe_cpu_mesh(timeout: float = 600.0):
+    """-> (overlap_fraction or None, backend tag). The per-layer comm/compute
+    overlap measured on the 8-device CPU proof mesh in a subprocess, via the
+    test-driven per-layer loop (overlap_updates: each layer's update runs the
+    moment its collective lands — the schedule the reference's canonical loop
+    uses, mlsl_test.cpp:660-698). Keeps the overlap trajectory tracked in
+    BENCH_MEASURED.json even when the attached accelerator is one chip."""
+    import subprocess
+
+    env_vars = dict(
+        os.environ,
+        JAX_PLATFORMS="cpu",
+        MLSL_TPU_PLATFORM="cpu",
+        XLA_FLAGS=(os.environ.get("XLA_FLAGS", "")
+                   + " --xla_force_host_platform_device_count=8").strip(),
+    )
+    # fault-injection/watchdog config armed for the CHIP run must not leak
+    # into the probe's training loop (an armed hang would wedge it to timeout)
+    env_vars.pop("MLSL_CHAOS", None)
+    env_vars.pop("MLSL_WATCHDOG_TIMEOUT", None)
+    try:
+        out = subprocess.run(
+            [sys.executable, "-c", _OVERLAP_PROBE_SRC],
+            capture_output=True, text=True, timeout=timeout, env=env_vars,
+            cwd=os.path.dirname(os.path.abspath(__file__)),
+        )
+        for line in out.stdout.splitlines():
+            if line.startswith("OVERLAP="):
+                v = json.loads(line[len("OVERLAP="):])
+                if v is not None:
+                    return float(v), "cpu-mesh-proof"
+        tail = (out.stderr or "").strip().splitlines()
+        print("bench: cpu overlap probe produced no number"
+              + (f" ({tail[-1]})" if tail else ""), file=sys.stderr)
+    except Exception as e:
+        print(f"bench: cpu overlap probe failed ({e})", file=sys.stderr)
+    return None, None
 
 
 def _is_oom(e: BaseException) -> bool:

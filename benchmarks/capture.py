@@ -112,6 +112,8 @@ def main():
              [sys.executable, "benchmarks/allreduce_curve.py", "--quant"], 2400),
             ("bucketing",
              [sys.executable, "benchmarks/bucketing_bench.py"], 1200),
+            ("quant_bucket",
+             [sys.executable, "benchmarks/quant_bucket_bench.py"], 1800),
             ("grid_collectives",
              [sys.executable, "benchmarks/grid_collectives.py"], 1200),
             ("transformer",

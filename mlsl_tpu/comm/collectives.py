@@ -616,9 +616,19 @@ _AXIS_BODIES = {
 
 _cache: dict = {}
 
+# AOT plan cache (Session.precompile_collectives, MLSL_PRECOMPILE): records
+# which collective programs were already warm-executed at commit, keyed by the
+# same (kind, group key, dtype/count, compression) identity the program caches
+# use, so a second session over the same graph shapes skips the replay. Must
+# clear together with _cache: a cleared program cache means fresh jitted fns
+# whose dispatch caches are cold again, so a stale plan entry would silently
+# skip re-warming them — any caller of clear_cache() gets both or neither.
+_plan_cache: dict = {}
+
 
 def clear_cache() -> None:
     _cache.clear()
+    _plan_cache.clear()
 
 
 class _ChaosDispatch:
@@ -640,6 +650,12 @@ class _ChaosDispatch:
         if chaos._plans:
             chaos.inject("collective.dispatch", kind=self._kind)
         return self._fn(*bufs)
+
+    @property
+    def _mlsl_inner(self):
+        """The wrapped jit fn, for the precompile warm (request._unwrap_chaos):
+        warming must not pass the chaos site."""
+        return self._fn
 
     def __getattr__(self, name):
         return getattr(self._fn, name)

@@ -68,6 +68,32 @@ def _chunk_unit(rc: int, use_pallas: bool, block: int) -> int:
     return block * qk.ROW_TILE
 
 
+def use_pallas_for(group: ProcessGroup, block: int) -> bool:
+    """Whether the ring's quantize hops take the Pallas kernel path on this
+    group's mesh (the same predicate build_quantized_collective applies)."""
+    return (
+        group.topology.mesh.devices.flat[0].platform == "tpu" and block % 128 == 0
+    )
+
+
+def ring_aligned_rc(group: ProcessGroup, rc: int, block: int) -> int:
+    """Per-rank ring slice length >= ``rc`` aligned to the chunk unit.
+
+    Coalesced quantized payloads (core/bucketing.py) size the bucket so each
+    rank's slice already sits on the ``_chunk_unit`` boundary: the ring then
+    adds zero internal padding and — on the pallas path — every per-hop
+    quantize sees a row count that engages the packed-scale kernels (dense
+    (g, 128) scales, the fast path; see ops/quant_kernels.py). Aligning can
+    push ``rc`` across the coarse-unit threshold, so iterate to the fixpoint
+    (units are nested multiples: block | block*ROW_TILE | block*PACK_ROWS —
+    one extra pass suffices)."""
+    use_pallas = use_pallas_for(group, block)
+    for _ in range(2):
+        unit = _chunk_unit(rc, use_pallas, block)
+        rc = -(-rc // unit) * unit
+    return rc
+
+
 def _to_chunks(x, G, rc, chunk):
     """(n_orig,) -> (G, chunk): slice j of the logical partition (length rc) sits at
     the START of padded chunk j, so ring chunk ownership == MPI slice placement."""
@@ -147,7 +173,7 @@ def build_quantized_collective(
     sizes = _axis_sizes(mesh)
     g = 1 if group.is_self else group.size
     mlsl_assert(group.colors is None, "quantized collectives require axis-aligned groups")
-    use_pallas = mesh.devices.flat[0].platform == "tpu" and block % 128 == 0
+    use_pallas = use_pallas_for(group, block)
 
     # Per-rank logical slice rc, padded to the block/tile unit -> ring chunk.
     if kind == "reduce_scatter":
@@ -214,4 +240,7 @@ def _chaos_roundtrip(fn: Callable) -> Callable:
         return fn(buf, err)
 
     roundtrip.__wrapped__ = fn
+    # precompile warm bypass (request._unwrap_chaos): warming at Commit must
+    # not consume armed fault budgets at this site
+    roundtrip._mlsl_inner = fn
     return roundtrip

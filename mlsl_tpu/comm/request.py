@@ -228,6 +228,58 @@ class CommRequest:
         )
         self.is_setup = True
 
+    def precompile(self) -> int:
+        """Run every compiled program once on zero buffers so the jit caches
+        are hot before the first timed step (Session.precompile_collectives /
+        MLSL_PRECOMPILE). A warm CALL is required — jax's AOT
+        lower().compile() does not populate the dispatch cache the normal
+        call path consults, so only execution removes the step-0 stall (the
+        isolation replay relies on the same fact). Request round state
+        (_results / is_started / the error-feedback buffers) is untouched: a
+        never-started request must not look completed afterwards, and a zero
+        warm must not perturb _err. Returns the number of programs run."""
+        mlsl_assert(self.is_setup, "request must be setup() before precompile()")
+        d = self.desc
+        topo = d.group.topology
+        buf = topo.shard_buffer(
+            np.zeros((*topo.grid_shape, max(d.count, 1)), dtype=jnp_dtype(d.data_type))
+        )
+
+        def zero_err(el):
+            return topo.shard_buffer(
+                np.zeros((*topo.grid_shape, el), dtype=np.float32)
+            )
+
+        n = 0
+        seen: set = set()  # chunked requests repeat one program across
+        # same-length chunks ([fn]*k, shared quant fns) — warm each distinct
+        # (program, chunk length) once, not once per chunk
+
+        def warm(fn, sl, *err):
+            nonlocal n
+            inner = _unwrap_chaos(fn)
+            key = (id(inner), sl.stop - sl.start if sl.stop is not None else None)
+            if key in seen:
+                return
+            seen.add(key)
+            arg = buf if sl == slice(None) else buf[..., sl]
+            jax.block_until_ready(inner(arg, *err))
+            n += 1
+
+        if self._quant_fns is not None:
+            for fn, sl, el in zip(
+                self._quant_fns, self._chunk_slices, self._err_lens
+            ):
+                warm(fn, sl, zero_err(el))
+        elif self._quant_fn is not None:
+            warm(self._quant_fn, slice(None), zero_err(self._err_len))
+        elif self._single_full:
+            warm(self._fns[0], slice(None))
+        else:
+            for fn, sl in zip(self._fns, self._chunk_slices):
+                warm(fn, sl)
+        return n
+
     def _plan_chunks(self, compressed_ok: bool = False):
         """Chunk only elementwise-decomposable hot collectives (allreduce)."""
         d = self.desc
@@ -437,6 +489,18 @@ class CommRequest:
             self.is_started = False
             return True, out
         return False, None
+
+
+def _unwrap_chaos(fn):
+    """The compiled program beneath the chaos instrumentation (the wrappers'
+    ``_mlsl_inner`` — the same jit object the dispatch path calls, so the
+    warm hits the same cache; NOT ``__wrapped__``, which on a bare jitted fn
+    is the raw un-jitted Python callable). The precompile warm must NOT pass
+    the chaos sites: it would spend one-shot fault budgets (and shift
+    '@after N' schedules) inside Commit instead of the training step those
+    faults target, and a 'hang' would wedge Commit where no watchdog is
+    armed."""
+    return getattr(fn, "_mlsl_inner", fn)
 
 
 def _check_recv_count(d: CommDesc) -> None:

@@ -114,6 +114,13 @@ class Config:
     alltoall_split: int = 1         # MLSL_ALLTOALL_SPLIT
     thp_threshold_mb: int = 0       # MLSL_THP_THRESHOLD_MB
 
+    # Commit-time AOT precompilation (comm: Session.precompile_collectives):
+    # warm-execute every collective program the committed graph can dispatch —
+    # plain, bucketed, and quant-ring — on zero buffers at Commit, so step 0
+    # of the training loop contains no collective compilation. Composes with
+    # compile_cache_dir below (the warm run itself reloads from disk).
+    precompile: bool = False        # MLSL_PRECOMPILE
+
     # Persistent XLA compilation cache (TPU-native: Session::Commit pre-lowers
     # every per-edge collective, and on real chips each first compile costs
     # tens of seconds — a warm cache makes restarts near-instant; the
@@ -158,6 +165,7 @@ class Config:
             "MLSL_CKPT_RETRY_BACKOFF_S", c.ckpt_retry_backoff_s
         )
         c.chaos_spec = os.environ.get("MLSL_CHAOS", c.chaos_spec)
+        c.precompile = _env_bool("MLSL_PRECOMPILE", c.precompile)
         c.server_affinity = os.environ.get("MLSL_SERVER_AFFINITY", c.server_affinity)
         c.heap_size_gb = _env_int("MLSL_HEAP_SIZE_GB", c.heap_size_gb)
         c.alltoall_split = _env_int("MLSL_ALLTOALL_SPLIT", c.alltoall_split)

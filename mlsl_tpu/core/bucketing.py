@@ -7,13 +7,24 @@ parameter tensor, each paying a full host dispatch and wire latency — on the
 dispatch-floor numbers (README 'Host dispatch floor') a ResNet-50's ~160
 small tensors are launch-bound, not bandwidth-bound.
 
-Buckets pack eligible ParameterSets — same gradient group, same dtype, plain
-uncompressed allreduce path — into ``MLSL_GRAD_BUCKET_MB``-sized groups in
-REVERSE creation order (the backward-pass start order), at Session.commit.
-The last member to Start triggers ONE concatenated allreduce for the whole
-bucket; each member's Wait/Test slices its own segment from the bucket
-result. One dispatch + one wire latency amortized over the bucket, and the
-wire sees a bandwidth-sized message.
+Buckets pack eligible ParameterSets — same gradient group, same dtype, same
+compression — into ``MLSL_GRAD_BUCKET_MB``-sized groups in REVERSE creation
+order (the backward-pass start order), at Session.commit. The last member to
+Start triggers ONE concatenated collective for the whole bucket; each
+member's Wait/Test slices its own segment from the bucket result. One
+dispatch + one wire latency amortized over the bucket, and the wire sees a
+bandwidth-sized message.
+
+The compressed path coalesces too (EQuARX/THC both show quantized
+collectives only reach peak algbw at coalesced message sizes, where the
+per-block scale overhead amortizes): QUANTIZATION members pack into one int8
+ring reduce-scatter + all-gather over the whole bucket, with the per-member
+error-feedback residuals carried as slices of the bucket request's single
+residual buffer. Member slots align to the quant block (a block never
+straddles two members, so per-member scale locality matches the individual
+ring) and the total aligns to the ring chunk unit (every hop takes the
+dense-scale kernel path; quant_ring.ring_aligned_rc). TOPK stays individual
+— the sparse wire format has no coalesced form.
 
 Opportunistic by design: correctness never depends on co-arrival. Any
 pattern the bucket cannot serve exactly — a Wait/Test before the bucket
@@ -31,7 +42,8 @@ import jax
 import jax.numpy as jnp
 
 from mlsl_tpu.comm.request import CommDesc, CommRequest, ComputeType
-from mlsl_tpu.log import log_debug
+from mlsl_tpu.core import stats as stats_mod
+from mlsl_tpu.log import log_debug, mlsl_assert
 from mlsl_tpu.types import CompressionType, ReductionType
 
 
@@ -59,10 +71,15 @@ class GradBucket:
     round (counts as consumed) and runs individually.
     """
 
-    def __init__(self, members: List, env, kind: str = "allreduce"):
+    def __init__(self, members: List, env, kind: str = "allreduce",
+                 compression: CompressionType = CompressionType.NONE):
+        from mlsl_tpu.types import dtype_size
+
         # members in START order (reverse creation = backward pass order)
         self.members = members
         self.kind = kind
+        self.compression = CompressionType(compression)
+        quant = self.compression == CompressionType.QUANTIZATION
         # which ParameterSet round flag / fallback request this bucket drives
         self.round_attr = (
             "_inc_bucket_round" if kind == "allgather" else "_bucket_round"
@@ -71,22 +88,75 @@ class GradBucket:
         self._idx = {id(ps): i for i, ps in enumerate(members)}
         # owned elements per member (== local for the plain allreduce path)
         self.counts = [ps.owned_kernel_count * ps.kernel_size for ps in members]
-        self.offsets = [0]
-        for c in self.counts[:-1]:
-            self.offsets.append(self.offsets[-1] + c)
-        total = sum(self.counts)
         ps0 = members[0]
         group = ps0.dist.grad_group
         g = 1 if group.is_self else group.size
-        offsets, counts = self.offsets, self.counts
+        if quant:
+            mlsl_assert(
+                kind in ("allreduce", "reduce_scatter"),
+                "quantized buckets coalesce allreduce/reduce_scatter only "
+                "(got %s)", kind,
+            )
+            from mlsl_tpu.comm.quant_ring import ring_aligned_rc
+            from mlsl_tpu.ops.quant_kernels import block_align
+
+            block = env.config.quant_block_elems
+            # member slots align to the quant block (scale locality parity
+            # with the individual ring; padding quantizes to exact zeros) and
+            # the total aligns to the ring chunk unit so every hop takes the
+            # dense-scale kernel path with zero ring-internal padding
+            self.slots = [block_align(c, block) for c in self.counts]
+            total_slots = sum(self.slots)
+            if kind == "reduce_scatter":
+                total = ring_aligned_rc(group, total_slots, block)
+            else:
+                total = g * ring_aligned_rc(group, -(-total_slots // g), block)
+        else:
+            self.slots = list(self.counts)
+            total = sum(self.counts)
+        self.offsets = [0]
+        for s in self.slots[:-1]:
+            self.offsets.append(self.offsets[-1] + s)
+        # ring-alignment tail beyond the last member's slot (quant only)
+        tail = total - (self.offsets[-1] + self.slots[-1])
+        offsets, counts, slots = self.offsets, self.counts, self.slots
+        # stats: coalesced member payload bytes per dispatched round, and the
+        # wire bytes a quantized round saves vs the f32 wire (int8 payload +
+        # one f32 scale per block instead of f32 data; an estimate — the real
+        # wire repeats per hop, but the ratio is the tracked signal)
+        esize = dtype_size(ps0.data_type)
+        mult = g if kind == "reduce_scatter" else 1
+        self._coalesced_bytes = sum(self.counts) * esize * mult
+        n_wire = total * mult
+        self._wire_saved_bytes = (
+            max(0, n_wire * esize - (n_wire + (n_wire // env.config.quant_block_elems) * 4))
+            if quant else 0
+        )
         # jitted pack/unpack: EAGER concatenate/slice on sharded arrays pays
         # one full dispatch per op (~2 ms each on the CPU mesh); one compiled
         # program for the whole pack and one for the whole unpack keeps the
         # bucket's overhead below a single member's dispatch cost
         sl = lambda x, a, b: jax.lax.slice_in_dim(x, a, b, axis=x.ndim - 1)
-        # plain concat pack / offset-slice unpack are the defaults; each kind
-        # overrides only its genuinely different side
-        self._concat = jax.jit(lambda *xs: jnp.concatenate(xs, axis=-1))
+
+        def padded(x, c, s):
+            if s == c:
+                return x
+            return jnp.pad(x, [(0, 0)] * (x.ndim - 1) + [(0, s - c)])
+
+        def tail_zeros(x):
+            return jnp.zeros((*x.shape[:-1], tail), x.dtype)
+
+        # slot-padded concat pack / slot-offset-slice unpack are the defaults
+        # (identical to plain concat/slice when slots == counts and tail == 0,
+        # the uncompressed case); each kind overrides only its genuinely
+        # different side
+        def pack(*xs):
+            parts = [padded(x, c, s) for x, c, s in zip(xs, counts, slots)]
+            if tail:
+                parts.append(tail_zeros(xs[0]))
+            return jnp.concatenate(parts, axis=-1)
+
+        self._concat = jax.jit(pack)
         self._split = jax.jit(lambda x: tuple(
             sl(x, o, o + c) for o, c in zip(offsets, counts)
         ))
@@ -94,6 +164,7 @@ class GradBucket:
             desc = CommDesc(
                 "allreduce", group, total, ps0.data_type,
                 compute_type=ComputeType.PARAM_GRAD, op=ReductionType.SUM,
+                compression=self.compression,
             )
         elif kind == "reduce_scatter":
             # member m's buffer is G chunks of counts[m]; chunk r of the
@@ -103,12 +174,21 @@ class GradBucket:
                 "reduce_scatter", group, total * g, ps0.data_type,
                 compute_type=ComputeType.PARAM_GRAD, op=ReductionType.SUM,
                 recv_count=total,
+                compression=self.compression,
             )
-            self._concat = jax.jit(lambda *xs: jnp.concatenate(
-                [sl(x, r * c, (r + 1) * c)
-                 for r in range(g) for x, c in zip(xs, counts)],
-                axis=-1,
-            ))
+
+            def rs_pack(*xs):
+                parts = []
+                for r in range(g):
+                    parts.extend(
+                        padded(sl(x, r * c, (r + 1) * c), c, s)
+                        for x, c, s in zip(xs, counts, slots)
+                    )
+                    if tail:
+                        parts.append(tail_zeros(xs[0]))
+                return jnp.concatenate(parts, axis=-1)
+
+            self._concat = jax.jit(rs_pack)
         elif kind == "allgather":
             # result is G blocks of (total,); member m's shard concatenation
             # = its offsets[m] slice of every block, in group-rank order
@@ -131,6 +211,7 @@ class GradBucket:
         )
         self.req.setup()
         self._lock = threading.Lock()
+        self._warmed = False         # precompile() ran (per-instance jits hot)
         self._bufs: dict = {}        # member index -> buffer (this round)
         self._dispatched = False
         self._parts = None           # split bucket result (this round)
@@ -161,6 +242,7 @@ class GradBucket:
                 # restart while the bucket is in flight: abandon the slot for
                 # this round and run individually (well-defined supersede
                 # semantics live on the individual request)
+                stats_mod.record_bucket_round("abandon", self.kind)
                 self._consume_locked(i)
                 return False
             self._bufs[i] = buf  # a pre-dispatch restart supersedes
@@ -170,6 +252,11 @@ class GradBucket:
                 ordered = [self._bufs[j] for j in range(len(self.members))]
                 self.req.start(self._concat(*ordered))
                 self._dispatched = True
+                stats_mod.record_bucket_round(
+                    "dispatched", self.kind, members=len(self.members),
+                    coalesced=self._coalesced_bytes,
+                    wire_saved=self._wire_saved_bytes,
+                )
             return True
 
     def _fallback_locked(self) -> None:
@@ -179,6 +266,9 @@ class GradBucket:
         log_debug(
             "%s bucket fallback: %d/%d members started",
             self.kind, len(self._bufs), len(self.members),
+        )
+        stats_mod.record_bucket_round(
+            "fallback", self.kind, members=len(self._bufs)
         )
         for j, buf in self._bufs.items():
             ps = self.members[j]
@@ -291,6 +381,52 @@ class GradBucket:
                 return True, False, None
             return True, True, self._part_locked(out, i)
 
+    # -- AOT precompilation (Session.precompile_collectives) ---------------
+
+    def precompile(self) -> int:
+        """Warm this bucket's pack/unpack programs and its coalesced request
+        on zero buffers (jit-cache warm — see CommRequest.precompile for why a
+        call, not AOT lower().compile(), is what eliminates the step-0 stall).
+        Round state is untouched. Returns the number of programs run.
+
+        Idempotent per INSTANCE, not per shape: _concat/_split are fresh
+        jax.jit closures on every GradBucket, so a same-shaped sibling (or a
+        second session's bucket) holds cold caches of its own — a shared
+        shape-keyed plan entry would skip them and leak the pack/unpack
+        compiles back into step 0."""
+        import numpy as np
+
+        from mlsl_tpu.types import jnp_dtype
+
+        if self._warmed:
+            return 0
+        self._warmed = True
+        d = self.req.desc
+        topo = d.group.topology
+        grid = topo.grid_shape
+        g = 1 if d.group.is_self else d.group.size
+        in_mult = g if self.kind == "reduce_scatter" else 1
+        in_dt = jnp_dtype(d.data_type)
+        bufs = [
+            topo.shard_buffer(np.zeros((*grid, c * in_mult), dtype=in_dt))
+            for c in self.counts
+        ]
+        jax.block_until_ready(self._concat(*bufs))
+        if self.kind == "reduce_scatter":
+            out_len = d.recv_count
+        elif self.kind == "allgather":
+            out_len = d.count * g
+        else:
+            out_len = d.count
+        # the quantized ring delivers float32 regardless of the entry dtype
+        out_dt = (
+            jnp.float32
+            if self.compression == CompressionType.QUANTIZATION else in_dt
+        )
+        out = topo.shard_buffer(np.zeros((*grid, out_len), dtype=out_dt))
+        jax.block_until_ready(self._split(out))
+        return 2 + self.req.precompile()
+
 
 def _pack_by_size(pss: List, limit: int, size_of) -> List[List]:
     """Greedy packing in reverse creation (= backward start) order; singleton
@@ -320,58 +456,90 @@ def _pack_by_size(pss: List, limit: int, size_of) -> List[List]:
     return groups
 
 
+#: compressions whose gradient collective coalesces (TOPK stays individual:
+#: the sparse wire format has no coalesced form)
+_BUCKETABLE = (CompressionType.NONE, CompressionType.QUANTIZATION)
+
+
 def build_buckets(session, bucket_mb: int) -> int:
     """Pack eligible ParameterSets into GradBuckets (called at Commit):
-    plain sets coalesce their gradient allreduce; distributed-update (ZeRO-1)
-    sets coalesce BOTH phases — the gradient reduce_scatter (uncompressed
-    only; quantized grads ride the compressed ring individually) and the
-    increment all_gather. Returns the number of buckets formed."""
+    plain sets coalesce their gradient allreduce (uncompressed, or the int8
+    quantized ring — quantized sets bucket with their own kind, never mixed
+    with uncompressed neighbors); distributed-update (ZeRO-1) sets coalesce
+    BOTH phases — the gradient reduce_scatter (uncompressed or quantized) and
+    the increment all_gather (always uncompressed: there is no compressed
+    allgather). Returns the number of buckets formed."""
     from mlsl_tpu.comm.collectives import _group_key
     from mlsl_tpu.types import dtype_size
 
-    plain: dict = {}  # (group key, dtype) -> [ps] in creation order
+    plain: dict = {}   # (group key, dtype, compression) -> [ps] creation order
     du: dict = {}
+    du_inc: dict = {}  # (group key, dtype) -> [ps]: the increment all_gather
+    # is ALWAYS uncompressed, so it coalesces across compression types — only
+    # the gradient phase partitions by compression
     for op in session.operations:
         for ps in op.parameter_sets:
             if not ps.need_comm:
                 continue
-            key = (_group_key(ps.dist.grad_group), ps.data_type)
+            key = (_group_key(ps.dist.grad_group), ps.data_type, ps.compression)
             if (
                 not ps.distributed_update
-                and ps.compression == CompressionType.NONE
+                and ps.compression in _BUCKETABLE
                 and ps.bucket is None
             ):
                 plain.setdefault(key, []).append(ps)
             elif ps.distributed_update:
                 du.setdefault(key, []).append(ps)
+                du_inc.setdefault(key[:2], []).append(ps)
 
     limit = bucket_mb * 1024 * 1024
     n_buckets = 0
 
-    def form(pss, kind, attr):
+    cfg = session.env.config
+
+    def form(pss, kind, attr, compression=CompressionType.NONE):
         nonlocal n_buckets
         if not pss:
             return
+        limit_eff = limit
+        if (
+            compression == CompressionType.QUANTIZATION
+            and kind == "allreduce"
+            and cfg.large_msg_size_mb > 0
+            and cfg.large_msg_chunks > 1
+        ):
+            # a quantized allreduce above MLSL_LARGE_MSG_SIZE_MB would be
+            # linspace-chunked by CommRequest.setup at arbitrary offsets,
+            # voiding the slot/ring alignment this bucket just computed and
+            # splitting the single bucket residual per chunk — cap the bucket
+            # under the chunk threshold instead (7/8: alignment padding can
+            # grow the payload by up to 12.5%, the quantize() waste bound)
+            limit_eff = min(limit, cfg.large_msg_size_mb * 1024 * 1024 * 7 // 8)
         esize = dtype_size(pss[0].data_type)
         grp = pss[0].dist.grad_group
         g = 1 if grp.is_self else grp.size
         # member's wire contribution: full LOCAL gradient bytes — for the
         # ZeRO-1 reduce_scatter that is owned * g (the whole chunked buffer),
         # so bandwidth-sized layers are excluded consistently across kinds
+        # (quantized members are sized at their f32 bytes too: the bucket knob
+        # bounds the coalesced payload, not the compressed wire image)
         mult = g if kind == "reduce_scatter" else 1
         size_of = lambda ps: ps.owned_kernel_count * ps.kernel_size * esize * mult
-        for members in _pack_by_size(pss, limit, size_of):
-            bucket = GradBucket(members, session.env, kind=kind)
+        for members in _pack_by_size(pss, limit_eff, size_of):
+            bucket = GradBucket(
+                members, session.env, kind=kind, compression=compression
+            )
             for ps in members:
                 setattr(ps, attr, bucket)
             n_buckets += 1
 
-    for pss in plain.values():
-        form(pss, "allreduce", "bucket")
-    for pss in du.values():
-        form([ps for ps in pss
-              if ps.compression == CompressionType.NONE and ps.bucket is None],
-             "reduce_scatter", "bucket")
+    for (_, _, comp), pss in plain.items():
+        form(pss, "allreduce", "bucket", compression=comp)
+    for (_, _, comp), pss in du.items():
+        if comp in _BUCKETABLE:
+            form([ps for ps in pss if ps.bucket is None],
+                 "reduce_scatter", "bucket", compression=comp)
+    for pss in du_inc.values():
         form([ps for ps in pss if ps.inc_bucket is None],
              "allgather", "inc_bucket")
     if n_buckets:

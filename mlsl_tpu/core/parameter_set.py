@@ -62,9 +62,13 @@ class ParameterSet:
         self.inc_req: Optional[CommRequest] = None
         # gradient bucketing (core/bucketing.py, assigned at Session.commit):
         # the buckets opportunistically coalesce this set's grad collective
-        # (allreduce, or ZeRO-1 reduce_scatter) and its increment all_gather
-        # with its neighbors'; the *_round flags track whether the CURRENT
-        # round is bucket-owned or individual (fallback)
+        # (allreduce or ZeRO-1 reduce_scatter, uncompressed or int8-quantized
+        # — a quantized set joins a compressed-ring bucket whose single
+        # error-feedback residual carries this member's slice) and its
+        # increment all_gather with its neighbors'; the *_round flags track
+        # whether the CURRENT round is bucket-owned or individual (fallback —
+        # which for a quantized member runs its own compressed request with
+        # its own residual, so correctness never depends on co-arrival)
         self.bucket = None
         self._bucket_round = False
         self.inc_bucket = None

@@ -21,7 +21,7 @@ from typing import List, Optional
 from mlsl_tpu.core.activation import Activation
 from mlsl_tpu.core.parameter_set import ParameterSet
 from mlsl_tpu.core.stats import Statistics
-from mlsl_tpu.log import mlsl_assert
+from mlsl_tpu.log import log_debug, mlsl_assert
 from mlsl_tpu.types import CompressionType, DataType, OpType, PhaseType
 
 
@@ -303,9 +303,77 @@ class Session:
             from mlsl_tpu.core.bucketing import build_buckets
 
             build_buckets(self, cfg.grad_bucket_mb)
+        if cfg is not None and cfg.precompile:
+            self.precompile_collectives()
         self.stats.initialize()
         if cfg is not None and cfg.enable_stats:
             self.stats.collect_isolation_stats()
+
+    def precompile_collectives(self) -> int:
+        """AOT-warm every collective program this session's committed graph
+        can dispatch — activation edges, per-layer gradient/increment
+        requests (plain, chunked, quant-ring), and the coalesced GradBucket
+        programs (pack, collective, unpack) — by executing each once on zero
+        buffers, so step 0 of the training loop contains no collective
+        compilation (run automatically at Commit under MLSL_PRECOMPILE=1).
+
+        Idempotent across sessions: programs already warmed under the same
+        plan key (the collectives-cache identity: kind, group, dtype, count,
+        compression) are skipped via collectives._plan_cache, which
+        collectives.clear_cache() clears together with the program cache.
+        Returns the number of programs run."""
+        from mlsl_tpu.comm.collectives import _group_key, _plan_cache
+
+        n = 0
+
+        from mlsl_tpu.types import CompressionType
+
+        cfg = self.env.config
+
+        def warm_req(req):
+            nonlocal n
+            if req is None or not req.is_setup:
+                return
+            d = req.desc
+            # compressed programs are parameterized by codec geometry the
+            # desc does not carry (quant_ring/sparse cache by it): a plan
+            # entry recorded under one block size / ratio / custom codec must
+            # not suppress warming a program built under another
+            codec_key = ()
+            if d.compression != CompressionType.NONE:
+                codec_key = (cfg.quant_block_elems, cfg.topk_ratio,
+                             id(cfg.custom_codec))
+            key = (
+                "req", d.kind, _group_key(d.group), int(d.data_type), d.count,
+                int(d.compression), d.recv_count,
+                None if d.op is None else int(d.op), d.root,
+                len(req._chunk_slices), codec_key,
+            )
+            if key in _plan_cache:
+                return
+            n += req.precompile()
+            _plan_cache[key] = True
+
+        buckets: dict = {}
+        for op in self.operations:
+            for act in op.inputs + op.outputs:
+                warm_req(act.comm_req)
+            for ps in op.parameter_sets:
+                warm_req(ps.grad_req)
+                warm_req(ps.inc_req)
+                for b in (ps.bucket, ps.inc_bucket):
+                    if b is not None:
+                        buckets[id(b)] = b
+        # buckets warm per INSTANCE (GradBucket.precompile is idempotent on
+        # itself): their pack/unpack are per-instance jit closures, so a
+        # shape-identity plan entry would skip a same-shaped sibling whose
+        # caches are cold. Only the bucket's underlying collective comes from
+        # the shared module caches — re-warming it costs one cheap execution.
+        for b in buckets.values():
+            n += b.precompile()
+        if n:
+            log_debug("precompile: %d collective program(s) warmed at commit", n)
+        return n
 
     # -- statistics plumbing ----------------------------------------------
 
@@ -329,3 +397,4 @@ class Session:
     GetOperation = get_operation
     GetStats = get_stats
     Commit = commit
+    PrecompileCollectives = precompile_collectives

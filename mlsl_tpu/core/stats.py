@@ -21,6 +21,7 @@ src/mlsl_impl_stats.cpp):
 from __future__ import annotations
 
 import collections
+import contextlib
 import time
 from typing import Deque, Dict, List, Optional, Tuple
 
@@ -63,6 +64,75 @@ def record_watchdog_event(descriptor: str, phase: str, waited_s: float) -> None:
             )
     except OSError:
         pass
+
+
+# Bucket-round accounting (core/bucketing.py): process-wide like the watchdog
+# record — buckets fire from the request layer with no Session handle. The
+# aggregate counters are the tracked signal (printed by Statistics.print_ into
+# STATS_OUTPUT_FILE); the bounded event ring keeps the recent per-round detail
+# for diagnosis without growing memory on a long run.
+BUCKET_EVENTS: Deque[dict] = collections.deque(maxlen=256)
+BUCKET_COUNTERS: Dict[str, int] = {
+    "rounds_dispatched": 0,   # full rounds served by one coalesced dispatch
+    "rounds_fallback": 0,     # early-Wait rounds degraded to individual reqs
+    "member_abandons": 0,     # members restarted mid-flight (ran individually)
+    "bytes_coalesced": 0,     # member payload bytes carried by bucket rounds
+    "wire_bytes_saved": 0,    # est. wire bytes compression saved vs f32 rounds
+}
+
+
+def record_bucket_round(
+    event: str, kind: str, members: int = 0, coalesced: int = 0,
+    wire_saved: int = 0,
+) -> None:
+    """Called by GradBucket at every round transition (dispatch / early-Wait
+    fallback / member-restart abandon)."""
+    if event == "dispatched":
+        BUCKET_COUNTERS["rounds_dispatched"] += 1
+        BUCKET_COUNTERS["bytes_coalesced"] += coalesced
+        BUCKET_COUNTERS["wire_bytes_saved"] += wire_saved
+    elif event == "fallback":
+        BUCKET_COUNTERS["rounds_fallback"] += 1
+    else:  # abandon
+        BUCKET_COUNTERS["member_abandons"] += max(members, 1)
+    BUCKET_EVENTS.append(
+        {"event": event, "kind": kind, "members": members, "at": time.time()}
+    )
+
+
+def reset_bucket_counters() -> None:
+    for k in BUCKET_COUNTERS:
+        BUCKET_COUNTERS[k] = 0
+    BUCKET_EVENTS.clear()
+
+
+#: jax monitoring event fired once per XLA backend compilation — the
+#: compile-count probe behind the MLSL_PRECOMPILE acceptance check.
+BACKEND_COMPILE_EVENT = "/jax/core/compile/backend_compile_duration"
+
+
+@contextlib.contextmanager
+def count_backend_compiles():
+    """Count XLA backend compilations inside the block: yields a one-element
+    list whose [0] is the running count. Used to verify AOT precompilation
+    (Session.precompile_collectives / MLSL_PRECOMPILE) actually removed
+    compile stalls from the timed path — a warmed step must count 0."""
+    from jax._src import monitoring
+
+    n = [0]
+
+    def _listener(event, duration=0.0, **kw):  # noqa: ARG001
+        if event == BACKEND_COMPILE_EVENT:
+            n[0] += 1
+
+    monitoring.register_event_duration_secs_listener(_listener)
+    try:
+        yield n
+    finally:
+        try:
+            monitoring._unregister_event_duration_listener_by_callback(_listener)
+        except Exception:  # pragma: no cover - jax internals moved
+            pass
 
 
 class _Slot:
@@ -302,6 +372,14 @@ class Statistics:
                     f"{ent['iso_ns'] / 1e3:>10.1f} Kns = "
                     f"{ent['overlap_fraction']:.3f}"
                 )
+        c = BUCKET_COUNTERS
+        if c["rounds_dispatched"] or c["rounds_fallback"] or c["member_abandons"]:
+            lines.append(
+                f"{'BUCKET':<16} {'ROUNDS':<8} dispatched {c['rounds_dispatched']} "
+                f"fallback {c['rounds_fallback']} abandoned {c['member_abandons']} "
+                f"coalesced {c['bytes_coalesced'] / 1024.0:.1f} KB "
+                f"wire_saved {c['wire_bytes_saved'] / 1024.0:.1f} KB"
+            )
         text = "\n".join(lines) + "\n"
         try:
             with open(path, "a") as f:

@@ -630,6 +630,80 @@ class DataParallelTrainer:
 
         return fused_opt
 
+    # -- AOT warm-up (MLSL_PRECOMPILE) --------------------------------------
+
+    def precompile(self, batch) -> None:
+        """Warm every compiled program one step() dispatches, so step 0 of the
+        timed loop contains no compilation: the session's collective plans
+        (Session.precompile_collectives — also run automatically at Commit
+        under MLSL_PRECOMPILE=1, and idempotent here) plus this trainer's
+        model-side programs. Donating programs are exercised on copies — a
+        donated warm argument must never consume the live params/opt state.
+        ``batch`` is a shard_batch() result; its values are read, not trained
+        on (params are unchanged afterwards)."""
+        self.session.precompile_collectives()
+        copy = lambda tree: jax.tree.map(jnp.copy, tree)
+        if self._fused_fn is not None:
+            # the fused step never dispatches _grad_fn — warming it here would
+            # ADD a full-model compile to startup, the exact stall this exists
+            # to remove
+            if self.optimizer is None:
+                out = self._fused_fn(copy(self.params), batch)
+            else:
+                out = self._fused_fn(copy(self.params), copy(self._opt_state), batch)
+            jax.block_until_ready(out)
+            return
+        loss, grads = self._grad_fn(self.params, batch)
+        if self.overlap_updates:
+            for name in self.layers:  # per-layer update fns never donate
+                self._layer_update_fns[name](
+                    self.get_layer(self.params, name), grads[name]
+                )
+        elif not (self.distributed_update and self._needs_comm):
+            if self.optimizer is None:
+                self._update_fn(copy(self.params), grads)
+            else:
+                self._update_fn(copy(self.params), copy(self._opt_state), grads)
+        else:
+            topo = self.dist.topology
+            grid = topo.grid_shape
+            owned = {
+                name: topo.shard_buffer(np.zeros(
+                    (*grid,
+                     self.ops[name].get_parameter_set(0).owned_kernel_count
+                     * self.ops[name].get_parameter_set(0).kernel_size),
+                    np.float32,
+                ))
+                for name in self.layers
+            }
+            scale_args = ()
+            if self.clip_global_norm is not None:
+                if self._du_norm_fn is None:
+                    self._du_norm_fn = build_owned_norm_fn(
+                        self.mesh, self.data_size
+                    )
+                scale_args = (_clip_scale(
+                    self._du_norm_fn(owned) ** 2, self.clip_global_norm
+                ),)
+            incs = {}
+            for name in self.layers:
+                if self.optimizer is None:
+                    self._du_inc_fn(owned[name], *scale_args)
+                elif self._du_inc_fns is not None:
+                    self._du_inc_fns[name](
+                        owned[name], copy(self._du_opt_state[name]),
+                        self.get_layer(self.params, name), *scale_args
+                    )
+                else:
+                    self._du_inc_fn(
+                        owned[name], copy(self._du_opt_state[name]), *scale_args
+                    )
+                incs[name] = topo.shard_buffer(np.zeros(
+                    (*grid, self.padded_counts[name]), np.float32
+                ))
+            self._du_apply_fn(copy(self.params), incs)
+        jax.block_until_ready(loss)
+
     # -- data placement ----------------------------------------------------
 
     def shard_batch(self, x: np.ndarray, y: np.ndarray):

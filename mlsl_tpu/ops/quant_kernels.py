@@ -169,6 +169,15 @@ def _dequantize_pallas(q2d, scales, interpret=False):
     )(q2d, scales[:, None])
 
 
+def block_align(n: int, block: int) -> int:
+    """Smallest multiple of ``block`` >= n. Coalesced quantized payloads
+    (core/bucketing.py) align every member's slot to this so a quant block
+    never straddles two members' gradients: each member keeps exactly the
+    per-block scale locality it would have on its own individual ring, and the
+    inter-member padding quantizes to exact zeros."""
+    return -(-n // block) * block
+
+
 # -- public API: pads to tile geometry, picks backend -------------------------
 
 

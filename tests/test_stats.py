@@ -82,11 +82,13 @@ def test_start_stop_gating(stats_env):
 
 
 def _retry_overlap_comparison(measure_blocked, measure_overlapped,
-                              exposed_ratio, context, attempts=3):
+                              exposed_ratio, context, attempts=5):
     """Comparative-only overlap assertion with load-spike retries: a sustained
     spike (e.g. a concurrent JAX import pinning the shared core) can straddle
     every rep of one phase and invert the blocking-vs-overlapped comparison,
-    so the comparison itself retries with backoff before failing."""
+    so the comparison itself retries with backoff before failing (5 attempts:
+    3 was still observed losing 1-in-N under a sustained spike on the shared
+    box — only failing runs pay the extra backoff)."""
     import time
 
     for attempt in range(attempts):
