@@ -399,9 +399,11 @@ def _overlap_probe_cpu_mesh(timeout: float = 600.0):
                    + " --xla_force_host_platform_device_count=8").strip(),
     )
     # fault-injection/watchdog config armed for the CHIP run must not leak
-    # into the probe's training loop (an armed hang would wedge it to timeout)
+    # into the probe's training loop (an armed hang would wedge it to
+    # timeout), and the span tracer must not tax a comparative timing probe
     env_vars.pop("MLSL_CHAOS", None)
     env_vars.pop("MLSL_WATCHDOG_TIMEOUT", None)
+    env_vars.pop("MLSL_TRACE", None)
     try:
         out = subprocess.run(
             [sys.executable, "-c", _OVERLAP_PROBE_SRC],

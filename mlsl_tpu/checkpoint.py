@@ -34,6 +34,7 @@ import jax
 from mlsl_tpu import chaos
 from mlsl_tpu.config import _env_float, _env_int
 from mlsl_tpu.log import MLSLError, log_info, log_warning
+from mlsl_tpu.obs import tracer as obs
 
 try:
     import orbax.checkpoint as ocp
@@ -95,6 +96,8 @@ class CheckpointManager:
         backoff; anything else propagates (recoverable by FaultTolerantLoop).
         """
         self.check_errors()
+        tr = obs._tracer
+        t0 = tr.now() if tr is not None else 0
         delay = self.retry_backoff_s
         for attempt in range(self.save_retries + 1):
             try:
@@ -108,6 +111,9 @@ class CheckpointManager:
             except OSError as e:
                 if attempt >= self.save_retries:
                     raise
+                if tr is not None:
+                    tr.instant("ckpt.save.retry", "ckpt", step=step,
+                               attempt=attempt + 1, error=repr(e))
                 log_warning(
                     "checkpoint save of step %d failed (%s: %s); "
                     "retry %d/%d in %.2fs",
@@ -117,6 +123,10 @@ class CheckpointManager:
                 time.sleep(delay)
                 delay *= 2
         self._unverified.add(step)
+        if tr is not None:
+            # dispatch span only: the orbax write itself runs async in the
+            # background — its drain lands in the wait() span below
+            tr.complete("ckpt.save", "ckpt", t0, step=step, attempts=attempt + 1)
         if wait:
             self.wait()
         # async path: manifests are checksummed at the next drain point
@@ -131,6 +141,8 @@ class CheckpointManager:
         if step is None:
             return None
         chaos.inject("checkpoint.restore", step=step)
+        tr = obs._tracer
+        t0 = tr.now() if tr is not None else 0
         if template is not None:
             target = jax.tree.map(
                 lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype, sharding=getattr(x, "sharding", None))
@@ -138,8 +150,12 @@ class CheckpointManager:
                 else x,
                 template,
             )
-            return self._mgr.restore(step, args=ocp.args.StandardRestore(target))
-        return self._mgr.restore(step)
+            out = self._mgr.restore(step, args=ocp.args.StandardRestore(target))
+        else:
+            out = self._mgr.restore(step)
+        if tr is not None:
+            tr.complete("ckpt.restore", "ckpt", t0, step=step)
+        return out
 
     def latest_step(self) -> Optional[int]:
         return self._mgr.latest_step()
@@ -148,9 +164,13 @@ class CheckpointManager:
         return sorted(self._mgr.all_steps())
 
     def wait(self) -> None:
+        tr = obs._tracer
+        t0 = tr.now() if tr is not None else 0
         self._mgr.wait_until_finished()
         self.check_errors()
         self._flush_manifests()
+        if tr is not None:
+            tr.complete("ckpt.drain", "ckpt", t0)
 
     def close(self) -> None:
         self._mgr.wait_until_finished()

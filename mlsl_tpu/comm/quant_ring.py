@@ -231,13 +231,24 @@ def _chaos_roundtrip(fn: Callable) -> Callable:
     """Wrap the compiled ring so every (buf, err) round-trip passes the
     'codec.roundtrip' chaos site — faults at the compressed-wire layer must be
     recoverable (EQuARX/THC pair compressed collectives with correctness
-    safeguards; ours is the tested recovery path)."""
+    safeguards; ours is the tested recovery path) — and, when tracing is armed
+    (mlsl_tpu.obs), records the host-side quant encode/ring/decode enqueue as
+    a 'quant.roundtrip' span (device completion lands in the owning request's
+    wait span)."""
     from mlsl_tpu import chaos
+    from mlsl_tpu.obs import tracer as obs
 
     def roundtrip(buf, err):
         if chaos._plans:
             chaos.inject("codec.roundtrip")
-        return fn(buf, err)
+        tr = obs._tracer
+        if tr is None:
+            return fn(buf, err)
+        t0 = tr.now()
+        out = fn(buf, err)
+        tr.complete("quant.roundtrip", "quant", t0,
+                    elems=int(buf.shape[-1]) if hasattr(buf, "shape") else 0)
+        return out
 
     roundtrip.__wrapped__ = fn
     # precompile warm bypass (request._unwrap_chaos): warming at Commit must

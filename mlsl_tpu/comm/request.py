@@ -29,6 +29,7 @@ import jax
 import jax.numpy as jnp
 
 from mlsl_tpu import chaos
+from mlsl_tpu.obs import tracer as obs
 from mlsl_tpu.comm.mesh import NUM_GRID_AXES, ProcessGroup
 from mlsl_tpu.log import (
     MLSLTimeoutError,
@@ -318,6 +319,11 @@ class CommRequest:
             self._dispatch_error = None
             self.is_started = True
             self._started_at = time.monotonic()  # watchdog stamp
+        tr = obs._tracer
+        if tr is not None:
+            tr.instant("submit", "req", track=self._trace_name,
+                       req=self.name or self.uid, epoch=self._epoch,
+                       bytes=self._payload)
         self.dispatcher.submit(self, buf)
         return self
 
@@ -336,10 +342,15 @@ class CommRequest:
             if epoch is not None and epoch != self._epoch:
                 log_debug("dropping superseded dispatch of %s", self.name or self.uid)
                 return
+            tr = obs._tracer
+            t0 = tr.now() if tr is not None else 0
             try:
                 with jax.profiler.TraceAnnotation(self._trace_name):
                     self._dispatch_inner(buf)
             except Exception as e:
+                if tr is not None:
+                    tr.instant("dispatch.error", "req", track=self._trace_name,
+                               req=self.name or self.uid, error=repr(e))
                 if epoch is None:
                     raise  # direct dispatch: fail the caller's start()
                 # Queued dispatch: record the failure on the request while the
@@ -348,6 +359,13 @@ class CommRequest:
                 # _dispatch_error and bumps the epoch) and attach this stale
                 # failure to the new start.
                 self._dispatch_error = e
+            else:
+                if tr is not None:
+                    # host-side enqueue span: XLA's async dispatch returns
+                    # before the device finishes, so this measures launch
+                    # cost; device completion lands in the wait span
+                    tr.complete("dispatch", "req", t0, track=self._trace_name,
+                                req=self.name or self.uid, epoch=self._epoch)
 
     def _dispatch_inner(self, buf: jax.Array) -> None:
         # Cross-distribution edges (redistribution cases 3-5) hand a buffer laid
@@ -422,6 +440,13 @@ class CommRequest:
         raise the recoverable timeout."""
         waited = time.monotonic() - (self._started_at or time.monotonic())
         desc = self.describe()
+        tr = obs._tracer
+        if tr is not None:
+            # on the stuck request's OWN track, before the flight record is
+            # cut (record_watchdog_event) so the dump contains it
+            tr.instant("watchdog.trip", "watchdog", track=self._trace_name,
+                       req=self.name or self.uid, phase=phase,
+                       waited_s=round(waited, 3), descriptor=desc)
         from mlsl_tpu.core import stats as stats_mod
 
         stats_mod.record_watchdog_event(desc, phase, waited)
@@ -455,6 +480,8 @@ class CommRequest:
         if chaos._plans:
             chaos.inject("request.wait", request=self.name or self.uid,
                          kind=self.desc.kind)
+        tr = obs._tracer
+        t0 = tr.now() if tr is not None else 0
         deadline = self._watchdog_deadline(timeout)
         self.dispatcher.wait_dispatched(self, deadline)
         if self._dispatch_error is not None:
@@ -464,6 +491,12 @@ class CommRequest:
         out = self._assemble()
         self._block_ready(out, deadline)
         self.is_started = False
+        if tr is not None:
+            # the wait STALL: host time blocked for this request (dispatch
+            # race + device completion) — the per-op overlap-loss signal
+            # behind Statistics.overlap_report's p50/p95 fields
+            tr.complete("wait", "req", t0, track=self._trace_name,
+                        req=self.name or self.uid, epoch=self._epoch)
         return out
 
     def test(self) -> tuple:
@@ -487,6 +520,10 @@ class CommRequest:
             out = self._assemble()
             jax.block_until_ready(out)
             self.is_started = False
+            tr = obs._tracer
+            if tr is not None:
+                tr.instant("test.done", "req", track=self._trace_name,
+                           req=self.name or self.uid, epoch=self._epoch)
             return True, out
         return False, None
 
@@ -699,6 +736,11 @@ class Dispatcher:
             if immediate:
                 req._dispatch(buf)  # outside the lock: may trigger compilation
             else:
+                tr = obs._tracer
+                if tr is not None:
+                    tr.instant("defer", "req", track=req._trace_name,
+                               req=req.name or req.uid, bytes=req._payload,
+                               scheduler="native")
                 log_debug(
                     "deferred request %s (%d B)", req.name, req.desc.payload_bytes()
                 )
@@ -712,6 +754,11 @@ class Dispatcher:
             self._pending = [e for e in self._pending if e[0] is not req]
             self._pending.append((req, buf, req._epoch))
             self._note_deferred_locked()
+        tr = obs._tracer
+        if tr is not None:
+            tr.instant("defer", "req", track=req._trace_name,
+                       req=req.name or req.uid, bytes=req._payload,
+                       scheduler="python")
         log_debug("deferred request %s (%d B)", req.name, req._payload)
 
     def _note_deferred_locked(self) -> None:

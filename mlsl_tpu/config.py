@@ -13,6 +13,8 @@ from __future__ import annotations
 import dataclasses
 import os
 
+from mlsl_tpu.obs.tracer import DEFAULT_CAPACITY as _TRACE_DEFAULT_CAPACITY
+
 
 def _env_int(name: str, default: int) -> int:
     v = os.environ.get(name)
@@ -108,6 +110,17 @@ class Config:
     # comma-separated). Kept here for discoverability/printing only.
     chaos_spec: str = ""            # MLSL_CHAOS
 
+    # --- observability tier (mlsl_tpu.obs span tracer) ---
+    # Kept for discoverability/printing only, like chaos_spec: the tracer is
+    # process-wide (armed at import from MLSL_TRACE, or obs.enable()) and the
+    # output dir / ring capacity are read from the SAME env vars per call —
+    # override via the obs API, not by mutating these fields.
+    trace: bool = False             # MLSL_TRACE: arm the comm timeline tracer
+    trace_dir: str = ""             # MLSL_TRACE_DIR: trace-*.json output dir
+    # MLSL_TRACE_CAPACITY: ring size (events); single source of truth is the
+    # tracer's own default
+    trace_capacity: int = _TRACE_DEFAULT_CAPACITY
+
     # --- accepted-for-parity no-ops (MPI/shm specific) ---
     server_affinity: str = ""       # MLSL_SERVER_AFFINITY
     heap_size_gb: int = 0           # MLSL_HEAP_SIZE_GB
@@ -165,6 +178,9 @@ class Config:
             "MLSL_CKPT_RETRY_BACKOFF_S", c.ckpt_retry_backoff_s
         )
         c.chaos_spec = os.environ.get("MLSL_CHAOS", c.chaos_spec)
+        c.trace = _env_bool("MLSL_TRACE", c.trace)
+        c.trace_dir = os.environ.get("MLSL_TRACE_DIR", c.trace_dir)
+        c.trace_capacity = _env_int("MLSL_TRACE_CAPACITY", c.trace_capacity)
         c.precompile = _env_bool("MLSL_PRECOMPILE", c.precompile)
         c.server_affinity = os.environ.get("MLSL_SERVER_AFFINITY", c.server_affinity)
         c.heap_size_gb = _env_int("MLSL_HEAP_SIZE_GB", c.heap_size_gb)

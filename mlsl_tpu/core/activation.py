@@ -236,8 +236,11 @@ class Activation:
         esize = dtype_size(dt)
 
         def mk(kind, group, **kw):
+            # op-attributed name: trace tracks / watchdog descriptors / the
+            # overlap report's span-derived stalls key on the '<op>/' prefix
             req = CommRequest(
-                CommDesc(kind, group, kw.pop("count"), dt, **kw), env.dispatcher
+                CommDesc(kind, group, kw.pop("count"), dt, **kw), env.dispatcher,
+                name=f"{out_act.op.name}/{kind}",
             )
             req.setup()
             return req

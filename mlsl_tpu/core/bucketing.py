@@ -43,6 +43,7 @@ import jax.numpy as jnp
 
 from mlsl_tpu.comm.request import CommDesc, CommRequest, ComputeType
 from mlsl_tpu.core import stats as stats_mod
+from mlsl_tpu.obs import tracer as obs
 from mlsl_tpu.log import log_debug, mlsl_assert
 from mlsl_tpu.types import CompressionType, ReductionType
 
@@ -250,7 +251,16 @@ class GradBucket:
                 # _error is necessarily None here: every member passed the
                 # per-member supersede block above on its way into this round
                 ordered = [self._bufs[j] for j in range(len(self.members))]
+                tr = obs._tracer
+                t0 = tr.now() if tr is not None else 0
                 self.req.start(self._concat(*ordered))
+                if tr is not None:
+                    # pack + coalesced Start on the bucket request's track
+                    # (its submit/dispatch/wait spans land there too)
+                    tr.complete("bucket.pack", "bucket", t0,
+                                track=self.req._trace_name, kind=self.kind,
+                                members=len(self.members),
+                                bytes=self._coalesced_bytes)
                 self._dispatched = True
                 stats_mod.record_bucket_round(
                     "dispatched", self.kind, members=len(self.members),

@@ -76,6 +76,12 @@ class ParameterSet:
         env = op.session.env
         if self.need_comm:
             n_owned = self.owned_kernel_count * self.kernel_size
+            # op-attributed request names: the trace timeline (mlsl_tpu.obs)
+            # and the watchdog descriptor name the owning operation, and the
+            # span-derived per-op wait-stall fields of
+            # Statistics.overlap_report key on the '<op>/' prefix
+            # (op.name is never empty: Operation defaults it to op<idx>)
+            req_name = f"{op.name}/"
             if self.distributed_update:
                 self.grad_req = CommRequest(
                     CommDesc(
@@ -89,6 +95,7 @@ class ParameterSet:
                         compression=self.compression,
                     ),
                     env.dispatcher,
+                    name=f"{req_name}grad{index}",
                 )
                 self.inc_req = CommRequest(
                     CommDesc(
@@ -99,6 +106,7 @@ class ParameterSet:
                         compute_type=ComputeType.PARAM_INC,
                     ),
                     env.dispatcher,
+                    name=f"{req_name}inc{index}",
                 )
                 self.inc_req.setup()
             else:
@@ -113,6 +121,7 @@ class ParameterSet:
                         compression=self.compression,
                     ),
                     env.dispatcher,
+                    name=f"{req_name}grad{index}",
                 )
             self.grad_req.setup()
 

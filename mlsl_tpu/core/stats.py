@@ -22,6 +22,7 @@ from __future__ import annotations
 
 import collections
 import contextlib
+import os
 import time
 from typing import Deque, Dict, List, Optional, Tuple
 
@@ -29,11 +30,20 @@ import numpy as np
 import jax
 
 from mlsl_tpu.log import log_warning
+from mlsl_tpu.obs import tracer as obs
 from mlsl_tpu.types import dtype_size, jnp_dtype
 
 ISOLATION_ITERS = 10
 ISOLATION_SKIP = 4
 STATS_OUTPUT_FILE = "mlsl_stats.log"
+
+
+def stats_path(name: str = STATS_OUTPUT_FILE) -> str:
+    """Where the stats log lands: ``MLSL_STATS_DIR`` (default CWD, the
+    reference's behavior). Read per call, not at import — tests route it to a
+    tmp dir and long-lived processes may re-point it between phases."""
+    d = os.environ.get("MLSL_STATS_DIR")
+    return os.path.join(d, name) if d else name
 
 # Watchdog event record: every request the watchdog declared stuck, with its
 # descriptor and how long it had been in flight. Process-wide (the watchdog
@@ -56,8 +66,22 @@ def record_watchdog_event(descriptor: str, phase: str, waited_s: float) -> None:
     log_warning(
         "watchdog: request stuck in %s for %.2fs: %s", phase, waited_s, descriptor
     )
+    if obs._tracer is not None:
+        # flight recorder: dump the trailing window of spans around the stall
+        # (the stuck epoch plus margin) so the timeout report carries the
+        # timeline that led to it — the stuck request's own watchdog.trip
+        # instant is already in the ring (CommRequest._watchdog_trip)
+        from mlsl_tpu.obs import export as obs_export
+
+        path = obs_export.flight_record(
+            window_s=max(2 * waited_s, 30.0),
+            reason=f"watchdog {phase}: {descriptor}",
+        )
+        if path:
+            evt["flight_record"] = path
+            log_warning("watchdog flight record written: %s", path)
     try:
-        with open(STATS_OUTPUT_FILE, "a") as f:
+        with open(stats_path(), "a") as f:
             f.write(
                 f"{'WATCHDOG':<16} {phase:<8} waited {waited_s:>10.2f} s  "
                 f"{descriptor}\n"
@@ -98,6 +122,11 @@ def record_bucket_round(
     BUCKET_EVENTS.append(
         {"event": event, "kind": kind, "members": members, "at": time.time()}
     )
+    if obs._tracer is not None:
+        # round transitions on the comm timeline (the dispatched round's
+        # pack+Start duration is recorded by GradBucket itself)
+        obs._tracer.instant(f"bucket.{event}", "bucket", kind=kind,
+                            members=members)
 
 
 def reset_bucket_counters() -> None:
@@ -116,7 +145,13 @@ def count_backend_compiles():
     """Count XLA backend compilations inside the block: yields a one-element
     list whose [0] is the running count. Used to verify AOT precompilation
     (Session.precompile_collectives / MLSL_PRECOMPILE) actually removed
-    compile stalls from the timed path — a warmed step must count 0."""
+    compile stalls from the timed path — a warmed step must count 0.
+
+    Cleanup is unconditional (the ``finally`` runs on exception paths too) and
+    VERIFIED: a listener left behind by a failing test body would keep
+    counting other tests' compiles forever, so if jax's private unregister
+    hook has moved we excise the callback from the registry list directly and
+    warn rather than silently leaking."""
     from jax._src import monitoring
 
     n = [0]
@@ -129,10 +164,36 @@ def count_backend_compiles():
     try:
         yield n
     finally:
-        try:
-            monitoring._unregister_event_duration_listener_by_callback(_listener)
-        except Exception:  # pragma: no cover - jax internals moved
-            pass
+        _remove_duration_listener(monitoring, _listener)
+
+
+def _remove_duration_listener(monitoring, listener) -> None:
+    """Best-effort unregister via the jax API, then verify against the
+    registry itself and fall back to direct excision — never leave the
+    listener installed."""
+    try:
+        monitoring._unregister_event_duration_listener_by_callback(listener)
+    except Exception:  # jax internals moved; the verify below still runs
+        pass
+    for attr in (
+        "_event_duration_secs_listeners",  # current jax registry list
+        "event_duration_secs_listeners",
+    ):
+        reg = getattr(monitoring, attr, None)
+        if isinstance(reg, list) and listener in reg:
+            try:
+                reg.remove(listener)
+            except ValueError:
+                pass
+    for reg in (
+        getattr(monitoring, "_event_duration_secs_listeners", None),
+        getattr(monitoring, "event_duration_secs_listeners", None),
+    ):
+        if isinstance(reg, list) and listener in reg:  # pragma: no cover
+            log_warning(
+                "count_backend_compiles could not unregister its jax "
+                "monitoring listener; later compile counts will be inflated"
+            )
 
 
 class _Slot:
@@ -283,7 +344,36 @@ class Statistics:
                 max(0, tot_iso - tot_exposed) / tot_iso if tot_iso > 0 else None
             ),
         }
-        return {"ops": ops, "total": total}
+        rep = {"ops": ops, "total": total}
+        tr = obs._tracer
+        if tr is not None:
+            # span-derived attribution (tracing on): per-op p50/p95 wait-stall
+            # from the tracer's 'wait' spans — requests are named '<op>/...'
+            # (core/parameter_set.py), so overlap loss maps to specific ops
+            # instead of one aggregate number
+            stalls = tr.wait_stall_durations()
+            all_durs: List[int] = []
+            for name, ent in ops.items():
+                durs: List[int] = []
+                for key, d in stalls.items():
+                    if key.startswith(name + "/"):
+                        durs.extend(d)
+                if durs:
+                    durs.sort()
+                    ent["wait_spans"] = len(durs)
+                    ent["wait_stall_p50_ms"] = (
+                        obs._percentile(durs, 50) / 1e6
+                    )
+                    ent["wait_stall_p95_ms"] = (
+                        obs._percentile(durs, 95) / 1e6
+                    )
+                all_durs.extend(durs)
+            if all_durs:
+                all_durs.sort()
+                total["wait_spans"] = len(all_durs)
+                total["wait_stall_p50_ms"] = obs._percentile(all_durs, 50) / 1e6
+                total["wait_stall_p95_ms"] = obs._percentile(all_durs, 95) / 1e6
+        return rep
 
     def _overlap_slots(self):
         """(op_idx, true_comm_ns, exposed_ns) per qualifying slot — the ONE
@@ -336,7 +426,9 @@ class Statistics:
 
     # -- printer (reference :226-363) --------------------------------------
 
-    def print_(self, path: str = STATS_OUTPUT_FILE) -> str:
+    def print_(self, path: Optional[str] = None) -> str:
+        if path is None:
+            path = stats_path()  # MLSL_STATS_DIR routing, resolved per call
         lines = []
         mb = max(self.session.global_minibatch_size, 1)
         lines.append(
@@ -374,12 +466,29 @@ class Statistics:
                 )
         c = BUCKET_COUNTERS
         if c["rounds_dispatched"] or c["rounds_fallback"] or c["member_abandons"]:
-            lines.append(
+            bucket_line = (
                 f"{'BUCKET':<16} {'ROUNDS':<8} dispatched {c['rounds_dispatched']} "
                 f"fallback {c['rounds_fallback']} abandoned {c['member_abandons']} "
                 f"coalesced {c['bytes_coalesced'] / 1024.0:.1f} KB "
                 f"wire_saved {c['wire_bytes_saved'] / 1024.0:.1f} KB"
             )
+            tr = obs._tracer
+            if tr is not None:
+                # span-derived: wait-stall distribution over the bucket
+                # requests' 'wait' spans (named 'bucket-<kind>[NxM]')
+                durs = [
+                    d
+                    for key, ds in tr.wait_stall_durations().items()
+                    if key.startswith("bucket-")
+                    for d in ds
+                ]
+                if durs:
+                    durs.sort()
+                    bucket_line += (
+                        f" wait_p50 {obs._percentile(durs, 50) / 1e6:.2f} ms"
+                        f" wait_p95 {obs._percentile(durs, 95) / 1e6:.2f} ms"
+                    )
+            lines.append(bucket_line)
         text = "\n".join(lines) + "\n"
         try:
             with open(path, "a") as f:

@@ -36,6 +36,7 @@ from mlsl_tpu.comm.mesh import (
     SEQ_AXIS,
 )
 from mlsl_tpu.log import mlsl_assert
+from mlsl_tpu.obs import tracer as obs_trace
 from mlsl_tpu.types import CompressionType, DataType, OpType
 
 
@@ -367,6 +368,9 @@ class DataParallelTrainer:
             if self.overlap_updates
             else None
         )
+        # monotonically increasing step() counter — trace spans
+        # (mlsl_tpu.obs) carry it so a timeline row maps back to a step
+        self._step_no = 0
 
     # -- compiled pieces ---------------------------------------------------
 
@@ -760,6 +764,7 @@ class DataParallelTrainer:
         the same local minibatch size; the effective loss is the mean over all
         k micro-batches. Returns the mean loss."""
         mlsl_assert(len(batches) >= 1, "step_accum needs at least one batch")
+        self._step_no += 1
         if self._accum_fns is None:
             def add(a, b):
                 return jax.tree.map(jnp.add, a, b)
@@ -769,15 +774,23 @@ class DataParallelTrainer:
 
             self._accum_fns = (jax.jit(add), jax.jit(scale, static_argnums=1))
         add_fn, scale_fn = self._accum_fns
+        tr = obs_trace._tracer
+        t0 = tr.now() if tr is not None else 0
         total, loss_sum = None, None
         for b in batches:
             loss, grads = self._grad_fn(self.params, b)
             total = grads if total is None else add_fn(total, grads)
             loss_sum = loss if loss_sum is None else loss_sum + loss
         k = len(batches)
+        if tr is not None:
+            tr.complete("step.grad", "step", t0, step=self._step_no,
+                        micro_batches=k)
         return self._sync_and_update(scale_fn(total, k), loss_sum / k)
 
     def step(self, batch) -> jax.Array:
+        self._step_no += 1
+        tr = obs_trace._tracer
+        t0 = tr.now() if tr is not None else 0
         if self._fused_fn is not None:
             if self.optimizer is None:
                 loss, self.params = self._fused_fn(self.params, batch)
@@ -785,15 +798,27 @@ class DataParallelTrainer:
                 loss, self.params, self._opt_state = self._fused_fn(
                     self.params, self._opt_state, batch
                 )
+            if tr is not None:
+                tr.complete("step.fused", "step", t0, step=self._step_no)
             return loss
         loss, grads = self._grad_fn(self.params, batch)
+        if tr is not None:
+            # host-side dispatch of the local-gradient program (async: device
+            # compute overlaps the comm Starts that follow)
+            tr.complete("step.grad", "step", t0, step=self._step_no)
         return self._sync_and_update(grads, loss)
 
     def _sync_and_update(self, grads, loss) -> jax.Array:
         # Start gradient comms newest-gradient-first (reverse layer order), the
         # stream shape eplib's priority allreduce was built for.
+        tr = obs_trace._tracer
+        t0 = tr.now() if tr is not None else 0
         for name in reversed(self.layers):
             self.ops[name].get_parameter_set(0).start_gradient_comm(grads[name])
+        if tr is not None:
+            tr.complete("step.sync_start", "step", t0, step=self._step_no,
+                        layers=len(self.layers))
+            t0 = tr.now()
 
         if self.overlap_updates:
             # poll Test and update each layer the moment its collective lands
@@ -884,6 +909,9 @@ class DataParallelTrainer:
                 ps = self.ops[name].get_parameter_set(0)
                 incs[name] = ps.wait_increment_comm()
             self.params = self._du_apply_fn(self.params, incs)
+        if tr is not None:
+            # wait-all + parameter update phase (whatever path ran above)
+            tr.complete("step.update", "step", t0, step=self._step_no)
         return loss
 
 

@@ -1,0 +1,52 @@
+"""mlsl_tpu.obs — structured comm-timeline tracing (docs/DESIGN.md
+"Observability & tracing").
+
+Quick start::
+
+    MLSL_TRACE=1 python train.py        # arm at launch
+    # or programmatically:
+    from mlsl_tpu import obs
+    obs.enable()
+    ... run ...
+    path = obs.write_trace()            # load in ui.perfetto.dev
+
+Env knobs: ``MLSL_TRACE`` (arm), ``MLSL_TRACE_DIR`` (output directory,
+default CWD), ``MLSL_TRACE_CAPACITY`` (ring size in events, default 65536).
+
+On a watchdog trip (``MLSLTimeoutError``) the flight recorder dumps the
+trailing window of spans to ``trace-crash-<ts>.json`` automatically.
+"""
+
+from mlsl_tpu.obs.tracer import (  # noqa: F401
+    DEFAULT_CAPACITY,
+    Tracer,
+    disable,
+    enable,
+    enabled,
+    get_tracer,
+    span,
+    trace_dir,
+)
+from mlsl_tpu.obs.export import (  # noqa: F401
+    flight_record,
+    render,
+    summarize,
+    to_trace_events,
+    write_trace,
+)
+
+__all__ = [
+    "DEFAULT_CAPACITY",
+    "Tracer",
+    "disable",
+    "enable",
+    "enabled",
+    "get_tracer",
+    "span",
+    "trace_dir",
+    "flight_record",
+    "render",
+    "summarize",
+    "to_trace_events",
+    "write_trace",
+]

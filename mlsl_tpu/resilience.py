@@ -21,6 +21,7 @@ import jax
 
 from mlsl_tpu.checkpoint import CheckpointManager, restore_trainer, save_trainer
 from mlsl_tpu.log import MLSLError, log_info, log_warning
+from mlsl_tpu.obs import tracer as obs
 
 
 # MLSLError subclasses RuntimeError; ValueError is deliberately NOT recoverable
@@ -119,6 +120,8 @@ class FaultTolerantLoop:
     def _recover(self, trainer, error) -> tuple:
         """Tear down, rebuild, restore. -> (trainer, resume_step)."""
         self.recoveries += 1
+        tr = obs._tracer
+        t0 = tr.now() if tr is not None else 0
         log_info("recovering from %s: %s", type(error).__name__, error)
         # drain in-flight async saves first: restoring from a half-committed step
         # (or re-saving a step whose original write is still in flight) corrupts
@@ -147,6 +150,12 @@ class FaultTolerantLoop:
             )
         trainer = self.make_trainer()
         restored = restore_trainer(self.ckpt, trainer)
+        if tr is not None:
+            # one span per recovery cycle: drain + teardown + rebuild +
+            # restore — on the timeline this is the gap a fault cost the run
+            tr.complete("recover", "resilience", t0,
+                        error=type(error).__name__, recovery=self.recoveries,
+                        resumed_step=restored if restored is not None else -1)
         return trainer, (restored + 1 if restored is not None else 0)
 
     def run(self, batch_fn: Callable, steps: int, on_step: Optional[Callable] = None):
@@ -201,6 +210,9 @@ class FaultTolerantLoop:
                     # failure here must not abort the graceful exit — the last
                     # cadence checkpoint remains the resume point
                     self.preempted = True
+                    if obs._tracer is not None:
+                        obs._tracer.instant("preemption", "resilience",
+                                            step=step)
                     try:
                         if last_saved != step:
                             log_info(

@@ -1,0 +1,71 @@
+#!/usr/bin/env python
+"""Terminal viewer for mlsl_tpu trace files (obs/export.py output).
+
+Summarizes a Chrome/Perfetto trace_event JSON — per-(cat, name) span
+statistics, busiest tracks, slowest spans, instant counts — without leaving
+the terminal; load the same file in ui.perfetto.dev or chrome://tracing for
+the graphical timeline.
+
+Usage:
+    python scripts/trace_view.py trace-<ts>.json [--top N] [--tail N]
+
+``--tail N`` additionally prints the last N events in time order (the
+flight-recorder reading mode: what happened right before the trip).
+"""
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), ".."))
+
+
+def tail_lines(doc: dict, n: int) -> str:
+    """The last ``n`` events in end-time order, one line each."""
+    names = {
+        e["tid"]: e.get("args", {}).get("name", str(e["tid"]))
+        for e in doc.get("traceEvents", [])
+        if e.get("ph") == "M" and e.get("name") == "thread_name"
+    }
+    evs = [e for e in doc.get("traceEvents", []) if e.get("ph") in ("X", "i")]
+    evs.sort(key=lambda e: e.get("ts", 0.0) + e.get("dur", 0.0))
+    out = ["", f"last {min(n, len(evs))} events:"]
+    for e in evs[-n:]:
+        dur = f" dur={e['dur'] / 1e3:.3f}ms" if "dur" in e else ""
+        args = e.get("args")
+        out.append(
+            f"  t={e.get('ts', 0.0) / 1e3:>10.3f}ms [{e.get('ph')}] "
+            f"{e.get('cat', '?')}:{e.get('name')} @ "
+            f"{names.get(e.get('tid'), e.get('tid'))}{dur}"
+            + (f"  {args}" if args else "")
+        )
+    return "\n".join(out)
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("trace", help="trace-*.json / trace-crash-*.json file")
+    ap.add_argument("--top", type=int, default=10,
+                    help="rows in the busiest/slowest listings")
+    ap.add_argument("--tail", type=int, default=0,
+                    help="also print the last N events in time order")
+    args = ap.parse_args()
+
+    from mlsl_tpu.obs.export import summarize
+
+    with open(args.trace) as f:
+        doc = json.load(f)
+    meta = doc.get("otherData", {})
+    if meta:
+        kind = meta.get("kind", "trace")
+        reason = meta.get("reason")
+        print(f"{args.trace}: {kind}" + (f" ({reason})" if reason else ""))
+    print(summarize(doc, top=args.top))
+    if args.tail:
+        print(tail_lines(doc, args.tail))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -41,6 +41,15 @@ def _clean_singleton():
         Environment._instance.finalize()
 
 
+@pytest.fixture(autouse=True)
+def _route_artifacts(tmp_path, monkeypatch):
+    """Route mlsl_stats.log and trace-*.json into the test's tmp dir: a test
+    run must never litter the CWD (core/stats.stats_path and obs.trace_dir
+    both resolve their env var per call)."""
+    monkeypatch.setenv("MLSL_STATS_DIR", str(tmp_path))
+    monkeypatch.setenv("MLSL_TRACE_DIR", str(tmp_path))
+
+
 def ref_coords(p, data_parts, model_parts):
     """The reference's rank->color math (src/mlsl_impl.hpp:224-240), used as the
     oracle for grid tests."""
