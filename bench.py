@@ -404,6 +404,13 @@ def _overlap_probe_cpu_mesh(timeout: float = 600.0):
     env_vars.pop("MLSL_CHAOS", None)
     env_vars.pop("MLSL_WATCHDOG_TIMEOUT", None)
     env_vars.pop("MLSL_TRACE", None)
+    # a chip-run tuner sweep (MLSL_TUNE) must not re-run — or its chip-keyed
+    # profile load — inside the CPU-mesh probe (mismatched fingerprint), and
+    # a chip-targeted algorithm override must not reroute the probe's
+    # baseline collectives either
+    env_vars.pop("MLSL_TUNE", None)
+    env_vars.pop("MLSL_TUNE_PROFILE", None)
+    env_vars.pop("MLSL_ALGO", None)
     try:
         out = subprocess.run(
             [sys.executable, "-c", _OVERLAP_PROBE_SRC],

@@ -116,6 +116,9 @@ def main():
              [sys.executable, "benchmarks/quant_bucket_bench.py"], 1800),
             ("trace_overhead",
              [sys.executable, "benchmarks/trace_overhead_bench.py"], 900),
+            ("algo_sweep",
+             [sys.executable, "benchmarks/algo_sweep_bench.py", "--quant"],
+             1800),
             ("grid_collectives",
              [sys.executable, "benchmarks/grid_collectives.py"], 1200),
             ("transformer",

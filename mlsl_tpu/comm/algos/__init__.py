@@ -1,0 +1,241 @@
+"""Collective algorithm engine: multiple lowering strategies per collective.
+
+The reference eplib ships TWO allreduce implementations — the MPI-native one
+and a recursive-halving/doubling priority allreduce (eplib/allreduce_pr.c) —
+selected by environment knobs. Our TPU port until now lowered every
+collective to exactly one ``lax`` program. This package restores (and
+extends) the algorithm dimension:
+
+- ``lax``     — the single-shot XLA-native body (comm/collectives.py): psum /
+                psum_scatter / gather emulation. The baseline and the
+                heuristic default; untuned behavior is bit-for-bit this.
+- ``rhd``     — recursive halving/doubling composed from the pairwise
+                exchange primitive (``lax.ppermute``, the same op behind the
+                sendrecv body): log2(G) rounds of halving (reduce-scatter)
+                and doubling (all-gather), with the classic pre/post fold
+                remainder step for non-power-of-two groups. Paper parity
+                with eplib/allreduce_pr.c. Latency-optimal round count.
+- ``ring2d``  — hierarchical ring-of-rings for multi-axis (torus) groups:
+                reduce-scatter along the minor mesh axis, reduce over the
+                remaining axes, all-gather back along the minor axis. Each
+                phase rides ONE physical ICI ring instead of asking XLA to
+                fuse a reduction over the whole sub-torus (EQuARX/DynamiQ
+                both report the multi-hop topology-aware decomposition is
+                where large-group allreduce wins live).
+
+Selection (``select``) is keyed by (kind, payload bytes, group shape,
+compression) with strict precedence:
+
+    explicit config (MLSL_ALGO)  >  tuned profile (mlsl_tpu.tuner)  >
+    heuristic default ("lax")
+
+The heuristic default is deliberately the baseline: with no explicit knob
+and no measured profile the dispatched programs are bit-for-bit what they
+were before this engine existed. Only a measurement (the tuner) or an
+explicit operator override changes the program.
+
+Programs built here are cached in the SAME cache as the baseline
+(collectives._cache) with the algorithm name in the key, wrapped in the same
+chaos-dispatch instrumentation, and therefore cleared by
+collectives.clear_cache() and warmed by MLSL_PRECOMPILE like every other
+collective program (the plan-cache key carries the algorithm identity —
+core/session.py).
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional, Tuple
+
+import numpy as np
+
+from mlsl_tpu.comm.mesh import ProcessGroup
+from mlsl_tpu.log import log_debug, mlsl_assert
+from mlsl_tpu.types import CompressionType, ReductionType
+
+#: the baseline algorithm: the single-shot lax program (comm/collectives.py)
+DEFAULT = "lax"
+
+#: engine kinds: only elementwise-reduction collectives have alternative
+#: lowerings (the reference's algorithm choice is likewise allreduce-only)
+ENGINE_KINDS = ("allreduce", "reduce_scatter")
+
+
+def group_shape(group: ProcessGroup) -> Tuple[int, ...]:
+    """The selection-table shape key for a group: per-axis member counts for
+    axis-aligned groups (major -> minor, degenerate size-1 axes dropped so a
+    4-axis global group over a (1, 4, 1, 2) grid and a 2-axis (4, 2) group
+    share one profile cell), ``(-G,)`` for color groups (the sign marks
+    'color' so a color group never aliases a 1D axis group of the same
+    size)."""
+    if group.colors is not None:
+        return (-int(group.size),)
+    topo = group.topology
+    sizes = dict(zip(topo.mesh.axis_names, topo.mesh.devices.shape))
+    shape = tuple(int(sizes[a]) for a in group.axes if sizes[a] > 1)
+    return shape or (1,)
+
+
+def _eligible_rhd(kind: str, group: ProcessGroup, op) -> bool:
+    # uniform groups only (the pairwise schedule needs equal member counts);
+    # any op (pairwise combine handles MIN/MAX, unlike ring/scatter forms)
+    if group.is_self or not group.is_uniform:
+        return False
+    if group.size <= 1:
+        return False
+    if kind == "reduce_scatter" and op not in (None, ReductionType.SUM,
+                                               ReductionType.MIN,
+                                               ReductionType.MAX):
+        return False
+    return True
+
+
+def _eligible_ring2d(kind: str, group: ProcessGroup, op) -> bool:
+    # SUM only (the scatter phases are psum_scatter) on axis-aligned groups
+    # spanning >= 2 non-degenerate mesh axes (a real sub-torus)
+    if group.colors is not None or op not in (None, ReductionType.SUM):
+        return False
+    live = [s for s in group_shape(group) if s > 1]
+    if len(live) < 2:
+        return False
+    if kind == "reduce_scatter" and len(live) != 2:
+        # the 2-phase scatter placement math is 2D; >2 live axes fall back
+        return False
+    return True
+
+
+#: name -> eligibility predicate; builders are resolved lazily (the bodies
+#: import jax)
+_ELIGIBLE = {
+    "lax": lambda kind, group, op: True,
+    "rhd": _eligible_rhd,
+    "ring2d": _eligible_ring2d,
+}
+
+ALGORITHMS = tuple(_ELIGIBLE)
+
+
+def eligible(algo: str, kind: str, group: ProcessGroup, op=None) -> bool:
+    """Can ``algo`` lower (kind, group, op)? Unknown names are never eligible."""
+    if kind not in ENGINE_KINDS:
+        return algo == DEFAULT
+    pred = _ELIGIBLE.get(algo)
+    return bool(pred and pred(kind, group, op))
+
+
+def candidates(kind: str, group: ProcessGroup, op=None) -> Tuple[str, ...]:
+    """Every algorithm eligible for (kind, group, op), baseline first."""
+    return tuple(a for a in ALGORITHMS if eligible(a, kind, group, op))
+
+
+def parse_forced(spec: str) -> dict:
+    """Parse MLSL_ALGO: either one algorithm name (forced for every engine
+    kind) or a comma list of kind=name entries. Raises MLSLError (via
+    mlsl_assert) on unknown algorithm or kind names — the config-validation
+    contract: a contradictory setting fails at init, not deep in dispatch."""
+    spec = (spec or "").strip()
+    out: dict = {}
+    if not spec:
+        return out
+    if "=" not in spec:
+        mlsl_assert(
+            spec in ALGORITHMS,
+            "MLSL_ALGO %r is not a registered collective algorithm "
+            "(registry: %s)", spec, ", ".join(ALGORITHMS),
+        )
+        out["*"] = spec
+        return out
+    for part in spec.split(","):
+        part = part.strip()
+        if not part:
+            continue
+        mlsl_assert("=" in part, "MLSL_ALGO entry %r is not kind=algo", part)
+        kind, _, name = part.partition("=")
+        kind, name = kind.strip(), name.strip()
+        mlsl_assert(
+            kind in ENGINE_KINDS,
+            "MLSL_ALGO kind %r is not an engine collective (expected one of "
+            "%s)", kind, ", ".join(ENGINE_KINDS),
+        )
+        mlsl_assert(
+            name in ALGORITHMS,
+            "MLSL_ALGO %r for kind %r is not a registered collective "
+            "algorithm (registry: %s)", name, kind, ", ".join(ALGORITHMS),
+        )
+        out[kind] = name
+    return out
+
+
+def select(
+    kind: str,
+    group: ProcessGroup,
+    payload_bytes: int,
+    compression: CompressionType,
+    config,
+    op=None,
+) -> str:
+    """The selection table: explicit config > tuned profile > heuristic
+    default. An explicit or tuned choice that is not eligible for this
+    (kind, group, op) falls back to the baseline with a debug log — forcing
+    ``rhd`` globally must not break the ragged-color-group requests the
+    pairwise schedule cannot serve."""
+    if kind not in ENGINE_KINDS or config is None:
+        return DEFAULT
+    if compression != CompressionType.NONE:
+        # compressed collectives have their own wire formats (quant ring /
+        # sparse top-k); the engine's dense algorithms do not apply. The
+        # selection key still carries compression so tuned profiles can hold
+        # per-compression knob cells (tuner).
+        return DEFAULT
+    forced = getattr(config, "_forced_algos", None)
+    if forced:
+        name = forced.get(kind) or forced.get("*")
+        if name:
+            if eligible(name, kind, group, op):
+                return name
+            log_debug(
+                "forced algorithm %s not eligible for %s on group %s; "
+                "falling back to %s", name, kind, group_shape(group), DEFAULT,
+            )
+            return DEFAULT
+    profile = getattr(config, "tuned_profile", None)
+    if profile is not None:
+        name = profile.select(kind, group_shape(group), compression,
+                              payload_bytes)
+        if name and name != DEFAULT:
+            if eligible(name, kind, group, op):
+                return name
+            log_debug(
+                "tuned algorithm %s not eligible for %s on group %s; "
+                "falling back to %s", name, kind, group_shape(group), DEFAULT,
+            )
+    return DEFAULT
+
+
+def build(kind: str, group: ProcessGroup, dtype, algo: str, **kw) -> Callable:
+    """Build (or fetch) the compiled program for ``algo``: global distributed
+    buffer -> global result buffer, the exact calling convention of
+    collectives.build_collective. ``algo='lax'`` IS build_collective — same
+    cache entry, same key, bit-for-bit the baseline program."""
+    from mlsl_tpu.comm import collectives
+
+    if algo == DEFAULT:
+        return collectives.build_collective(kind, group, dtype, **kw)
+    mlsl_assert(
+        eligible(algo, kind, group, kw.get("op")),
+        "algorithm %s cannot lower %s on group shape %s",
+        algo, kind, group_shape(group),
+    )
+    key = (
+        "algo", algo, kind, collectives._group_key(group),
+        np.dtype(dtype).str, tuple(sorted(kw.items())),
+    )
+    fn = collectives._cache.get(key)
+    if fn is not None:
+        return fn
+    if algo == "rhd":
+        from mlsl_tpu.comm.algos import rhd as impl
+    else:
+        from mlsl_tpu.comm.algos import ring2d as impl
+    fn = collectives._chaos_dispatch(impl.build(kind, group, **kw), kind)
+    collectives._cache[key] = fn
+    return fn

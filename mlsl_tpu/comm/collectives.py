@@ -746,17 +746,28 @@ def build_collective(kind: str, group: ProcessGroup, dtype, **kw) -> Callable:
         raw = _AXIS_BODIES[kind]
         body = functools.partial(raw, axes=group.axes, sizes=sizes, **kw)
 
+    fn = _chaos_dispatch(
+        _build_axis(body, mesh, kind, group.axes or "color"), kind
+    )
+    _cache[key] = fn
+    return fn
+
+
+def _build_axis(body, mesh, kind: str, tag) -> Callable:
+    """Compile a squeezed-local (n,) -> (out_n,) body over the 4-axis grid mesh,
+    accepting/returning the standard (R, D, S, M, n) distributed buffer — the
+    axis-aligned counterpart of _build_flat, shared with the algorithm engine
+    (comm/algos)."""
+
     def local_fn(x):  # x: (1, 1, 1, 1, n)
         # named_scope puts the collective's identity on the DEVICE timeline (the
         # host-side TraceAnnotation in CommRequest only covers the async enqueue)
-        with jax.named_scope(f"mlsl_{kind}_{group.axes or 'color'}"):
+        with jax.named_scope(f"mlsl_{kind}_{tag}"):
             out = body(x.reshape(x.shape[NUM_GRID_AXES:]))
         return out[None, None, None, None]
 
     sm = _shard_map(local_fn, mesh=mesh, in_specs=_BUF_SPEC, out_specs=_BUF_SPEC)
-    fn = _chaos_dispatch(jax.jit(sm), kind)
-    _cache[key] = fn
-    return fn
+    return jax.jit(sm)
 
 
 def _build_flat(body, topo, kind: str, tag) -> Callable:

@@ -247,7 +247,8 @@ def _chaos_roundtrip(fn: Callable) -> Callable:
         t0 = tr.now()
         out = fn(buf, err)
         tr.complete("quant.roundtrip", "quant", t0,
-                    elems=int(buf.shape[-1]) if hasattr(buf, "shape") else 0)
+                    elems=int(buf.shape[-1]) if hasattr(buf, "shape") else 0,
+                    algo="quant_ring")
         return out
 
     roundtrip.__wrapped__ = fn

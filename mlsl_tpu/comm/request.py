@@ -39,6 +39,8 @@ from mlsl_tpu.log import (
     log_warning,
 )
 from mlsl_tpu.comm import collectives
+from mlsl_tpu.comm import algos
+from mlsl_tpu.core import stats as stats_mod
 from mlsl_tpu.types import (
     CompressionType,
     DataType,
@@ -105,6 +107,11 @@ class CommRequest:
         self._errs: Optional[List[jax.Array]] = None
         self.is_started = False
         self.is_setup = False
+        # which program family carries this request's collective: a comm/algos
+        # registry name ('lax'/'rhd'/'ring2d') for the dense engine kinds, or
+        # the compressed wire family ('quant_ring'/'custom_codec'/'topk').
+        # Resolved at setup(); traces, stats, and describe() all report it.
+        self.algo = algos.DEFAULT
         self._epoch = 0
         self._dlock = threading.Lock()  # serializes dispatch vs restart
         self._dispatch_error: Optional[BaseException] = None
@@ -139,6 +146,7 @@ class CommRequest:
                 d.kind, d.group, d.count, self.dispatcher.config.topk_ratio
             )
             self._chunk_slices = [slice(None)]
+            self.algo = "topk"
             self.is_setup = True
             return
         if d.compression == CompressionType.QUANTIZATION and d.kind in (
@@ -152,6 +160,7 @@ class CommRequest:
             )
             _check_recv_count(d)
             codec = getattr(self.dispatcher.config, "custom_codec", None)
+            self.algo = "custom_codec" if codec is not None else "quant_ring"
             if codec is not None:
                 # user-pluggable codec (reference dlopen contract,
                 # quant/quant.c:96-133): compressed ring wire, framework-owned
@@ -214,12 +223,21 @@ class CommRequest:
             kw.update(_normalize_alltoallv(d))
 
         dtype = jnp_dtype(d.data_type)
+        # Algorithm selection (comm/algos): explicit config > tuned profile >
+        # the 'lax' baseline. 'lax' routes through build_collective unchanged
+        # — same cache entry, same program, bit-for-bit the untuned behavior.
+        # Chunked requests select once on the FULL payload (the knob the
+        # operator reasons about) and reuse one program across chunks.
+        self.algo = algos.select(
+            d.kind, d.group, self._payload, d.compression,
+            self.dispatcher.config, op=kw.get("op"),
+        )
         chunks = self._plan_chunks()
         if chunks is None:
-            self._fns = [collectives.build_collective(d.kind, d.group, dtype, **kw)]
+            self._fns = [algos.build(d.kind, d.group, dtype, self.algo, **kw)]
             self._chunk_slices = [slice(None)]
         else:
-            fn = collectives.build_collective(d.kind, d.group, dtype, **kw)
+            fn = algos.build(d.kind, d.group, dtype, self.algo, **kw)
             self._fns = [fn] * len(chunks)
             self._chunk_slices = chunks
         # hot-path precomputation: the per-layer dispatch floor must stay in
@@ -363,11 +381,17 @@ class CommRequest:
                 if tr is not None:
                     # host-side enqueue span: XLA's async dispatch returns
                     # before the device finishes, so this measures launch
-                    # cost; device completion lands in the wait span
+                    # cost; device completion lands in the wait span. The
+                    # algo arg attributes the time to the program family the
+                    # selection table chose (comm/algos).
                     tr.complete("dispatch", "req", t0, track=self._trace_name,
-                                req=self.name or self.uid, epoch=self._epoch)
+                                req=self.name or self.uid, epoch=self._epoch,
+                                algo=self.algo)
 
     def _dispatch_inner(self, buf: jax.Array) -> None:
+        # per-algorithm launch attribution (ALGO line in mlsl_stats.log);
+        # one dict upsert — stays under the per-layer dispatch-floor budget
+        stats_mod.record_algo_dispatch(self.desc.kind, self.algo)
         # Cross-distribution edges (redistribution cases 3-5) hand a buffer laid
         # out for the OTHER distribution's grid; re-view it onto this request's
         # group topology (device-local, no transfer — see Topology.adopt_buffer).
@@ -430,8 +454,8 @@ class CommRequest:
         """One-line stuck-request descriptor for the watchdog log."""
         d = self.desc
         return (
-            f"{d.kind} name={self.name or self.uid} count={d.count} "
-            f"dtype={d.data_type.name} axes={d.group.axes} "
+            f"{d.kind} name={self.name or self.uid} algo={self.algo} "
+            f"count={d.count} dtype={d.data_type.name} axes={d.group.axes} "
             f"payload={self._payload}B epoch={self._epoch}"
         )
 
@@ -447,8 +471,6 @@ class CommRequest:
             tr.instant("watchdog.trip", "watchdog", track=self._trace_name,
                        req=self.name or self.uid, phase=phase,
                        waited_s=round(waited, 3), descriptor=desc)
-        from mlsl_tpu.core import stats as stats_mod
-
         stats_mod.record_watchdog_event(desc, phase, waited)
         raise MLSLTimeoutError(
             f"watchdog: request stuck in {phase} for {waited:.2f}s: {desc}"
@@ -494,9 +516,13 @@ class CommRequest:
         if tr is not None:
             # the wait STALL: host time blocked for this request (dispatch
             # race + device completion) — the per-op overlap-loss signal
-            # behind Statistics.overlap_report's p50/p95 fields
+            # behind Statistics.overlap_report's p50/p95 fields. algo rides
+            # along because THIS span holds the wire time the per-algorithm
+            # trace summary (obs/export.summarize) attributes — the dispatch
+            # span alone is only the async enqueue cost.
             tr.complete("wait", "req", t0, track=self._trace_name,
-                        req=self.name or self.uid, epoch=self._epoch)
+                        req=self.name or self.uid, epoch=self._epoch,
+                        algo=self.algo)
         return out
 
     def test(self) -> tuple:

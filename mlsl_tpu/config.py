@@ -43,6 +43,7 @@ _ENV_FIELDS = {
     "MLSL_GATHER_DEVICE_LIMIT_MB": "gather_device_limit_mb",
     "MLSL_GRAD_BUCKET_MB": "grad_bucket_mb",
     "MLSL_NUM_SERVERS": "num_servers",
+    "MLSL_QUANT_BLOCK_ELEMS": "quant_block_elems",
 }
 
 
@@ -85,6 +86,28 @@ class Config:
     # eplib/allreduce_pr.c:69-278). Requests deferred within the window are
     # launched together, newest first.
     msg_priority_flush_ms: float = 2.0  # MLSL_MSG_PRIORITY_FLUSH_MS
+
+    # --- collective algorithm engine (comm/algos) + autotuner (tuner/) ---
+    # Forced algorithm selection: '' = auto (tuned profile, else the 'lax'
+    # baseline). Either one registry name ('rhd') applied to every engine
+    # kind, or a comma list of kind=name entries
+    # ('allreduce=rhd,reduce_scatter=ring2d'). Validated against the
+    # registry at init (validate()) — an unknown name is an MLSLError there,
+    # not a failure deep in dispatch.
+    collective_algo: str = ""       # MLSL_ALGO
+    # Run the topology autotuner at Environment.init: sweep candidate
+    # algorithms x chunk/bucket/priority knobs on the live mesh and persist
+    # the winning table to ``tune_profile`` (tuner/).
+    tune: bool = False              # MLSL_TUNE
+    # Profile path: read at init when set (MLSL_TUNE=0), written when the
+    # sweep runs (MLSL_TUNE=1). '' = the default mlsl_tune_profile.json in
+    # MLSL_STATS_DIR (or CWD). A profile whose topology fingerprint does not
+    # match the probed hardware is rejected with a warning; a missing or
+    # corrupt file is an MLSLError at init.
+    tune_profile: str = ""          # MLSL_TUNE_PROFILE
+    # Loaded tuner.TunedProfile (or None): consulted by comm/algos.select
+    # for every engine collective. Set by Environment.init, never from env.
+    tuned_profile: object = None
 
     # --- compression ---
     quant_block_elems: int = 256
@@ -140,6 +163,48 @@ class Config:
     # reference has no analog because MPI has no compile step). Empty = off.
     compile_cache_dir: str = ""     # MLSL_COMPILE_CACHE_DIR
 
+    def validate(self) -> None:
+        """Reject contradictory or unserviceable settings with a clear
+        MLSLError at init time instead of failing deep in dispatch. Parses
+        ``collective_algo`` into the ``_forced_algos`` dict comm/algos.select
+        consults (raising on names not in the registry); basic range sanity
+        on the numeric knobs the engine and tuner rely on. Profile-file
+        errors (missing/corrupt MLSL_TUNE_PROFILE) are raised by
+        mlsl_tpu.tuner.init_profile, which Environment.init calls right after
+        this."""
+        from mlsl_tpu.comm import algos
+        from mlsl_tpu.log import mlsl_assert
+
+        self._forced_algos = algos.parse_forced(self.collective_algo)
+        mlsl_assert(
+            self.large_msg_size_mb >= 0,
+            "MLSL_LARGE_MSG_SIZE_MB must be >= 0 (got %d)",
+            self.large_msg_size_mb,
+        )
+        mlsl_assert(
+            self.large_msg_chunks >= 1,
+            "MLSL_LARGE_MSG_CHUNKS must be >= 1 (got %d)",
+            self.large_msg_chunks,
+        )
+        mlsl_assert(
+            self.quant_block_elems > 0,
+            "MLSL_QUANT_BLOCK_ELEMS must be > 0 (got %d)",
+            self.quant_block_elems,
+        )
+        mlsl_assert(
+            0.0 < self.topk_ratio <= 1.0,
+            "MLSL_TOPK_RATIO must be in (0, 1] (got %r)", self.topk_ratio,
+        )
+        mlsl_assert(
+            self.grad_bucket_mb >= 0,
+            "MLSL_GRAD_BUCKET_MB must be >= 0 (got %d)", self.grad_bucket_mb,
+        )
+        mlsl_assert(
+            self.watchdog_timeout_s >= 0,
+            "MLSL_WATCHDOG_TIMEOUT must be >= 0 (got %r)",
+            self.watchdog_timeout_s,
+        )
+
     @staticmethod
     def from_env() -> "Config":
         c = Config()
@@ -170,6 +235,9 @@ class Config:
         c.msg_priority_flush_ms = _env_float(
             "MLSL_MSG_PRIORITY_FLUSH_MS", c.msg_priority_flush_ms
         )
+        c.collective_algo = os.environ.get("MLSL_ALGO", c.collective_algo)
+        c.tune = _env_bool("MLSL_TUNE", c.tune)
+        c.tune_profile = os.environ.get("MLSL_TUNE_PROFILE", c.tune_profile)
         c.quant_block_elems = _env_int("MLSL_QUANT_BLOCK_ELEMS", c.quant_block_elems)
         c.topk_ratio = _env_float("MLSL_TOPK_RATIO", c.topk_ratio)
         c.watchdog_timeout_s = _env_float("MLSL_WATCHDOG_TIMEOUT", c.watchdog_timeout_s)

@@ -260,7 +260,8 @@ class GradBucket:
                     tr.complete("bucket.pack", "bucket", t0,
                                 track=self.req._trace_name, kind=self.kind,
                                 members=len(self.members),
-                                bytes=self._coalesced_bytes)
+                                bytes=self._coalesced_bytes,
+                                algo=self.req.algo)
                 self._dispatched = True
                 stats_mod.record_bucket_round(
                     "dispatched", self.kind, members=len(self.members),

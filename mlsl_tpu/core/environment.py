@@ -83,9 +83,25 @@ class Environment:
         self.config = Config.from_env()
         set_log_level(self.config.log_level)
         sysinfo.auto_config(self.config)
-        self._apply_compile_cache()
-        self.dispatcher = Dispatcher(self.config)
+        # fail-fast validation (MLSLError): contradictory settings — an
+        # MLSL_ALGO name outside the registry, nonsensical knob ranges — are
+        # init-time errors, not latent dispatch failures
+        self.config.validate()
         self.devices = tuple(devices) if devices is not None else tuple(jax.devices())
+        # the persistent XLA cache must be armed BEFORE the tuner sweep: the
+        # sweep compiles every eligible algorithm x size x shape program, and
+        # on real chips those compiles are the tens-of-seconds cost the cache
+        # exists to amortize across restarts
+        self._apply_compile_cache()
+        # autotuner hook: MLSL_TUNE=1 sweeps and persists a profile on the
+        # live mesh; MLSL_TUNE_PROFILE loads one (stale fingerprints rejected
+        # with a warning, missing/corrupt files raise). Sets
+        # config.tuned_profile, which comm/algos.select consults, and applies
+        # tuned chunk/bucket/priority knobs (explicit env always wins).
+        from mlsl_tpu import tuner
+
+        tuner.init_profile(self.config, self.devices)
+        self.dispatcher = Dispatcher(self.config)
         self._initialized = True
         self._init_pid = os.getpid()
         if self.quant_params is not None:

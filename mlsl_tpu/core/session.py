@@ -343,11 +343,15 @@ class Session:
             if d.compression != CompressionType.NONE:
                 codec_key = (cfg.quant_block_elems, cfg.topk_ratio,
                              id(cfg.custom_codec))
+            # the algorithm identity is part of the plan key: a profile (or
+            # MLSL_ALGO) switching a request from 'lax' to 'rhd' between
+            # sessions compiles a DIFFERENT program, and a stale plan entry
+            # recorded under the old algorithm must not skip warming it
             key = (
                 "req", d.kind, _group_key(d.group), int(d.data_type), d.count,
                 int(d.compression), d.recv_count,
                 None if d.op is None else int(d.op), d.root,
-                len(req._chunk_slices), codec_key,
+                len(req._chunk_slices), codec_key, req.algo,
             )
             if key in _plan_cache:
                 return

@@ -135,6 +135,25 @@ def reset_bucket_counters() -> None:
     BUCKET_EVENTS.clear()
 
 
+# Per-algorithm dispatch accounting (comm/algos): process-wide like the
+# bucket counters — dispatch fires at the request layer with no Session
+# handle. Key = (kind, algorithm name); value = launches. The point: traces
+# and stats must attribute wire time to the ALGORITHM that ran, or a tuned
+# profile's effect is invisible in the logs it was tuned from.
+ALGO_COUNTERS: Dict[Tuple[str, str], int] = {}
+
+
+def record_algo_dispatch(kind: str, algo: str) -> None:
+    """One collective launch under ``algo`` (called by CommRequest._dispatch
+    on the hot path: a dict upsert, no allocation beyond the first key)."""
+    key = (kind, algo)
+    ALGO_COUNTERS[key] = ALGO_COUNTERS.get(key, 0) + 1
+
+
+def reset_algo_counters() -> None:
+    ALGO_COUNTERS.clear()
+
+
 #: jax monitoring event fired once per XLA backend compilation — the
 #: compile-count probe behind the MLSL_PRECOMPILE acceptance check.
 BACKEND_COMPILE_EVENT = "/jax/core/compile/backend_compile_duration"
@@ -489,6 +508,16 @@ class Statistics:
                         f" wait_p95 {obs._percentile(durs, 95) / 1e6:.2f} ms"
                     )
             lines.append(bucket_line)
+        if ALGO_COUNTERS:
+            # per-algorithm dispatch attribution (comm/algos): which program
+            # family actually carried each collective kind this run
+            parts = [
+                f"{kind}:{algo}={n}"
+                for (kind, algo), n in sorted(ALGO_COUNTERS.items())
+            ]
+            lines.append(
+                f"{'ALGO':<16} {'DISPATCH':<8} " + " ".join(parts)
+            )
         text = "\n".join(lines) + "\n"
         try:
             with open(path, "a") as f:

@@ -200,6 +200,24 @@ def summarize(doc: dict, top: int = 10) -> str:
                 f"{tracer_mod._percentile(durs, 95):>9.3f} "
                 f"{durs[-1]:>9.3f}"
             )
+    # per-algorithm attribution: dispatch/wait spans carry the selected
+    # collective algorithm (comm/algos) in their args, so a tuned profile's
+    # program switch is visible directly in the trace summary
+    by_algo: Dict[str, List[float]] = {}
+    for e in spans:
+        algo = (e.get("args") or {}).get("algo")
+        if algo:
+            by_algo.setdefault(str(algo), []).append(e.get("dur", 0.0) / 1e3)
+    if by_algo:
+        lines.append("")
+        lines.append(f"{'algorithm':<14} {'spans':>6} {'total ms':>10} "
+                     f"{'p95 ms':>9}")
+        for algo, durs in sorted(by_algo.items(), key=lambda kv: -sum(kv[1])):
+            durs.sort()
+            lines.append(
+                f"{algo:<14} {len(durs):>6} {sum(durs):>10.2f} "
+                f"{tracer_mod._percentile(durs, 95):>9.3f}"
+            )
     busiest: Dict[int, float] = {}
     for e in spans:
         busiest[e["tid"]] = busiest.get(e["tid"], 0.0) + e.get("dur", 0.0)

@@ -67,6 +67,22 @@ def probe() -> SysInfo:
     )
 
 
+def topology_fingerprint() -> dict:
+    """The identity a tuner profile (mlsl_tpu.tuner) is keyed by: measured
+    algorithm selections transfer exactly to the hardware they were measured
+    on — same platform, same chip generation, same world size and host
+    spread. A profile whose fingerprint disagrees with the probe is stale
+    (different machine / different slice shape) and must be re-measured, the
+    same contract as the reference's AutoConfig re-probing per launch."""
+    si = probe()
+    return {
+        "platform": si.platform,
+        "device_kind": si.device_kind,
+        "num_devices": si.num_devices,
+        "num_hosts": si.num_hosts,
+    }
+
+
 def device_class(si: SysInfo) -> str:
     """Coarse tuning class from the probed device kind (the analog of the
     reference's Xeon-vs-Phi x ETH-vs-MLX-vs-HFI matrix, src/sysinfo.hpp:27-48):

@@ -1,0 +1,96 @@
+"""Topology-aware autotuner: measured algorithm + knob selection.
+
+The missing half of the algorithm engine (comm/algos): the engine provides
+CHOICE, this package provides the MEASUREMENT that justifies one. A sweep
+(`sweep.run_sweep`) times every eligible algorithm per (kind, payload, group
+shape) on the live mesh and derives the chunk/bucket/priority knobs from the
+measured dispatch floor and algbw; the result persists as a JSON profile
+(`profile.TunedProfile`) keyed by a ``sysinfo`` topology fingerprint, and
+``init_profile`` loads it at Environment.init so every subsequent
+CommRequest.setup consults the tuned table.
+
+Operator surface (docs/TUNING.md §10):
+    MLSL_TUNE=1          run the sweep at init and persist + use the profile
+    MLSL_TUNE_PROFILE=f  profile path (read when MLSL_TUNE=0, written when 1);
+                         default mlsl_tune_profile.json in MLSL_STATS_DIR/CWD
+    MLSL_TUNE_SIZES      swept payloads, KiB, comma separated (tests/benches)
+    MLSL_TUNE_ITERS      timing iterations per cell
+
+Selection precedence stays: explicit config (MLSL_ALGO / exported MLSL_*
+knobs) > tuned profile > heuristic defaults. Tuned knobs never override a
+knob the user exported explicitly (the Config._explicit contract shared with
+sysinfo.auto_config), and with neither MLSL_TUNE nor MLSL_TUNE_PROFILE set
+this package never runs — untuned behavior is bit-for-bit unchanged.
+"""
+
+from __future__ import annotations
+
+from mlsl_tpu.log import log_info, log_warning
+from mlsl_tpu.tuner.profile import (  # noqa: F401  (public API)
+    DEFAULT_PROFILE_FILE,
+    KNOB_RANGES,
+    TunedProfile,
+    default_profile_path,
+    load_profile,
+)
+from mlsl_tpu.tuner.sweep import run_sweep  # noqa: F401
+
+#: Config fields a profile's knob table may set (anything else in ``knobs``
+#: is measurement metadata, ignored on apply); ranges enforced at load
+#: (profile.KNOB_RANGES)
+TUNABLE_KNOBS = tuple(KNOB_RANGES)
+
+
+def apply_knobs(config, profile: TunedProfile) -> None:
+    """Apply a profile's tuned knobs to the config — except knobs the user
+    exported explicitly (Config._explicit), which always win (the same
+    contract as sysinfo.auto_config and the reference's AutoConfig)."""
+    explicit = getattr(config, "_explicit", set())
+    for name in TUNABLE_KNOBS:
+        if name in profile.knobs and name not in explicit:
+            setattr(config, name, profile.knobs[name])
+
+
+def init_profile(config, devices=None) -> None:
+    """Environment.init hook: resolve the tuned profile for this process.
+
+    - MLSL_TUNE=1: run the sweep on the live device world, persist the
+      profile (atomic write), and use it.
+    - MLSL_TUNE_PROFILE set (no sweep): load it. Missing/corrupt/unknown
+      version raises MLSLError here — at init, where the operator can see it
+      — never deep in dispatch. A well-formed profile whose topology
+      fingerprint disagrees with the probed hardware is stale: rejected with
+      a warning, untuned defaults keep running.
+    - neither: config.tuned_profile stays None and nothing changes.
+    """
+    from mlsl_tpu import sysinfo
+
+    config.tuned_profile = None
+    if config.tune:
+        import os
+
+        path = config.tune_profile or default_profile_path()
+        # MLSL_TUNE_QUANT=1 adds the int8-ring block-palette cell — opt-in
+        # because it only pays off for quantized training and costs extra
+        # sweep time on every tuned init
+        quant = os.environ.get("MLSL_TUNE_QUANT", "").strip().lower() not in (
+            "", "0", "false", "no", "off",
+        )
+        profile = run_sweep(devices=devices, quant=quant)
+        profile.save(path)
+        log_info("tuner: profile written to %s (%d cells)", path,
+                 len(profile.cells))
+        config.tuned_profile = profile
+    elif config.tune_profile:
+        profile = load_profile(config.tune_profile)  # MLSLError on bad file
+        fp = sysinfo.topology_fingerprint()
+        if not profile.matches(fp):
+            log_warning(
+                "tuner: profile %s was measured on a different topology "
+                "(profile %r vs probed %r); rejecting it — rerun MLSL_TUNE=1 "
+                "on this machine", config.tune_profile, profile.fingerprint, fp,
+            )
+            return
+        config.tuned_profile = profile
+    if config.tuned_profile is not None:
+        apply_knobs(config, config.tuned_profile)

@@ -1,0 +1,176 @@
+"""Tuner profile: the persisted selection table + tuned knob set.
+
+A profile is one JSON document keyed by a ``sysinfo`` topology fingerprint
+(platform, chip generation, world size, host spread). Cells map
+(kind, group shape, compression, payload band) -> algorithm name; knobs are
+whole-config values (chunk/bucket/priority/quant-block) the sweep measured.
+Both carry the raw measurements they were derived from, so an operator can
+audit WHY a cell picked its algorithm (docs/TUNING.md §10).
+
+Load contract (the config-validation satellite): a missing or corrupt file
+is an immediate ``MLSLError`` — pointing MLSL_TUNE_PROFILE at garbage must
+fail at init, not deep in dispatch. A well-formed profile whose fingerprint
+disagrees with the probed hardware is STALE: rejected with a warning and the
+untuned defaults keep running (measurements do not transfer across
+machines).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+from typing import List, Optional, Tuple
+
+from mlsl_tpu.log import MLSLError, log_warning
+
+PROFILE_VERSION = 1
+DEFAULT_PROFILE_FILE = "mlsl_tune_profile.json"
+
+#: knob name -> minimum legal value: the Config fields a profile's knob
+#: table may set (anything else under "knobs" is measurement metadata,
+#: ignored on apply). Checked at LOAD time — a profile file with a
+#: nonsensical knob must fail with an MLSLError naming the file, not deep
+#: inside the first collective that consumes the knob (the same
+#: fail-at-init contract as Config.validate()).
+KNOB_RANGES = {
+    "msg_priority_threshold": 1,
+    "grad_bucket_mb": 0,
+    "large_msg_size_mb": 0,
+    "large_msg_chunks": 1,
+    "quant_block_elems": 1,
+}
+
+
+def default_profile_path() -> str:
+    """Where an unnamed profile lands: ``MLSL_STATS_DIR`` (default CWD), the
+    same routing contract as mlsl_stats.log (core/stats.stats_path)."""
+    d = os.environ.get("MLSL_STATS_DIR")
+    return os.path.join(d, DEFAULT_PROFILE_FILE) if d else DEFAULT_PROFILE_FILE
+
+
+@dataclasses.dataclass
+class TunedProfile:
+    """In-memory form of one profile document."""
+
+    fingerprint: dict
+    cells: List[dict] = dataclasses.field(default_factory=list)
+    knobs: dict = dataclasses.field(default_factory=dict)
+    created: str = ""
+
+    # -- selection ---------------------------------------------------------
+
+    def select(
+        self,
+        kind: str,
+        shape: Tuple[int, ...],
+        compression,
+        payload_bytes: int,
+    ) -> Optional[str]:
+        """Tuned algorithm for (kind, group shape, compression, payload), or
+        None when no cell covers it (the caller falls back to the heuristic
+        default). Cells are size-banded: the matching cell is the smallest
+        ``max_bytes`` band that still covers the payload; a cell with
+        ``max_bytes: null`` is the open top band."""
+        comp = _comp_name(compression)
+        shape = tuple(int(s) for s in shape)
+        best = None
+        best_cap = None
+        for cell in self.cells:
+            if cell.get("kind") != kind or _comp_name(cell.get("compression", "none")) != comp:
+                continue
+            if tuple(int(s) for s in cell.get("shape", ())) != shape:
+                continue
+            cap = cell.get("max_bytes")
+            if cap is not None and payload_bytes > cap:
+                continue
+            if best is None or (cap is not None and (best_cap is None or cap < best_cap)):
+                best, best_cap = cell, cap
+        return best.get("algo") if best else None
+
+    def matches(self, fingerprint: dict) -> bool:
+        return dict(self.fingerprint) == dict(fingerprint)
+
+    # -- persistence -------------------------------------------------------
+
+    def to_doc(self) -> dict:
+        return {
+            "version": PROFILE_VERSION,
+            "fingerprint": self.fingerprint,
+            "created": self.created,
+            "cells": self.cells,
+            "knobs": self.knobs,
+        }
+
+    def save(self, path: str) -> str:
+        tmp = f"{path}.tmp.{os.getpid()}"
+        with open(tmp, "w") as f:
+            json.dump(self.to_doc(), f, indent=1, sort_keys=True)
+            f.write("\n")
+        os.replace(tmp, path)  # atomic: a reader never sees a half-written file
+        return path
+
+
+def _comp_name(compression) -> str:
+    if isinstance(compression, str):
+        return compression
+    from mlsl_tpu.types import CompressionType
+
+    try:
+        return CompressionType(compression).name.lower()
+    except ValueError:
+        return str(compression)
+
+
+def load_profile(path: str) -> TunedProfile:
+    """Parse a profile file; MLSLError on missing/corrupt/unknown-version —
+    the fail-at-init contract for MLSL_TUNE_PROFILE."""
+    if not os.path.exists(path):
+        raise MLSLError(
+            f"MLSL_TUNE_PROFILE points at a missing file: {path} "
+            f"(run MLSL_TUNE=1 or scripts/run_tune.sh to produce one)"
+        )
+    try:
+        with open(path) as f:
+            doc = json.load(f)
+    except (OSError, ValueError) as e:
+        raise MLSLError(
+            f"MLSL_TUNE_PROFILE file {path} is unreadable or corrupt: {e!r}"
+        ) from e
+    if not isinstance(doc, dict) or "fingerprint" not in doc or "cells" not in doc:
+        raise MLSLError(
+            f"MLSL_TUNE_PROFILE file {path} is not a tuner profile "
+            f"(missing fingerprint/cells)"
+        )
+    if doc.get("version") != PROFILE_VERSION:
+        raise MLSLError(
+            f"MLSL_TUNE_PROFILE file {path} has unsupported version "
+            f"{doc.get('version')!r} (this build reads version {PROFILE_VERSION})"
+        )
+    cells = doc["cells"]
+    if not isinstance(cells, list) or not all(isinstance(c, dict) for c in cells):
+        raise MLSLError(f"MLSL_TUNE_PROFILE file {path} has a malformed cell table")
+    from mlsl_tpu.comm import algos
+
+    for cell in cells:
+        if cell.get("algo") not in algos.ALGORITHMS:
+            raise MLSLError(
+                f"MLSL_TUNE_PROFILE file {path} names unknown algorithm "
+                f"{cell.get('algo')!r} (registry: {', '.join(algos.ALGORITHMS)})"
+            )
+    knobs = doc.get("knobs", {}) or {}
+    for name, lo in KNOB_RANGES.items():
+        v = knobs.get(name)
+        if v is None:
+            continue
+        if isinstance(v, bool) or not isinstance(v, (int, float)) or v < lo:
+            raise MLSLError(
+                f"MLSL_TUNE_PROFILE file {path} has invalid knob "
+                f"{name}={v!r} (expected a number >= {lo})"
+            )
+    return TunedProfile(
+        fingerprint=doc["fingerprint"],
+        cells=cells,
+        knobs=knobs,
+        created=str(doc.get("created", "")),
+    )

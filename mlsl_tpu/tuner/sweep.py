@@ -1,0 +1,272 @@
+"""The autotuner sweep: measure candidate algorithms x knobs on the live mesh.
+
+The reference picks dispatch policy from a static Xeon-vs-Phi / NIC matrix
+(src/sysinfo.hpp, AutoConfig); EQuARX and DynamiQ both argue the selection
+that matters is MEASURED, on the actual interconnect, per (collective, size,
+group shape). This module is that measurement:
+
+- **algorithm cells**: for every engine kind x payload size x group shape,
+  build each eligible algorithm's program (comm/algos.build — the same cache
+  the dispatch path uses, so the sweep's winners are already warm) and time
+  best-of-N executions on zero buffers (the isolation-stats methodology:
+  repeated replay, warmup discarded, min taken — core/stats.py).
+- **knob derivation**: the dispatch floor (a tiny allreduce's wall time) and
+  the peak algbw together give the bandwidth/latency crossover every
+  scheduling knob encodes:
+    msg_priority_threshold — defer messages whose wire time exceeds the
+        dispatch floor (smaller ones are latency-bound; deferral only adds
+        queue overhead);
+    grad_bucket_mb — coalesce until one bucket's wire time is >= 16x the
+        dispatch floor (per-member dispatch overhead amortized to <= 6%);
+    large_msg_size_mb / large_msg_chunks — set only when a measured split
+        of the largest swept payload actually beats the single-shot
+        dispatch (on sim meshes it never does, and the knob stays unset);
+    quant_block_elems — argmin over the quant-ring block palette at a
+        bandwidth-sized payload (swept when ``quant=True``).
+
+Sweep geometry defaults to the two shapes every training topology exercises
+— the full 1D ring and (when the world factors) a 2D sub-torus — and is
+overridable for tests/benches via arguments or MLSL_TUNE_SIZES (KiB, comma
+separated) / MLSL_TUNE_ITERS.
+"""
+
+from __future__ import annotations
+
+import os
+import time
+from typing import List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from mlsl_tpu.log import log_debug, log_info
+
+#: payload sizes swept by default (bytes); spans latency-bound to
+#: bandwidth-bound on every backend we run on
+DEFAULT_SIZES = (16 * 1024, 256 * 1024, 2 * 1024 * 1024)
+DEFAULT_ITERS = 5
+WARMUP = 2
+
+#: quant-ring block palette (elements) swept for the quant knob cell
+QUANT_BLOCKS = (128, 256, 512)
+
+
+def _env_sizes() -> Optional[Tuple[int, ...]]:
+    v = os.environ.get("MLSL_TUNE_SIZES")
+    if not v:
+        return None
+    return tuple(int(float(s) * 1024) for s in v.split(",") if s.strip())
+
+
+def _time_fn(fn, args, iters: int) -> float:
+    """Best-of-``iters`` wall seconds for one compiled collective (min, not
+    mean: the minimum is the least-noise estimator for a deterministic
+    program under scheduler jitter — same reasoning as the bench harness).
+
+    Times the program BENEATH the chaos instrumentation (``_mlsl_inner``,
+    the same bypass the precompile warm uses): an armed MLSL_CHAOS budget
+    must fire at the training step it targets, not be spent — or wedge
+    init — inside the MLSL_TUNE sweep's hundreds of measurement calls."""
+    import jax
+
+    fn = getattr(fn, "_mlsl_inner", fn)
+    for _ in range(WARMUP):
+        jax.block_until_ready(fn(*args))
+    best = float("inf")
+    for _ in range(iters):
+        t0 = time.perf_counter()
+        jax.block_until_ready(fn(*args))
+        best = min(best, time.perf_counter() - t0)
+    return best
+
+
+def _sweep_topologies(devices) -> List[tuple]:
+    """(topology, group, shape) candidates: the 1D world ring plus a 2D
+    factoring when the world splits into a real sub-torus."""
+    from mlsl_tpu.comm.mesh import ProcessGroup, Topology
+    from mlsl_tpu.comm import algos
+
+    n = len(devices)
+    out = []
+    if n > 1:
+        t1 = Topology(n, 1, devices=devices)
+        g1 = ProcessGroup(t1, ("data",))
+        out.append((t1, g1, algos.group_shape(g1)))
+    if n >= 4 and n % 2 == 0:
+        t2 = Topology(n // 2, 2, devices=devices)
+        g2 = ProcessGroup(t2, ("data", "model"))
+        out.append((t2, g2, algos.group_shape(g2)))
+    return out
+
+
+def run_sweep(
+    devices=None,
+    sizes: Optional[Sequence[int]] = None,
+    iters: Optional[int] = None,
+    quant: bool = False,
+) -> "TunedProfile":
+    """Measure and return a TunedProfile for the current device world (not
+    yet saved — the caller owns persistence)."""
+    import jax
+
+    from mlsl_tpu import sysinfo
+    from mlsl_tpu.comm import algos
+    from mlsl_tpu.tuner.profile import TunedProfile
+    from mlsl_tpu.types import ReductionType
+
+    devices = tuple(devices) if devices is not None else tuple(jax.devices())
+    sizes = tuple(sizes) if sizes is not None else (_env_sizes() or DEFAULT_SIZES)
+    iters = int(iters if iters is not None else
+                os.environ.get("MLSL_TUNE_ITERS", DEFAULT_ITERS))
+    t_start = time.perf_counter()
+
+    cells: List[dict] = []
+    floor_s = None
+    algbw = 0.0
+    largest = {}
+
+    for topo, group, shape in _sweep_topologies(devices):
+        G = group.size
+
+        def buf_for(elems):
+            return topo.shard_buffer(
+                np.zeros((*topo.grid_shape, elems), dtype=np.float32)
+            )
+
+        # dispatch floor: one tiny allreduce on the first (1D) shape
+        if floor_s is None:
+            fn = algos.build("allreduce", group, np.float32, "lax",
+                             op=ReductionType.SUM)
+            floor_s = _time_fn(fn, (buf_for(256),), iters)
+
+        for kind in algos.ENGINE_KINDS:
+            for size_b in sorted(sizes):
+                # elements padded so reduce_scatter counts divide the group
+                elems = max(-(-(size_b // 4) // G) * G, G)
+                kw = dict(op=ReductionType.SUM)
+                if kind == "reduce_scatter":
+                    kw["recv_count"] = elems // G
+                args = (buf_for(elems),)
+                measured = {}
+                for algo in algos.candidates(kind, group, ReductionType.SUM):
+                    fn = algos.build(kind, group, np.float32, algo, **kw)
+                    measured[algo] = _time_fn(fn, args, iters)
+                best = min(measured, key=measured.get)
+                payload = elems * 4
+                cells.append({
+                    "kind": kind,
+                    "shape": list(shape),
+                    "compression": "none",
+                    "payload_bytes": payload,   # what was actually measured
+                    "max_bytes": payload * 2,   # the band this cell covers
+                    "algo": best,
+                    "us": {a: round(s * 1e6, 2) for a, s in measured.items()},
+                })
+                log_debug(
+                    "tune: %s shape=%s %dB -> %s (%s)", kind, shape, payload,
+                    best, cells[-1]["us"],
+                )
+                if kind == "allreduce":
+                    bw = payload / measured["lax"]
+                    if bw > algbw:
+                        algbw = bw
+                    if payload > largest.get("bytes", 0):
+                        largest = {"bytes": payload, "group": group,
+                                   "topo": topo, "kw": kw}
+
+        # open the top band: the largest swept size's winner covers payloads
+        # beyond the sweep range (bandwidth-bound behavior extrapolates;
+        # latency-bound does not)
+        for kind in algos.ENGINE_KINDS:
+            tops = [c for c in cells
+                    if c["kind"] == kind and c["shape"] == list(shape)]
+            if tops:
+                tops[-1]["max_bytes"] = None
+
+    knobs: dict = {}
+    if floor_s and algbw > 0:
+        mib = 1024 * 1024
+        knobs["msg_priority_threshold"] = int(
+            min(max(floor_s * algbw, 4096), 16 * mib)
+        )
+        knobs["grad_bucket_mb"] = int(
+            min(max(round(16 * floor_s * algbw / mib), 1), 64)
+        )
+        # chunk-split probe on the largest swept allreduce: sequential
+        # quarter-slice dispatches vs the single shot
+        if largest:
+            from mlsl_tpu.types import ReductionType as RT
+
+            grp, topo = largest["group"], largest["topo"]
+            elems = largest["bytes"] // 4
+            fn = algos.build("allreduce", grp, np.float32, "lax",
+                            op=RT.SUM)
+            fn = getattr(fn, "_mlsl_inner", fn)  # chaos bypass, as above
+            full = topo.shard_buffer(
+                np.zeros((*topo.grid_shape, elems), dtype=np.float32)
+            )
+            single = _time_fn(fn, (full,), iters)
+            q = elems // 4
+
+            def chunked():
+                outs = [fn(full[..., i * q:(i + 1) * q]) for i in range(4)]
+                return jax.block_until_ready(outs)
+
+            t_chunk = _time_fn(chunked, (), iters)
+            if t_chunk < single * 0.9:
+                knobs["large_msg_size_mb"] = max(largest["bytes"] // (2 * mib), 1)
+                knobs["large_msg_chunks"] = 4
+            knobs["_measured"] = {
+                "dispatch_floor_us": round(floor_s * 1e6, 2),
+                "algbw_gbps": round(algbw / 1e9, 4),
+                "large_single_us": round(single * 1e6, 2),
+                "large_chunked_us": round(t_chunk * 1e6, 2),
+            }
+
+    if quant:
+        knobs.update(_sweep_quant_block(devices, iters))
+
+    prof = TunedProfile(
+        fingerprint=sysinfo.topology_fingerprint(),
+        cells=cells,
+        knobs=knobs,
+        created=time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
+    )
+    log_info(
+        "tuner sweep: %d cells, %d knobs in %.1fs",
+        len(cells), len([k for k in knobs if not k.startswith("_")]),
+        time.perf_counter() - t_start,
+    )
+    return prof
+
+
+def _sweep_quant_block(devices, iters: int) -> dict:
+    """Block-size cell for the int8 quant ring: argmin over the palette at a
+    bandwidth-sized payload on the 1D ring."""
+    from mlsl_tpu.comm.mesh import ProcessGroup, Topology
+    from mlsl_tpu.comm import quant_ring
+
+    n = len(devices)
+    if n <= 1:
+        return {}
+    topo = Topology(n, 1, devices=devices)
+    group = ProcessGroup(topo, ("data",))
+    elems = max(256 * 1024 // 4, n) // n * n
+    measured = {}
+    for block in QUANT_BLOCKS:
+        fn, err_len = quant_ring.build_quantized_collective(
+            "allreduce", group, elems, block
+        )
+        buf = topo.shard_buffer(
+            np.zeros((*topo.grid_shape, elems), dtype=np.float32)
+        )
+        err = topo.shard_buffer(
+            np.zeros((*topo.grid_shape, err_len), dtype=np.float32)
+        )
+        measured[block] = _time_fn(fn, (buf, err), iters)
+    best = min(measured, key=measured.get)
+    return {
+        "quant_block_elems": int(best),
+        "_quant_measured": {
+            str(b): round(s * 1e6, 2) for b, s in measured.items()
+        },
+    }

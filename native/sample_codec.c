@@ -8,6 +8,11 @@
  * caller-supplied diff buffer is added before truncation and receives the new
  * residual (dl_comp semantics).
  *
+ * The f16 <-> f32 conversion is done in software (bit manipulation) rather
+ * than via the _Float16 C type: _Float16 is an optional target feature gcc
+ * rejects on several x86 baselines, and a sample codec must build anywhere
+ * `gcc -shared` runs. Round-to-nearest-even, same as hardware conversion.
+ *
  * Build:  gcc -shared -fPIC -O2 -o libsample_codec.so sample_codec.c
  */
 
@@ -15,7 +20,58 @@
 #include <stdint.h>
 #include <string.h>
 
-typedef _Float16 f16;
+typedef uint16_t f16;
+
+static f16 f32_to_f16(float value) {
+  uint32_t x;
+  memcpy(&x, &value, sizeof(x));
+  uint16_t sign = (uint16_t)((x >> 16) & 0x8000u);
+  int32_t exp = (int32_t)((x >> 23) & 0xff) - 127 + 15;
+  uint32_t mant = x & 0x7fffffu;
+  if (exp >= 0x1f) {               /* overflow / inf / nan */
+    if (((x >> 23) & 0xff) == 0xff && mant)
+      return (f16)(sign | 0x7e00u); /* nan */
+    return (f16)(sign | 0x7c00u);   /* inf */
+  }
+  if (exp <= 0) {                  /* subnormal or zero */
+    if (exp < -10) return sign;
+    mant |= 0x800000u;             /* implicit leading 1 */
+    uint32_t shift = (uint32_t)(14 - exp);
+    uint32_t half = mant >> shift;
+    uint32_t rem = mant & ((1u << shift) - 1u);
+    uint32_t mid = 1u << (shift - 1);
+    if (rem > mid || (rem == mid && (half & 1u))) half++;
+    return (f16)(sign | half);
+  }
+  uint32_t half = ((uint32_t)exp << 10) | (mant >> 13);
+  uint32_t rem = mant & 0x1fffu;
+  if (rem > 0x1000u || (rem == 0x1000u && (half & 1u))) half++;
+  return (f16)(sign | half);
+}
+
+static float f16_to_f32(f16 h) {
+  uint32_t sign = (uint32_t)(h & 0x8000u) << 16;
+  uint32_t exp = (h >> 10) & 0x1fu;
+  uint32_t mant = h & 0x3ffu;
+  uint32_t x;
+  if (exp == 0x1f) {               /* inf / nan */
+    x = sign | 0x7f800000u | (mant << 13);
+  } else if (exp == 0) {
+    if (mant == 0) {
+      x = sign;                    /* zero */
+    } else {                       /* subnormal: normalize */
+      exp = 127 - 15 + 1;
+      while (!(mant & 0x400u)) { mant <<= 1; exp--; }
+      mant &= 0x3ffu;
+      x = sign | (exp << 23) | (mant << 13);
+    }
+  } else {
+    x = sign | ((exp - 15 + 127) << 23) | (mant << 13);
+  }
+  float out;
+  memcpy(&out, &x, sizeof(out));
+  return out;
+}
 
 /* int quant(src, dst, count, diff, src_data_type, comp_ratio, method) */
 int sample_compress(void* src_buffer, void* dst_buffer, size_t count,
@@ -27,9 +83,9 @@ int sample_compress(void* src_buffer, void* dst_buffer, size_t count,
   f16* dst = (f16*)dst_buffer;
   for (size_t i = 0; i < count; i++) {
     float v = src[i] + (d ? d[i] : 0.0f);
-    f16 t = (f16)v;
+    f16 t = f32_to_f16(v);
     dst[i] = t;
-    if (d) d[i] = v - (float)t;
+    if (d) d[i] = v - f16_to_f32(t);
   }
   return 0;
 }
@@ -38,7 +94,7 @@ int sample_compress(void* src_buffer, void* dst_buffer, size_t count,
 int sample_decompress(void* src_buffer, void* dst_buffer, size_t count) {
   const f16* src = (const f16*)src_buffer;
   float* dst = (float*)dst_buffer;
-  for (size_t i = 0; i < count; i++) dst[i] = (float)src[i];
+  for (size_t i = 0; i < count; i++) dst[i] = f16_to_f32(src[i]);
   return 0;
 }
 
@@ -56,6 +112,7 @@ int sample_reduce_sum(const void* in_buffer, void* inout_buffer,
   const f16* in = (const f16*)in_buffer;
   f16* io = (f16*)inout_buffer;
   size_t n = block_count * SAMPLE_ELEM_IN_BLOCK;
-  for (size_t i = 0; i < n; i++) io[i] = (f16)((float)in[i] + (float)io[i]);
+  for (size_t i = 0; i < n; i++)
+    io[i] = f32_to_f16(f16_to_f32(in[i]) + f16_to_f32(io[i]));
   return 0;
 }

@@ -1,0 +1,308 @@
+"""Autotuner tests (mlsl_tpu.tuner): sweep, profile round-trip, staleness.
+
+The tuner's contract: a profile written on this topology and reloaded in a
+FRESH Environment reproduces the measured selection exactly; a profile from
+a different topology is rejected with a warning (stale measurements never
+steer dispatch); a missing/corrupt profile file is an MLSLError at init; and
+tuned knobs never override knobs the user exported explicitly.
+"""
+
+import json
+import os
+
+import numpy as np
+import pytest
+import jax
+
+from mlsl_tpu import sysinfo, tuner
+from mlsl_tpu.comm import algos
+from mlsl_tpu.log import MLSLError
+from mlsl_tpu.types import CompressionType, DataType, GroupType, ReductionType
+
+TINY_SIZES = (4 * 1024, 32 * 1024)
+
+
+@pytest.fixture(autouse=True)
+def _fast_sweep(monkeypatch):
+    """Keep any env-triggered sweep tiny: the suite tests the machinery, the
+    real measurement belongs to benchmarks/algo_sweep_bench.py."""
+    monkeypatch.setenv("MLSL_TUNE_SIZES", "4,32")
+    monkeypatch.setenv("MLSL_TUNE_ITERS", "2")
+
+
+def _profile(tmp_path, cells=None, knobs=None, fingerprint=None,
+             name="prof.json"):
+    doc = {
+        "version": 1,
+        "fingerprint": fingerprint or sysinfo.topology_fingerprint(),
+        "created": "test",
+        "cells": cells if cells is not None else [],
+        "knobs": knobs or {},
+    }
+    path = str(tmp_path / name)
+    with open(path, "w") as f:
+        json.dump(doc, f)
+    return path
+
+
+# -- sweep -------------------------------------------------------------------
+
+
+def test_run_sweep_produces_cells_and_knobs():
+    prof = tuner.run_sweep(sizes=TINY_SIZES, iters=2)
+    assert prof.fingerprint == sysinfo.topology_fingerprint()
+    kinds = {c["kind"] for c in prof.cells}
+    assert kinds == {"allreduce", "reduce_scatter"}
+    shapes = {tuple(c["shape"]) for c in prof.cells}
+    assert (8,) in shapes and (4, 2) in shapes
+    for c in prof.cells:
+        assert c["algo"] in algos.ALGORITHMS
+        assert "lax" in c["us"]  # the baseline is always measured
+    assert prof.knobs.get("msg_priority_threshold", 0) > 0
+    assert prof.knobs.get("grad_bucket_mb", 0) >= 1
+
+
+def test_sweep_quant_knob():
+    prof = tuner.run_sweep(sizes=(8 * 1024,), iters=2, quant=True)
+    assert prof.knobs.get("quant_block_elems") in (128, 256, 512)
+
+
+def test_tune_quant_env_produces_knob(tmp_path, monkeypatch):
+    """MLSL_TUNE_QUANT=1 is the supported init-path producer of the
+    quant_block_elems tuned knob (docs/TUNING.md §10)."""
+    from mlsl_tpu.core.environment import Environment
+
+    path = str(tmp_path / "q.json")
+    monkeypatch.setenv("MLSL_TUNE", "1")
+    monkeypatch.setenv("MLSL_TUNE_QUANT", "1")
+    monkeypatch.setenv("MLSL_TUNE_PROFILE", path)
+    e = Environment.get_env().init()
+    try:
+        assert e.config.tuned_profile.knobs.get("quant_block_elems") in (
+            128, 256, 512,
+        )
+        assert e.config.quant_block_elems in (128, 256, 512)
+    finally:
+        e.finalize()
+
+
+def test_sweep_bypasses_armed_chaos_budgets():
+    """The sweep's hundreds of measurement calls must not spend (or wedge
+    init on) an armed MLSL_CHAOS budget aimed at a training step — the same
+    _mlsl_inner bypass contract as the precompile warm."""
+    from mlsl_tpu import chaos
+
+    with chaos.injected("collective.dispatch", "error", times=1) as p:
+        prof = tuner.run_sweep(sizes=(4 * 1024,), iters=2)
+        assert prof.cells
+        assert p.hits == 0  # budget untouched by the sweep
+
+
+# -- profile round-trip ------------------------------------------------------
+
+
+def test_profile_save_load_roundtrip(tmp_path):
+    prof = tuner.run_sweep(sizes=TINY_SIZES, iters=2)
+    path = str(tmp_path / "p.json")
+    prof.save(path)
+    back = tuner.load_profile(path)
+    assert back.fingerprint == prof.fingerprint
+    assert back.knobs == prof.knobs
+    for kind in ("allreduce", "reduce_scatter"):
+        for shape in ((8,), (4, 2)):
+            for payload in (1024, 40 * 1024, 10 << 20):
+                assert back.select(kind, shape, "none", payload) == \
+                    prof.select(kind, shape, "none", payload)
+
+
+def test_profile_size_banding(tmp_path):
+    cells = [
+        {"kind": "allreduce", "shape": [8], "compression": "none",
+         "max_bytes": 65536, "algo": "rhd"},
+        {"kind": "allreduce", "shape": [8], "compression": "none",
+         "max_bytes": None, "algo": "lax"},
+    ]
+    prof = tuner.load_profile(_profile(tmp_path, cells=cells))
+    assert prof.select("allreduce", (8,), "none", 4096) == "rhd"
+    assert prof.select("allreduce", (8,), "none", 1 << 20) == "lax"
+    assert prof.select("allreduce", (4, 2), "none", 4096) is None
+    assert prof.select("reduce_scatter", (8,), "none", 4096) is None
+
+
+# -- Environment integration -------------------------------------------------
+
+
+def test_tune_writes_profile_and_fresh_env_honors_it(tmp_path, monkeypatch):
+    """The acceptance round-trip: MLSL_TUNE=1 writes a profile; a FRESH
+    Environment loading that file reproduces the recorded selection on a
+    live request."""
+    from mlsl_tpu.core.environment import Environment
+
+    path = str(tmp_path / "tuned.json")
+    monkeypatch.setenv("MLSL_TUNE", "1")
+    monkeypatch.setenv("MLSL_TUNE_PROFILE", path)
+    e = Environment.get_env().init()
+    prof = e.config.tuned_profile
+    assert prof is not None and os.path.exists(path)
+    recorded = {
+        (c["kind"], tuple(c["shape"]), c.get("max_bytes")): c["algo"]
+        for c in prof.cells
+    }
+    e.finalize()
+
+    monkeypatch.delenv("MLSL_TUNE")
+    e = Environment.get_env().init()
+    loaded = e.config.tuned_profile
+    assert loaded is not None
+    try:
+        assert {
+            (c["kind"], tuple(c["shape"]), c.get("max_bytes")): c["algo"]
+            for c in loaded.cells
+        } == recorded
+        # a live request consults the loaded table
+        from mlsl_tpu.comm.request import CommDesc, CommRequest
+
+        dist = e.create_distribution(8, 1)
+        n = 2048  # 8 KiB payload: inside the smallest swept band
+        want = loaded.select("allreduce", (8,), "none", n * 4) or "lax"
+        req = CommRequest(
+            CommDesc("allreduce", dist._group(GroupType.DATA), n,
+                     DataType.FLOAT, op=ReductionType.SUM),
+            e.dispatcher,
+        )
+        req.setup()
+        assert req.algo == want
+        # and the tuned path still produces the exact sum
+        buf = dist.make_buffer(
+            lambda p: np.full(n, float(p + 1), np.float32), n
+        )
+        req.start(buf)
+        np.testing.assert_array_equal(
+            np.asarray(dist.local_part(req.wait(), 0)),
+            np.full(n, 36.0, np.float32),
+        )
+    finally:
+        e.finalize()
+
+
+def test_selection_honored_for_nondefault_cell(tmp_path, monkeypatch):
+    """A hand-written profile cell steering a request away from the baseline
+    is honored end-to-end, deterministically (measured sweeps may pick any
+    winner; this pins the plumbing)."""
+    from mlsl_tpu.core.environment import Environment
+
+    cells = [{"kind": "allreduce", "shape": [8], "compression": "none",
+              "max_bytes": None, "algo": "rhd"}]
+    path = _profile(tmp_path, cells=cells)
+    monkeypatch.setenv("MLSL_TUNE_PROFILE", path)
+    e = Environment.get_env().init()
+    try:
+        from mlsl_tpu.comm.request import CommDesc, CommRequest
+
+        dist = e.create_distribution(8, 1)
+        req = CommRequest(
+            CommDesc("allreduce", dist._group(GroupType.DATA), 1024,
+                     DataType.FLOAT, op=ReductionType.SUM),
+            e.dispatcher,
+        )
+        req.setup()
+        assert req.algo == "rhd"
+    finally:
+        e.finalize()
+
+
+def test_stale_fingerprint_rejected_with_warning(tmp_path, monkeypatch,
+                                                 capfd):
+    from mlsl_tpu.core.environment import Environment
+
+    path = _profile(
+        tmp_path,
+        cells=[{"kind": "allreduce", "shape": [8], "compression": "none",
+                "max_bytes": None, "algo": "rhd"}],
+        fingerprint={"platform": "tpu", "device_kind": "TPU v9",
+                     "num_devices": 4096, "num_hosts": 512},
+    )
+    monkeypatch.setenv("MLSL_TUNE_PROFILE", path)
+    e = Environment.get_env().init()
+    try:
+        assert e.config.tuned_profile is None  # rejected, not applied
+        err = capfd.readouterr().err
+        assert "different topology" in err
+    finally:
+        e.finalize()
+
+
+def test_missing_profile_is_mlsl_error(monkeypatch):
+    from mlsl_tpu.core.environment import Environment
+
+    monkeypatch.setenv("MLSL_TUNE_PROFILE", "/nonexistent/prof.json")
+    e = Environment.get_env()
+    with pytest.raises(MLSLError, match="missing file"):
+        e.init()
+    assert not e._initialized
+
+
+def test_corrupt_profile_is_mlsl_error(tmp_path, monkeypatch):
+    from mlsl_tpu.core.environment import Environment
+
+    path = str(tmp_path / "bad.json")
+    with open(path, "w") as f:
+        f.write("{not json")
+    monkeypatch.setenv("MLSL_TUNE_PROFILE", path)
+    e = Environment.get_env()
+    with pytest.raises(MLSLError, match="corrupt"):
+        e.init()
+    assert not e._initialized
+
+
+def test_profile_with_unknown_algo_is_mlsl_error(tmp_path):
+    cells = [{"kind": "allreduce", "shape": [8], "compression": "none",
+              "max_bytes": None, "algo": "carrier_pigeon"}]
+    with pytest.raises(MLSLError, match="unknown algorithm"):
+        tuner.load_profile(_profile(tmp_path, cells=cells))
+
+
+def test_profile_with_invalid_knob_is_mlsl_error(tmp_path, monkeypatch):
+    """A bad knob value must fail at LOAD (naming the file), not deep inside
+    the first collective that consumes the knob — same contract as the cell
+    validation."""
+    from mlsl_tpu.core.environment import Environment
+
+    path = _profile(tmp_path, knobs={"quant_block_elems": 0})
+    with pytest.raises(MLSLError, match="invalid knob"):
+        tuner.load_profile(path)
+    path2 = _profile(tmp_path, knobs={"large_msg_chunks": "four"},
+                     name="p2.json")
+    monkeypatch.setenv("MLSL_TUNE_PROFILE", path2)
+    e = Environment.get_env()
+    with pytest.raises(MLSLError, match="invalid knob"):
+        e.init()
+    assert not e._initialized
+
+
+def test_profile_wrong_version_is_mlsl_error(tmp_path):
+    path = str(tmp_path / "v9.json")
+    with open(path, "w") as f:
+        json.dump({"version": 9, "fingerprint": {}, "cells": []}, f)
+    with pytest.raises(MLSLError, match="version"):
+        tuner.load_profile(path)
+
+
+# -- knob application --------------------------------------------------------
+
+
+def test_tuned_knobs_applied_but_explicit_env_wins(tmp_path, monkeypatch):
+    from mlsl_tpu.core.environment import Environment
+
+    path = _profile(
+        tmp_path,
+        knobs={"msg_priority_threshold": 123456, "grad_bucket_mb": 7},
+    )
+    monkeypatch.setenv("MLSL_TUNE_PROFILE", path)
+    monkeypatch.setenv("MLSL_GRAD_BUCKET_MB", "2")  # explicit: must win
+    e = Environment.get_env().init()
+    try:
+        assert e.config.msg_priority_threshold == 123456  # tuned applied
+        assert e.config.grad_bucket_mb == 2               # explicit wins
+    finally:
+        e.finalize()
