@@ -23,10 +23,16 @@ Env config (comma-separated)::
 
     MLSL_CHAOS="request.wait:error@6,collective.dispatch:hang=30,data.prefetch:delay=0.05x*"
 
-Grammar per entry: ``site:kind[=value][@after][xN]`` — *value* is the
+Grammar per entry: ``site:kind[=value][@after][xN][%p]`` — *value* is the
 exception name for ``error`` (oserror, runtimeerror, mlslerror, ...) or
 seconds for ``delay``/``hang``; ``@after`` skips the first N hits; ``xN``
-fires at most N times (default 1; ``x*`` = unlimited).
+fires at most N times (default 1; ``x*`` = unlimited); ``%p`` makes each
+eligible hit fire with probability *p* (e.g.
+``collective.dispatch:errorx*%0.05`` — a 5% flaky dispatch; ``%p`` is the
+trailing suffix, after ``xN``), so randomized
+soak runs need no hand-scheduled ``@after`` budgets. The fire decisions
+come from a module RNG seeded by ``MLSL_CHAOS_SEED`` (or :func:`seed`), so
+a probabilistic soak replays exactly.
 
 Hot-path contract: instrumented code guards with ``if chaos._plans:`` (one
 dict truthiness test when idle) or calls ``inject`` directly (one call + one
@@ -37,11 +43,12 @@ from __future__ import annotations
 
 import dataclasses
 import os
+import random
 import threading
 import time
 from typing import Dict, List, Optional
 
-from mlsl_tpu.log import MLSLError, log_info, log_warning
+from mlsl_tpu.log import MLSLCorruptionError, MLSLError, log_info, log_warning
 
 
 class ChaosError(RuntimeError):
@@ -66,11 +73,25 @@ _EXC_NAMES = {
     "chaoserror": ChaosError,
     "runtimeerror": RuntimeError,
     "mlslerror": MLSLError,
+    "corruptionerror": MLSLCorruptionError,
     "oserror": OSError,
     "ioerror": OSError,
     "valueerror": ValueError,
     "timeouterror": TimeoutError,
 }
+
+# Probabilistic-fire RNG (the %p grammar). Module-level and seedable so a
+# randomized soak run is replayable: MLSL_CHAOS_SEED=42 (or seed(42)) makes
+# the same fault schedule fire against the same workload.
+_rng = random.Random(
+    int(os.environ["MLSL_CHAOS_SEED"])
+    if os.environ.get("MLSL_CHAOS_SEED") else None
+)
+
+
+def seed(n: Optional[int]) -> None:
+    """Re-seed the probabilistic-fire RNG (tests / soak reproducibility)."""
+    _rng.seed(n)
 
 
 @dataclasses.dataclass
@@ -84,6 +105,7 @@ class Plan:
     seconds: float = 0.1
     after: int = 0
     times: Optional[int] = 1
+    prob: float = 1.0
     hits: int = 0
     fires: int = 0
     cancelled: bool = False
@@ -94,6 +116,10 @@ class Plan:
         if self.cancelled or self.hits <= self.after:
             return False
         if self.times is not None and self.fires >= self.times:
+            return False
+        if self.prob < 1.0 and _rng.random() >= self.prob:
+            # probabilistic plan (%p): an eligible hit that rolled a miss —
+            # counts as a hit, never as a fire, and never burns `times`
             return False
         self.fires += 1
         return True
@@ -110,16 +136,24 @@ def plan(
     seconds: float = 0.1,
     after: int = 0,
     times: Optional[int] = 1,
+    prob: float = 1.0,
 ) -> Plan:
-    """Arm a fault at ``site``. Returns the Plan (counters readable by tests)."""
+    """Arm a fault at ``site``. Returns the Plan (counters readable by tests).
+    ``prob`` < 1 makes each eligible hit fire with that probability (the
+    ``%p`` grammar — randomized soak faults with no hand-scheduled
+    budgets); pair it with ``times=None`` for an indefinitely flaky site."""
     if site not in SITES:
         raise ValueError(f"unknown chaos site {site!r}; known: {sorted(SITES)}")
     if kind not in KINDS:
         raise ValueError(f"unknown chaos kind {kind!r}; known: {KINDS}")
-    p = Plan(site=site, kind=kind, exc=exc, seconds=seconds, after=after, times=times)
+    if not 0.0 < prob <= 1.0:
+        raise ValueError(f"chaos probability must be in (0, 1] (got {prob!r})")
+    p = Plan(site=site, kind=kind, exc=exc, seconds=seconds, after=after,
+             times=times, prob=prob)
     with _lock:
         _plans.setdefault(site, []).append(p)
-    log_info("chaos armed: %s %s after=%d times=%s", site, kind, after, times)
+    log_info("chaos armed: %s %s after=%d times=%s prob=%s",
+             site, kind, after, times, prob)
     return p
 
 
@@ -212,6 +246,10 @@ def refresh_from_env(spec: Optional[str] = None) -> List[Plan]:
     is authoritative when used."""
     if spec is None:
         spec = os.environ.get("MLSL_CHAOS", "")
+    s = os.environ.get("MLSL_CHAOS_SEED")
+    if s:
+        # re-arming from the env restarts the reproducible fault schedule
+        _rng.seed(int(s))
     clear()
     out = []
     for entry in filter(None, (e.strip() for e in spec.split(","))):
@@ -220,11 +258,14 @@ def refresh_from_env(spec: Optional[str] = None) -> List[Plan]:
 
 
 def _parse_entry(entry: str) -> dict:
-    """``site:kind[=value][@after][xN]`` -> plan() kwargs."""
+    """``site:kind[=value][@after][xN][%p]`` -> plan() kwargs."""
     site, sep, rest = entry.partition(":")
     if not sep:
         raise ValueError(f"bad MLSL_CHAOS entry {entry!r}: expected site:kind[...]")
     kw: dict = {"site": site}
+    if "%" in rest:
+        rest, _, pr = rest.rpartition("%")
+        kw["prob"] = float(pr)
     times: Optional[int] = 1
     if "x" in rest:
         rest, _, t = rest.rpartition("x")

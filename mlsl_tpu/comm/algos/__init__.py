@@ -191,7 +191,7 @@ def select(
         name = forced.get(kind) or forced.get("*")
         if name:
             if eligible(name, kind, group, op):
-                return name
+                return _breaker_gate(name, kind)
             log_debug(
                 "forced algorithm %s not eligible for %s on group %s; "
                 "falling back to %s", name, kind, group_shape(group), DEFAULT,
@@ -203,12 +203,28 @@ def select(
                               payload_bytes)
         if name and name != DEFAULT:
             if eligible(name, kind, group, op):
-                return name
+                return _breaker_gate(name, kind)
             log_debug(
                 "tuned algorithm %s not eligible for %s on group %s; "
                 "falling back to %s", name, kind, group_shape(group), DEFAULT,
             )
     return DEFAULT
+
+
+def _breaker_gate(name: str, kind: str) -> str:
+    """Rung 3 at selection time: a non-baseline choice is honored only while
+    the algo-engine circuit breaker admits it (mlsl_tpu.supervisor). An OPEN
+    breaker pins NEW requests to the baseline; requests already built degrade
+    per dispatch in CommRequest. Lazy import: the registry must stay
+    importable from config validation."""
+    from mlsl_tpu import supervisor
+
+    if not supervisor.breaker("algo").allow():
+        log_debug(
+            "algo breaker open: %s for %s degrades to %s", name, kind, DEFAULT
+        )
+        return DEFAULT
+    return name
 
 
 def build(kind: str, group: ProcessGroup, dtype, algo: str, **kw) -> Callable:

@@ -665,6 +665,21 @@ def _chaos_dispatch(fn: Callable, kind: str) -> Callable:
     return _ChaosDispatch(fn, kind)
 
 
+def build_plain_fallback(kind: str, group: ProcessGroup, count: int) -> Callable:
+    """The always-correct float32 program a degraded compressed request falls
+    back to (supervisor rung 3): the same cached ``build_collective`` SUM
+    program the uncompressed path would have used — bit-for-bit the plain
+    request's program, which is what the degraded-path parity contract pins
+    against. float32 because the compressed families deliver float32
+    regardless of the entry dtype (the ring casts at entry), so the degraded
+    result dtype matches the healthy one."""
+    kw = {"op": ReductionType.SUM}
+    if kind == "reduce_scatter":
+        g = 1 if group.is_self else group.size
+        kw["recv_count"] = count // g
+    return build_collective(kind, group, np.float32, **kw)
+
+
 def _group_key(group: ProcessGroup):
     # Stable identity: mesh shape + device ids (NOT id(mesh) — a GC'd mesh's address
     # can be reused by a different mesh, which would alias cache entries).

@@ -94,6 +94,27 @@ def ring_aligned_rc(group: ProcessGroup, rc: int, block: int) -> int:
     return rc
 
 
+def logical_residual(err, g, chunk, rc, count):
+    """Ring-layout error-feedback residual -> the logical buffer layout.
+
+    The residual a quantized request carries (CommRequest._err) lives in the
+    ring's chunked layout: ``(*grid, g*chunk)`` where slice ``j`` of the
+    logical partition (length ``rc``) sits at the START of padded chunk
+    ``j`` (see ``_to_chunks``). When the recovery supervisor degrades the
+    quantized ring to the plain allreduce, the un-sent residual must be
+    flushed INTO the plain payload — delivered exactly once, not dropped —
+    so this inverts the chunking: take the first ``rc`` elements of each
+    chunk and truncate the concatenation to ``count``. Residual accumulated
+    in the zero-padding region is discarded: it never contributes to the
+    healthy path's output either (the ring result is likewise truncated).
+
+    Trailing-dim-only reshapes/slices: sharding over the grid axes is
+    preserved, so the flush is local (no communication)."""
+    lead = err.shape[:-1]
+    e = err.reshape(*lead, g, chunk)[..., :rc]
+    return e.reshape(*lead, g * rc)[..., :count]
+
+
 def _to_chunks(x, G, rc, chunk):
     """(n_orig,) -> (G, chunk): slice j of the logical partition (length rc) sits at
     the START of padded chunk j, so ring chunk ownership == MPI slice placement."""
@@ -227,14 +248,16 @@ def build_quantized_collective(
     return fn, err_len
 
 
-def _chaos_roundtrip(fn: Callable) -> Callable:
+def _chaos_roundtrip(fn: Callable, algo: str = "quant_ring") -> Callable:
     """Wrap the compiled ring so every (buf, err) round-trip passes the
     'codec.roundtrip' chaos site — faults at the compressed-wire layer must be
     recoverable (EQuARX/THC pair compressed collectives with correctness
     safeguards; ours is the tested recovery path) — and, when tracing is armed
     (mlsl_tpu.obs), records the host-side quant encode/ring/decode enqueue as
     a 'quant.roundtrip' span (device completion lands in the owning request's
-    wait span)."""
+    wait span). ``algo`` names the wire family in the span (the sparse top-k
+    path reuses this wrapper — every compressed family shares the codec
+    chaos site and the codec circuit breaker)."""
     from mlsl_tpu import chaos
     from mlsl_tpu.obs import tracer as obs
 
@@ -248,7 +271,7 @@ def _chaos_roundtrip(fn: Callable) -> Callable:
         out = fn(buf, err)
         tr.complete("quant.roundtrip", "quant", t0,
                     elems=int(buf.shape[-1]) if hasattr(buf, "shape") else 0,
-                    algo="quant_ring")
+                    algo=algo)
         return out
 
     roundtrip.__wrapped__ = fn

@@ -28,7 +28,7 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
-from mlsl_tpu import chaos
+from mlsl_tpu import chaos, supervisor
 from mlsl_tpu.obs import tracer as obs
 from mlsl_tpu.comm.mesh import NUM_GRID_AXES, ProcessGroup
 from mlsl_tpu.log import (
@@ -116,6 +116,26 @@ class CommRequest:
         self._dlock = threading.Lock()  # serializes dispatch vs restart
         self._dispatch_error: Optional[BaseException] = None
         self._single_full = False  # hot path: one un-chunked program
+        # recovery-ladder state (mlsl_tpu.supervisor). _breaker is None for
+        # requests with no degradable subsystem (the plain 'lax' path) — the
+        # hot dispatch then pays exactly one None test. Assigned at setup().
+        self._breaker: Optional[supervisor.CircuitBreaker] = None
+        self._degrade_subsys: Optional[str] = None
+        self._degrade_fns: Optional[tuple] = None   # (flush jit, plain fn)
+        self._degrade_geoms: Optional[List[tuple]] = None  # (count, err_len)/chunk
+        self._err_layout: Optional[str] = None      # 'ring' | 'flat'
+        self._lax_fns: Optional[List[Callable]] = None  # dense algo fallback
+        self._lax_build: Optional[tuple] = None     # (dtype, kw) for it
+        # last Start buffer: rung-2 wait retries re-dispatch it (a transient
+        # wait failure leaves the in-flight round suspect). One reference —
+        # comparable retention to the quant path's _err buffer.
+        self._last_buf: Optional[jax.Array] = None
+        # error-feedback state at Start (err, errs): any retry or degraded
+        # re-attempt rewinds to this before re-dispatching — a failed (or
+        # wait-failed) quantized dispatch has already advanced the residual,
+        # and replaying from the advanced state would silently drop the
+        # accumulated undelivered gradient
+        self._ef_snapshot: tuple = (None, None)
         with CommRequest._seq_lock:
             CommRequest._seq += 1
             self.uid = CommRequest._seq
@@ -147,6 +167,12 @@ class CommRequest:
             )
             self._chunk_slices = [slice(None)]
             self.algo = "topk"
+            # ladder: the sparse wire rides the codec subsystem's breaker;
+            # its residual is already in the logical layout ('flat')
+            self._breaker = supervisor.breaker("quant")
+            self._degrade_subsys = "quant"
+            self._err_layout = "flat"
+            self._degrade_geoms = [(d.count, self._err_len)]
             self.is_setup = True
             return
         if d.compression == CompressionType.QUANTIZATION and d.kind in (
@@ -192,9 +218,20 @@ class CommRequest:
                     self._quant_fns.append(fn)
                     self._err_lens.append(el)
                 self._chunk_slices = chunks
+                self._degrade_geoms = [
+                    (sl.stop - sl.start, el)
+                    for sl, el in zip(chunks, self._err_lens)
+                ]
             else:
                 self._quant_fn, self._err_len = build(d.count)
                 self._chunk_slices = [slice(None)]
+                self._degrade_geoms = [(d.count, self._err_len)]
+            # ladder: codec faults count against the quant breaker; when it
+            # trips, dispatch degrades to the plain f32 SUM program with the
+            # residual flushed (_dispatch_degraded)
+            self._breaker = supervisor.breaker("quant")
+            self._degrade_subsys = "quant"
+            self._err_layout = "ring"  # quant_ring AND custom_codec layout
             self.is_setup = True
             return
         if d.kind == "barrier":
@@ -240,6 +277,13 @@ class CommRequest:
             fn = algos.build(d.kind, d.group, dtype, self.algo, **kw)
             self._fns = [fn] * len(chunks)
             self._chunk_slices = chunks
+        if self.algo != algos.DEFAULT:
+            # ladder: a tuned/forced algorithm can degrade to the 'lax'
+            # baseline per dispatch; the baseline itself has no lower rung
+            # (its failures escalate straight to supervised restart)
+            self._breaker = supervisor.breaker("algo")
+            self._degrade_subsys = "algo"
+            self._lax_build = (dtype, dict(kw))
         # hot-path precomputation: the per-layer dispatch floor must stay in
         # single-digit µs (VERDICT r4 item 3), so nothing re-derived per Start
         self._single_full = (
@@ -316,7 +360,13 @@ class CommRequest:
 
     # -- start/wait/test --------------------------------------------------
 
-    def start(self, buf: jax.Array) -> "CommRequest":
+    def start(self, buf: jax.Array, *, _rewind_ef: bool = False) -> "CommRequest":
+        """``_rewind_ef`` (internal, wait-retry only): rewind the
+        error-feedback state to the previous Start's snapshot inside the
+        epoch-bump critical section — after the bump a stale in-flight
+        dispatch skips on the epoch check, and one that completed first is
+        rewound here, so the replay always quantizes from the exact state
+        the suspect round saw."""
         mlsl_assert(self.is_setup, "request must be setup() before start()")
         if chaos._plans:
             chaos.inject("request.start", request=self.name or self.uid,
@@ -332,11 +382,17 @@ class CommRequest:
         # below — never after it (the clobber the supersede logic exists for).
         with self._dlock:
             self._epoch += 1
+            if _rewind_ef:
+                self._ef_restore()
             self._results = []
             self._result = None
             self._dispatch_error = None
             self.is_started = True
             self._started_at = time.monotonic()  # watchdog stamp
+            self._last_buf = buf  # rung-2 wait retries re-dispatch this
+            self._ef_snapshot = (
+                self._err, list(self._errs) if self._errs is not None else None
+            )
         tr = obs._tracer
         if tr is not None:
             tr.instant("submit", "req", track=self._trace_name,
@@ -364,7 +420,7 @@ class CommRequest:
             t0 = tr.now() if tr is not None else 0
             try:
                 with jax.profiler.TraceAnnotation(self._trace_name):
-                    self._dispatch_inner(buf)
+                    self._dispatch_ladder(buf)
             except Exception as e:
                 if tr is not None:
                     tr.instant("dispatch.error", "req", track=self._trace_name,
@@ -387,6 +443,181 @@ class CommRequest:
                     tr.complete("dispatch", "req", t0, track=self._trace_name,
                                 req=self.name or self.uid, epoch=self._epoch,
                                 algo=self.algo)
+
+    def _dispatch_ladder(self, buf: jax.Array) -> None:
+        """Rungs 2+3 of the recovery ladder around one dispatch (caller holds
+        _dlock). TRANSIENT failures (supervisor.classify) retry in place with
+        exponential backoff + jitter (``MLSL_COMM_RETRIES`` /
+        ``MLSL_COMM_RETRY_BACKOFF_S``); CORRUPTION/PERSISTENT failures count
+        against the request's subsystem breaker, and once it is OPEN — the
+        tripping failure included — the dispatch is served by the degraded
+        fallback path instead of raising. A healthy dispatch while the
+        breaker is HALF_OPEN is the probe: its success re-closes the breaker
+        and re-engages the fast path. FATAL failures raise untouched.
+
+        The retry backoff sleeps in place — on the shared progress thread
+        when dispatch is deferred. That stalls other queued dispatches for
+        the backoff duration (bounded: ~retries x 1.5 x base, ~0.2s at the
+        defaults — comparable to one chunked large-message dispatch);
+        transients are rare by classification, and keeping the retry in
+        line preserves the dispatch-order/supersede invariants a re-queue
+        would have to re-prove. Keep retries x backoff well under the
+        watchdog timeout (TUNING.md §11) so a backing-off request cannot
+        cascade watchdog trips on the requests queued behind it."""
+        br = self._breaker
+        attempt = 0
+        forced_degrade = False
+        while True:
+            degraded = forced_degrade or (br is not None and not br.allow())
+            try:
+                if degraded:
+                    self._dispatch_degraded(buf)
+                else:
+                    self._dispatch_inner(buf)
+            except Exception as e:
+                # any re-attempt (retry, degrade, half-open probe loop)
+                # replays the round from the Start residual state
+                self._ef_restore()
+                cfg = self.dispatcher.config
+                cls = supervisor.classify(e)
+                if cls is supervisor.ErrorClass.TRANSIENT:
+                    if attempt < getattr(cfg, "comm_retries", 0):
+                        delay = supervisor.jittered_backoff(
+                            getattr(cfg, "comm_retry_backoff_s", 0.05), attempt
+                        )
+                        stats_mod.record_comm_retry(
+                            "dispatch", self.name or str(self.uid), e,
+                            attempt + 1, delay,
+                        )
+                        log_debug(
+                            "transient dispatch failure of %s (%s); retry %d "
+                            "in %.3fs", self.name or self.uid, e, attempt + 1,
+                            delay,
+                        )
+                        attempt += 1
+                        time.sleep(delay)
+                        continue
+                if (
+                    not degraded
+                    and br is not None
+                    and cls is not supervisor.ErrorClass.FATAL
+                    and br.record_failure(e)
+                ):
+                    # OPEN now (this failure tripped it, or a half-open probe
+                    # failed): serve THIS dispatch degraded — rung 3's whole
+                    # point is that the request succeeds instead of dying.
+                    # forced: do not re-consult allow() (a zero cooldown must
+                    # not ping-pong probe/fail forever inside one dispatch).
+                    forced_degrade = True
+                    continue
+                raise
+            else:
+                if br is not None and not degraded:
+                    br.record_success()  # no-op unless HALF_OPEN (the probe)
+                return
+
+    def _dispatch_degraded(self, buf: jax.Array) -> None:
+        """The rung-3 fallback dispatch: compressed wire -> plain f32 SUM
+        with the error-feedback residual flushed into the payload (delivered
+        exactly once, not dropped); tuned algorithm -> the 'lax' baseline.
+        Result shape/dtype match the healthy path exactly — callers cannot
+        tell a degraded round from a healthy one except through stats."""
+        d = self.desc
+        topo0 = d.group.topology
+        if hasattr(buf, "ndim") and (
+            buf.ndim != NUM_GRID_AXES + 1
+            or tuple(buf.shape[:NUM_GRID_AXES]) != topo0.grid_shape
+        ):
+            buf = topo0.adopt_buffer(buf)
+        stats_mod.record_degrade(self._degrade_subsys or "?", "fallback")
+        if self._quant_fn is not None or self._quant_fns is not None:
+            flush, plain = self._degrade_programs()
+            out = plain(flush(buf, *self._take_residuals()))
+            self._results = [out]
+            stats_mod.record_algo_dispatch(d.kind, "degraded-plain")
+            return
+        # dense engine path: tuned/forced algorithm -> the 'lax' baseline
+        if self._lax_fns is None:
+            dtype, kw = self._lax_build
+            fn = algos.build(d.kind, d.group, dtype, algos.DEFAULT, **kw)
+            self._lax_fns = [fn] * len(self._chunk_slices)
+        stats_mod.record_algo_dispatch(d.kind, algos.DEFAULT)
+        if self._single_full:
+            self._results = [self._lax_fns[0](buf)]
+        else:
+            self._results = [
+                fn(buf[..., sl])
+                for fn, sl in zip(self._lax_fns, self._chunk_slices)
+            ]
+
+    def _degrade_programs(self) -> tuple:
+        """(flush jit, plain collective) for the degraded compressed path,
+        built on first degrade and cached. flush casts to f32 and adds each
+        chunk's un-chunked residual (quant_ring.logical_residual) at its
+        slice; plain is the SAME cached build_collective program the
+        uncompressed path uses — the parity anchor."""
+        if self._degrade_fns is None:
+            from mlsl_tpu.comm.quant_ring import logical_residual
+
+            d = self.desc
+            g = 1 if d.group.is_self else d.group.size
+            plain = collectives.build_plain_fallback(d.kind, d.group, d.count)
+            rs = d.kind == "reduce_scatter"
+            slices = list(self._chunk_slices)
+            geoms = list(self._degrade_geoms)
+            flat = self._err_layout == "flat"
+            full = slices == [slice(None)]
+
+            def flush(b, *errs):
+                x = b.astype(jnp.float32)
+                for sl, (n, el), e in zip(slices, geoms, errs):
+                    res = e if flat else logical_residual(
+                        e, g, el // g, n // g if rs else -(-n // g), n
+                    )
+                    x = x + res if full else x.at[..., sl].add(res)
+                return x
+
+            self._degrade_fns = (jax.jit(flush), plain)
+        return self._degrade_fns
+
+    def _ef_restore(self) -> None:
+        """Rewind the error-feedback state to the Start snapshot before any
+        re-attempt: a failed chunked dispatch may have advanced a prefix of
+        the residuals, a wait-failed dispatch advanced all of them, and a
+        failed degraded dispatch consumed them (_take_residuals) — in every
+        case the replay must see the exact state the first attempt saw, or
+        accumulated undelivered gradient is silently dropped (or flushed
+        zero times). Arrays are immutable, so restoring references is a
+        full rewind; the list is copied so the in-place chunk updates of
+        the next attempt cannot corrupt the snapshot."""
+        err, errs = self._ef_snapshot
+        self._err = err
+        self._errs = list(errs) if errs is not None else None
+
+    def _take_residuals(self) -> List[jax.Array]:
+        """Consume the error-feedback residual(s) for a degraded dispatch:
+        lazily zeroed like the healthy path's first round, then RESET — the
+        flush delivers the residual, and the next healthy round (the
+        half-open probe) starts from virgin feedback state. Consumed BEFORE
+        the plain dispatch runs; a transiently failed fallback dispatch is
+        made safe by _ef_restore in the retry loop (the residual is flushed
+        exactly once — by whichever attempt succeeds)."""
+        topo = self.desc.group.topology
+
+        def zeros(el):
+            return topo.shard_buffer(
+                np.zeros((*topo.grid_shape, el), dtype=np.float32)
+            )
+
+        if self._quant_fns is not None:
+            errs = self._errs if self._errs is not None else [
+                zeros(el) for el in self._err_lens
+            ]
+            self._errs = None
+            return errs
+        err = self._err if self._err is not None else zeros(self._err_len)
+        self._err = None
+        return [err]
 
     def _dispatch_inner(self, buf: jax.Array) -> None:
         # per-algorithm launch attribution (ALGO line in mlsl_stats.log);
@@ -453,11 +684,17 @@ class CommRequest:
     def describe(self) -> str:
         """One-line stuck-request descriptor for the watchdog log."""
         d = self.desc
-        return (
+        s = (
             f"{d.kind} name={self.name or self.uid} algo={self.algo} "
             f"count={d.count} dtype={d.data_type.name} axes={d.group.axes} "
             f"payload={self._payload}B epoch={self._epoch}"
         )
+        br = self._breaker
+        if br is not None and br.state != supervisor.CLOSED:
+            # the ladder's state is part of the request's identity while it
+            # lasts: a watchdog report on a DEGRADED dispatch must say so
+            s += f" breaker={br.name}:{br.state}"
+        return s
 
     def _watchdog_trip(self, phase: str) -> None:
         """Log the stuck descriptor (core/stats.py keeps the event record) and
@@ -499,20 +736,50 @@ class CommRequest:
         if not self.is_started and self._result is not None:
             return self._result
         mlsl_assert(self.is_started, "request was not started")
-        if chaos._plans:
-            chaos.inject("request.wait", request=self.name or self.uid,
-                         kind=self.desc.kind)
         tr = obs._tracer
         t0 = tr.now() if tr is not None else 0
-        deadline = self._watchdog_deadline(timeout)
-        self.dispatcher.wait_dispatched(self, deadline)
-        if self._dispatch_error is not None:
-            err, self._dispatch_error = self._dispatch_error, None
-            self.is_started = False
-            raise err
-        out = self._assemble()
-        self._block_ready(out, deadline)
+        attempt = 0
+        while True:
+            try:
+                out = self._wait_inner(timeout)
+            except Exception as e:
+                # rung 2 for the wait side: a TRANSIENT failure surfacing at
+                # wait (an injected fault at the wait site, a dispatch error
+                # that exhausted ITS retries, a device read error) re-Starts
+                # the stored buffer — the in-flight round is suspect, and a
+                # fresh epoch supersedes anything still racing. Worst case
+                # (permanently-transient fault) is (retries+1)^2 dispatch
+                # attempts: both layers spend their own small budget.
+                cfg = self.dispatcher.config
+                if (
+                    supervisor.classify(e)
+                    is not supervisor.ErrorClass.TRANSIENT
+                    or attempt >= getattr(cfg, "comm_retries", 0)
+                    or self._last_buf is None
+                ):
+                    raise
+                delay = supervisor.jittered_backoff(
+                    getattr(cfg, "comm_retry_backoff_s", 0.05), attempt
+                )
+                stats_mod.record_comm_retry(
+                    "wait", self.name or str(self.uid), e, attempt + 1, delay
+                )
+                log_debug(
+                    "transient wait failure of %s (%s); re-dispatching, "
+                    "retry %d in %.3fs", self.name or self.uid, e,
+                    attempt + 1, delay,
+                )
+                attempt += 1
+                time.sleep(delay)
+                self.start(self._last_buf, _rewind_ef=True)
+                continue
+            break
         self.is_started = False
+        # the round is over: the retry buffer and residual snapshot are only
+        # needed while in flight — release them or every request permanently
+        # retains a gradient-sized device array between rounds
+        self._last_buf = None
+        self._ef_snapshot = (None, None)
         if tr is not None:
             # the wait STALL: host time blocked for this request (dispatch
             # race + device completion) — the per-op overlap-loss signal
@@ -523,6 +790,22 @@ class CommRequest:
             tr.complete("wait", "req", t0, track=self._trace_name,
                         req=self.name or self.uid, epoch=self._epoch,
                         algo=self.algo)
+        return out
+
+    def _wait_inner(self, timeout: Optional[float]) -> jax.Array:
+        """One wait attempt: chaos site, dispatch drain, error surface,
+        assemble, block. Split out so wait() can retry transients."""
+        if chaos._plans:
+            chaos.inject("request.wait", request=self.name or self.uid,
+                         kind=self.desc.kind)
+        deadline = self._watchdog_deadline(timeout)
+        self.dispatcher.wait_dispatched(self, deadline)
+        if self._dispatch_error is not None:
+            err, self._dispatch_error = self._dispatch_error, None
+            self.is_started = False
+            raise err
+        out = self._assemble()
+        self._block_ready(out, deadline)
         return out
 
     def test(self) -> tuple:
@@ -546,6 +829,8 @@ class CommRequest:
             out = self._assemble()
             jax.block_until_ready(out)
             self.is_started = False
+            self._last_buf = None  # round over: release the retry buffer
+            self._ef_snapshot = (None, None)
             tr = obs._tracer
             if tr is not None:
                 tr.instant("test.done", "req", track=self._trace_name,

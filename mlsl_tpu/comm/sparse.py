@@ -120,11 +120,15 @@ def build_sparse_collective(
     import functools
 
     from mlsl_tpu.comm.collectives import build_stateful_collective
+    from mlsl_tpu.comm.quant_ring import _chaos_roundtrip
 
     body = functools.partial(
         _sparse_body, axes=axes, sizes=sizes, k=k, n=count, recv_count=recv_count,
         use_ring=use_ring,
     )
-    fn = build_stateful_collective(body, topo.mesh)
+    # same 'codec.roundtrip' chaos site and codec breaker as the int8 ring:
+    # every compressed wire family is injectable and degradable uniformly
+    fn = _chaos_roundtrip(build_stateful_collective(body, topo.mesh),
+                          algo="topk")
     _cache[key] = fn
     return fn, count

@@ -129,8 +129,30 @@ class Config:
     # not by mutating these fields.
     ckpt_save_retries: int = 3          # MLSL_CKPT_SAVE_RETRIES
     ckpt_retry_backoff_s: float = 0.05  # MLSL_CKPT_RETRY_BACKOFF_S
-    # Fault-injection spec; parsed by mlsl_tpu.chaos (site:kind[=v][@after][xN],
-    # comma-separated). Kept here for discoverability/printing only.
+    # Recovery ladder (mlsl_tpu.supervisor). Rung 2: transient collective
+    # dispatch/wait failures retry in place with exponential backoff +
+    # jitter before anything escalates. 0 = no retries (fail straight to
+    # the breaker/restart rungs).
+    comm_retries: int = 2               # MLSL_COMM_RETRIES
+    comm_retry_backoff_s: float = 0.05  # MLSL_COMM_RETRY_BACKOFF_S
+    # Rung 3: per-subsystem circuit breakers (quant codec, grad buckets,
+    # algo engine, tracer). After `threshold` classified failures inside the
+    # sliding window the subsystem degrades to its always-correct fallback;
+    # after the cooldown a half-open probe re-engages the fast path.
+    # Breakers are process-wide (state survives Environment rebuilds —
+    # deliberately, so recovery cycles can escalate); these knobs are
+    # (re)applied to them at Environment.init via supervisor.configure.
+    breaker_threshold: int = 3          # MLSL_BREAKER_THRESHOLD
+    breaker_window_s: float = 30.0      # MLSL_BREAKER_WINDOW_S
+    breaker_cooldown_s: float = 10.0    # MLSL_BREAKER_COOLDOWN_S
+    # Rung 4: total checkpoint recoveries FaultTolerantLoop performs across
+    # a run before aborting with a flight record. Read by the loop itself
+    # (like the checkpoint retry knobs: recorded here for discoverability —
+    # override via the FaultTolerantLoop ctor, not by mutating this field).
+    restart_budget: int = 20            # MLSL_RESTART_BUDGET
+    # Fault-injection spec; parsed by mlsl_tpu.chaos
+    # (site:kind[=v][@after][xN][%p], comma-separated). Kept here for
+    # discoverability/printing only.
     chaos_spec: str = ""            # MLSL_CHAOS
 
     # --- observability tier (mlsl_tpu.obs span tracer) ---
@@ -204,6 +226,29 @@ class Config:
             "MLSL_WATCHDOG_TIMEOUT must be >= 0 (got %r)",
             self.watchdog_timeout_s,
         )
+        mlsl_assert(
+            self.comm_retries >= 0,
+            "MLSL_COMM_RETRIES must be >= 0 (got %d)", self.comm_retries,
+        )
+        mlsl_assert(
+            self.comm_retry_backoff_s >= 0,
+            "MLSL_COMM_RETRY_BACKOFF_S must be >= 0 (got %r)",
+            self.comm_retry_backoff_s,
+        )
+        mlsl_assert(
+            self.breaker_threshold >= 1,
+            "MLSL_BREAKER_THRESHOLD must be >= 1 (got %d)",
+            self.breaker_threshold,
+        )
+        mlsl_assert(
+            self.breaker_window_s >= 0 and self.breaker_cooldown_s >= 0,
+            "MLSL_BREAKER_WINDOW_S / MLSL_BREAKER_COOLDOWN_S must be >= 0 "
+            "(got %r / %r)", self.breaker_window_s, self.breaker_cooldown_s,
+        )
+        mlsl_assert(
+            self.restart_budget >= 0,
+            "MLSL_RESTART_BUDGET must be >= 0 (got %d)", self.restart_budget,
+        )
 
     @staticmethod
     def from_env() -> "Config":
@@ -241,6 +286,16 @@ class Config:
         c.quant_block_elems = _env_int("MLSL_QUANT_BLOCK_ELEMS", c.quant_block_elems)
         c.topk_ratio = _env_float("MLSL_TOPK_RATIO", c.topk_ratio)
         c.watchdog_timeout_s = _env_float("MLSL_WATCHDOG_TIMEOUT", c.watchdog_timeout_s)
+        c.comm_retries = _env_int("MLSL_COMM_RETRIES", c.comm_retries)
+        c.comm_retry_backoff_s = _env_float(
+            "MLSL_COMM_RETRY_BACKOFF_S", c.comm_retry_backoff_s
+        )
+        c.breaker_threshold = _env_int("MLSL_BREAKER_THRESHOLD", c.breaker_threshold)
+        c.breaker_window_s = _env_float("MLSL_BREAKER_WINDOW_S", c.breaker_window_s)
+        c.breaker_cooldown_s = _env_float(
+            "MLSL_BREAKER_COOLDOWN_S", c.breaker_cooldown_s
+        )
+        c.restart_budget = _env_int("MLSL_RESTART_BUDGET", c.restart_budget)
         c.ckpt_save_retries = _env_int("MLSL_CKPT_SAVE_RETRIES", c.ckpt_save_retries)
         c.ckpt_retry_backoff_s = _env_float(
             "MLSL_CKPT_RETRY_BACKOFF_S", c.ckpt_retry_backoff_s

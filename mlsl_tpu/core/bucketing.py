@@ -41,6 +41,7 @@ from typing import List
 import jax
 import jax.numpy as jnp
 
+from mlsl_tpu import supervisor
 from mlsl_tpu.comm.request import CommDesc, CommRequest, ComputeType
 from mlsl_tpu.core import stats as stats_mod
 from mlsl_tpu.obs import tracer as obs
@@ -220,11 +221,18 @@ class GradBucket:
         self._last: dict = {}        # member index -> last delivered result
         self._round = 0              # bumped at every re-arm: detects a round
                                      # completing under an out-of-lock wait
+        self._degraded_round = -1    # _round value the last degrade fired on
         # a failed bucket dispatch must raise at EVERY member's wait/test —
         # like the per-layer path, where each request raises its own error —
         # not only at the first waiter (CommRequest consumes its error once)
         self._error = None
         self._error_left: set = set()
+        # recovery ladder (mlsl_tpu.supervisor): classified failures of the
+        # coalesced request count against the process-wide bucket breaker;
+        # once OPEN, rounds degrade to the members' individual requests (the
+        # always-correct path coalescing merely optimizes) until the
+        # half-open probe round succeeds
+        self._breaker = supervisor.breaker("bucket")
 
     # -- round state machine (all under _lock) -----------------------------
 
@@ -246,6 +254,13 @@ class GradBucket:
                 stats_mod.record_bucket_round("abandon", self.kind)
                 self._consume_locked(i)
                 return False
+            if not self._bufs and not self._breaker.allow():
+                # bucket breaker OPEN (supervisor rung 3): deny the fresh
+                # round at its boundary — every member runs its individual
+                # request until the cooldown admits a half-open probe round.
+                # Mid-round members keep registering so an admitted round
+                # always completes or fails as a unit.
+                return False
             self._bufs[i] = buf  # a pre-dispatch restart supersedes
             if len(self._bufs) == len(self.members):
                 # _error is necessarily None here: every member passed the
@@ -253,7 +268,23 @@ class GradBucket:
                 ordered = [self._bufs[j] for j in range(len(self.members))]
                 tr = obs._tracer
                 t0 = tr.now() if tr is not None else 0
-                self.req.start(self._concat(*ordered))
+                try:
+                    self.req.start(self._concat(*ordered))
+                except Exception as e:
+                    # a DIRECT dispatch (msg_priority off) fails at Start,
+                    # not at the members' waits: run the same ladder here.
+                    # Degrade pops OUR buffer first — the caller starts our
+                    # individual request on the False return, while
+                    # _fallback_locked starts everyone else's. Below the
+                    # breaker threshold the error propagates to THIS caller
+                    # only: the round never dispatched, so the other
+                    # members' buffers are intact and their waits take the
+                    # existing pre-dispatch fallback (individual requests) —
+                    # correctness never depends on co-arrival.
+                    del self._bufs[i]
+                    if self._degrade_locked(e):
+                        return False
+                    raise
                 if tr is not None:
                     # pack + coalesced Start on the bucket request's track
                     # (its submit/dispatch/wait spans land there too)
@@ -315,6 +346,30 @@ class GradBucket:
         self._parts = None
         self._round += 1
 
+    def _degrade_locked(self, e: BaseException) -> bool:
+        """Rung 3 for a failed coalesced round (caller holds _lock): count
+        the classified failure against the bucket breaker; once it is OPEN
+        (this failure tripped it, or a probe round failed) the round degrades
+        — every registered member's INDIVIDUAL request starts with its
+        registered buffer (the always-correct path, delivering this round's
+        gradients without a recovery cycle) and the bucket re-arms. Returns
+        True when degraded; False leaves the error for _record_error_locked
+        (below threshold: the failure escalates to supervised restart)."""
+        if supervisor.classify(e) is supervisor.ErrorClass.FATAL:
+            return False
+        if not self._breaker.record_failure(e):
+            return False
+        stats_mod.record_degrade(
+            "bucket", "fallback",
+            detail=f"{self.kind}[{len(self.members)}]: "
+                   f"{type(e).__name__}: {e}",
+        )
+        self._degraded_round = self._round
+        self._dispatched = False
+        self._parts = None
+        self._fallback_locked()
+        return True
+
     def _raise_error_locked(self, i: int) -> None:
         err = self._error
         self._error_left.discard(i)
@@ -355,9 +410,28 @@ class GradBucket:
             out = self.req.wait()
         except Exception as e:
             with self._lock:
+                if self._round == r0:
+                    # first waiter to see the failure decides the round's
+                    # fate: degrade (breaker OPEN — individual requests are
+                    # now running, ours included) or record for every member
+                    if self._degrade_locked(e):
+                        return False, None
+                    if self._error is None:
+                        self._record_error_locked(e)
+                    self._raise_error_locked(i)
+                if self._degraded_round == r0:
+                    # a concurrent waiter degraded this round under us; our
+                    # individual request was started by its fallback
+                    return False, None
+                if self._error is not None and i in self._error_left:
+                    self._raise_error_locked(i)
+                # round completed under us despite our local failure (e.g. a
+                # watchdog trip racing a successful concurrent wait): keep
+                # the first-error-wins contract
                 if self._error is None:
                     self._record_error_locked(e)
                 self._raise_error_locked(i)
+        self._breaker.record_success()  # no-op unless HALF_OPEN (the probe)
         with self._lock:
             if self._round != r0:
                 # the round completed (or failed over) under us — a concurrent
@@ -385,11 +459,16 @@ class GradBucket:
             try:
                 done, out = self.req.test()
             except Exception as e:
+                if self._degrade_locked(e):
+                    # degraded: the member's individual request is running —
+                    # handled=False sends the caller to poll it
+                    return False, False, None
                 if self._error is None:
                     self._record_error_locked(e)
                 self._raise_error_locked(i)
             if not done:
                 return True, False, None
+            self._breaker.record_success()  # no-op unless HALF_OPEN
             return True, True, self._part_locked(out, i)
 
     # -- AOT precompilation (Session.precompile_collectives) ---------------

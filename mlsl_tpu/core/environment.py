@@ -87,6 +87,13 @@ class Environment:
         # MLSL_ALGO name outside the registry, nonsensical knob ranges — are
         # init-time errors, not latent dispatch failures
         self.config.validate()
+        # (re)apply the recovery-ladder breaker knobs: breakers are
+        # process-wide and keep their STATE across an Environment rebuild
+        # (subsystem health must survive recovery cycles), but adopt the
+        # freshly validated thresholds
+        from mlsl_tpu import supervisor
+
+        supervisor.configure(self.config)
         self.devices = tuple(devices) if devices is not None else tuple(jax.devices())
         # the persistent XLA cache must be armed BEFORE the tuner sweep: the
         # sweep compiles every eligible algorithm x size x shape program, and

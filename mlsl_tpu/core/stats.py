@@ -135,6 +135,82 @@ def reset_bucket_counters() -> None:
     BUCKET_EVENTS.clear()
 
 
+# Degradation-ladder accounting (mlsl_tpu.supervisor): breaker transitions,
+# degraded dispatches, comm retries, and supervised recoveries — process-wide
+# like the watchdog record (breakers fire from the request layer with no
+# Session handle). Breaker transitions append a DEGRADE line to
+# STATS_OUTPUT_FILE immediately (cold path — trips are rare by construction);
+# per-dispatch fallbacks and retries only bump counters + the obs timeline
+# (an OPEN breaker degrades every dispatch, and a file append per layer per
+# step would be the new bottleneck). Statistics.print_ renders the counter
+# totals as the DEGRADE summary line.
+DEGRADE_EVENTS: Deque[dict] = collections.deque(maxlen=256)
+DEGRADE_COUNTERS: Dict[str, int] = {
+    "breaker_trips": 0,     # closed/half_open -> open transitions
+    "breaker_probes": 0,    # open -> half_open probe admissions
+    "breaker_resets": 0,    # half_open -> closed (healthy path re-engaged)
+    "comm_retries": 0,      # rung-2 transient retries (dispatch + wait)
+    "recoveries": 0,        # rung-4 supervised checkpoint restarts
+}
+#: degraded dispatches per subsystem (quant->plain, bucket->individual,
+#: algo->lax, tracer->no-op)
+DEGRADE_FALLBACKS: Dict[str, int] = {}
+
+
+def record_degrade(subsystem: str, event: str, detail: str = "") -> None:
+    """One ladder event: ``event`` is a breaker transition ('trip' /
+    'probe' / 'reset'), a degraded dispatch ('fallback'), or a supervised
+    restart ('recover'). Called by supervisor.CircuitBreaker and the
+    degraded call sites."""
+    if event == "trip":
+        DEGRADE_COUNTERS["breaker_trips"] += 1
+    elif event == "probe":
+        DEGRADE_COUNTERS["breaker_probes"] += 1
+    elif event == "reset":
+        DEGRADE_COUNTERS["breaker_resets"] += 1
+    elif event == "recover":
+        DEGRADE_COUNTERS["recoveries"] += 1
+    else:  # fallback: one dispatch served by the degraded path
+        DEGRADE_FALLBACKS[subsystem] = DEGRADE_FALLBACKS.get(subsystem, 0) + 1
+    DEGRADE_EVENTS.append(
+        {"subsystem": subsystem, "event": event, "detail": detail,
+         "at": time.time()}
+    )
+    if obs._tracer is not None:
+        # trip/reset instants bracket the degraded interval on the timeline;
+        # fallback instants attribute each degraded dispatch
+        name = f"breaker.{event}" if event != "fallback" else "degrade.fallback"
+        obs._tracer.instant(name, "degrade", subsystem=subsystem,
+                            detail=detail or None)
+    if event in ("trip", "probe", "reset", "recover"):
+        try:
+            with open(stats_path(), "a") as f:
+                f.write(
+                    f"{'DEGRADE':<16} {event.upper():<8} {subsystem:<10} "
+                    f"{detail}\n"
+                )
+        except OSError:
+            pass
+
+
+def record_comm_retry(phase: str, request: str, error: BaseException,
+                      attempt: int, delay_s: float) -> None:
+    """One rung-2 retry of a transient dispatch/wait failure (called by
+    CommRequest before it backs off)."""
+    DEGRADE_COUNTERS["comm_retries"] += 1
+    if obs._tracer is not None:
+        obs._tracer.instant(f"{phase}.retry", "degrade", request=request,
+                            attempt=attempt, delay_s=round(delay_s, 4),
+                            error=repr(error))
+
+
+def reset_degrade_counters() -> None:
+    for k in DEGRADE_COUNTERS:
+        DEGRADE_COUNTERS[k] = 0
+    DEGRADE_FALLBACKS.clear()
+    DEGRADE_EVENTS.clear()
+
+
 # Per-algorithm dispatch accounting (comm/algos): process-wide like the
 # bucket counters — dispatch fires at the request layer with no Session
 # handle. Key = (kind, algorithm name); value = launches. The point: traces
@@ -517,6 +593,30 @@ class Statistics:
             ]
             lines.append(
                 f"{'ALGO':<16} {'DISPATCH':<8} " + " ".join(parts)
+            )
+        dc = DEGRADE_COUNTERS
+        if any(dc.values()) or DEGRADE_FALLBACKS:
+            # the ladder summary: every trip/probe/reset, retry, degraded
+            # dispatch, and supervised recovery of this run, plus the live
+            # breaker states — one grep ('DEGRADE') answers "did this run
+            # ever leave the healthy path, and is it back on it"
+            from mlsl_tpu import supervisor  # lazy: supervisor imports stats
+
+            states = " ".join(
+                f"{name}:{st['state']}"
+                for name, st in supervisor.status().items()
+                if st.get("trips") or st["state"] != supervisor.CLOSED
+            )
+            fb = " ".join(
+                f"{name}={n}" for name, n in sorted(DEGRADE_FALLBACKS.items())
+            )
+            lines.append(
+                f"{'DEGRADE':<16} {'LADDER':<8} retries {dc['comm_retries']} "
+                f"trips {dc['breaker_trips']} probes {dc['breaker_probes']} "
+                f"resets {dc['breaker_resets']} "
+                f"recoveries {dc['recoveries']}"
+                + (f" fallbacks {fb}" if fb else "")
+                + (f" breakers {states}" if states else "")
             )
         text = "\n".join(lines) + "\n"
         try:

@@ -37,6 +37,14 @@ class MLSLTimeoutError(MLSLError):
     rebuild, restore — instead of blocking forever."""
 
 
+class MLSLCorruptionError(MLSLError):
+    """Data-integrity failure: bitrot, a codec round-trip that does not
+    verify, a checksum mismatch. Classified CORRUPTION by the recovery
+    supervisor (mlsl_tpu.supervisor): the producing subsystem is suspect, so
+    the failure counts against that subsystem's circuit breaker and degrades
+    it to the always-correct path rather than retrying in place."""
+
+
 def set_log_level(level: int | LogLevel) -> None:
     global _level
     _level = LogLevel(int(level))

@@ -108,14 +108,41 @@ def render(events: List[tuple],
     return doc
 
 
-def _write(doc: dict, path: str) -> str:
-    d = os.path.dirname(path)
-    if d:
-        os.makedirs(d, exist_ok=True)
-    tmp = path + ".tmp"
-    with open(tmp, "w") as f:
-        json.dump(doc, f)
-    os.replace(tmp, path)
+def _write(doc: dict, path: str) -> Optional[str]:
+    """Atomic JSON write, gated by the tracer circuit breaker
+    (mlsl_tpu.supervisor): repeated IO failures (full disk, revoked
+    credentials on a network mount) trip it and exports become no-ops —
+    observability degrades instead of taking the training loop down with it
+    — until the half-open probe write succeeds again. Returns None when the
+    breaker is open; IO errors below the trip threshold propagate (callers
+    on error paths already swallow them — flight_record — and interactive
+    callers should see the real failure)."""
+    from mlsl_tpu import supervisor
+
+    br = supervisor.breaker("tracer")
+    if not br.allow():
+        from mlsl_tpu.core import stats as stats_mod
+
+        stats_mod.record_degrade("tracer", "fallback", detail=path)
+        return None
+    try:
+        d = os.path.dirname(path)
+        if d:
+            os.makedirs(d, exist_ok=True)
+        tmp = path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(doc, f)
+        os.replace(tmp, path)
+    except OSError as e:
+        if br.record_failure(e):
+            # tripping (or probe-failing) write: served by the fallback —
+            # a no-op export — per the rung-3 contract, not raised
+            from mlsl_tpu.core import stats as stats_mod
+
+            stats_mod.record_degrade("tracer", "fallback", detail=path)
+            return None
+        raise
+    br.record_success()  # no-op unless HALF_OPEN (the probe write)
     return path
 
 

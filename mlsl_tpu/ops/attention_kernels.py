@@ -27,6 +27,10 @@ from jax import lax
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+# jax renamed TPUCompilerParams -> CompilerParams (jax 0.7); accept either so
+# the flash kernels build on both sides of the rename
+_CompilerParams = getattr(pltpu, "CompilerParams", None) or pltpu.TPUCompilerParams
+
 NEG = -1e30
 
 
@@ -188,7 +192,7 @@ def _flash_fwd(q, k, v, q_offset, k_offset, causal=False, interpret=False,
             if want_lse
             else [jax.ShapeDtypeStruct((bh, sq, d), q.dtype)]
         ),
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_CompilerParams(
             dimension_semantics=("parallel", "parallel", "arbitrary"),
         ),
         interpret=interpret,
@@ -322,7 +326,7 @@ def _flash_bwd(q, k, v, do, out, lse, q_offset, k_offset, causal, interpret):
             scratch_shapes=[pltpu.VMEM((tq, d), jnp.float32)],
         ),
         out_shape=jax.ShapeDtypeStruct((bh, sq, d), q.dtype),
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_CompilerParams(
             dimension_semantics=("parallel", "parallel", "arbitrary"),
         ),
         interpret=interpret,
@@ -355,7 +359,7 @@ def _flash_bwd(q, k, v, do, out, lse, q_offset, k_offset, causal, interpret):
             jax.ShapeDtypeStruct((bh, sk, d), k.dtype),
             jax.ShapeDtypeStruct((bh, sk, d), v.dtype),
         ],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_CompilerParams(
             dimension_semantics=("parallel", "parallel", "arbitrary"),
         ),
         interpret=interpret,
@@ -476,7 +480,7 @@ def _block_update_fwd(q, k, v, acc, m, l, q_offset, k_offset,
         # acc, m, l) -> acc/m/l reuse their input buffers, saving one HBM copy of
         # the dominant long-sequence state per ring hop
         input_output_aliases={5: 0, 6: 1, 7: 2},
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_CompilerParams(
             dimension_semantics=("parallel", "parallel", "arbitrary"),
         ),
         interpret=interpret,

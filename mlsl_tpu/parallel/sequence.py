@@ -139,8 +139,15 @@ def _ring_flash(q, k, v, axis: str, axis_size: int, causal: bool) -> jax.Array:
     bh = b * h
     interpret = jax.default_backend() != "tpu"
     qf = q.reshape(bh, sl, d)
-    me = lax.axis_index(axis)
-    q_off = jnp.full((1,), me * sl, jnp.int32)
+    # The scalar-prefetch offsets only matter for the causal mask / DMA-skip
+    # maps. Non-causal, feed constants: an axis_index-derived operand that the
+    # kernel never reads still lowers to a PartitionId instruction, which
+    # XLA:CPU's SPMD partitioner rejects (the interpret-mode CI path).
+    if causal:
+        me = lax.axis_index(axis)
+        q_off = jnp.full((1,), me * sl, jnp.int32)
+    else:
+        q_off = jnp.zeros((1,), jnp.int32)
 
     init = (
         _pvary(jnp.zeros((bh, sl, d), jnp.float32), axis),
@@ -150,7 +157,8 @@ def _ring_flash(q, k, v, axis: str, axis_size: int, causal: bool) -> jax.Array:
 
     def step_fn(carry, k_cur, v_cur, src):
         acc, m, l = carry
-        k_off = jnp.full((1,), src * sl, jnp.int32)
+        k_off = (jnp.full((1,), src * sl, jnp.int32) if causal
+                 else jnp.zeros((1,), jnp.int32))
         return flash_block_update(
             qf, k_cur, v_cur, acc, m, l, q_off, k_off, causal, interpret
         )

@@ -19,8 +19,9 @@ from typing import Callable, Optional
 
 import jax
 
+from mlsl_tpu import supervisor
 from mlsl_tpu.checkpoint import CheckpointManager, restore_trainer, save_trainer
-from mlsl_tpu.log import MLSLError, log_info, log_warning
+from mlsl_tpu.log import MLSLError, log_error, log_info, log_warning
 from mlsl_tpu.obs import tracer as obs
 
 
@@ -83,6 +84,12 @@ class FaultTolerantLoop:
     max_retries: failures tolerated AT THE SAME STEP before re-raising (guards
         against deterministic poison even when the resume point is several steps
         behind the failure).
+    max_total_recoveries: the restart budget — checkpoint recoveries across
+        the WHOLE run (rung 4 of the supervisor ladder; the lower rungs —
+        comm retries and circuit breakers, mlsl_tpu.supervisor — absorb what
+        they can before a failure ever reaches this loop). None reads
+        ``MLSL_RESTART_BUDGET`` (default 20). Exhausting it aborts with a
+        flight record (tracing armed) and the breaker status in the log.
     on_step fires exactly once per step: replayed steps below the furthest
         reported step are recomputed silently.
     fault_hook(step, attempt): optional test hook, called before each step attempt;
@@ -100,7 +107,7 @@ class FaultTolerantLoop:
         ckpt_dir: str,
         save_every: int = 10,
         max_retries: int = 2,
-        max_total_recoveries: int = 20,
+        max_total_recoveries: Optional[int] = None,
         fault_hook: Optional[Callable] = None,
         handle_preemption: bool = True,
     ):
@@ -111,6 +118,22 @@ class FaultTolerantLoop:
         # bound on recoveries across the whole run: a flaky fault that lands on a
         # DIFFERENT step each cycle resets the per-step count, and without this
         # cap the loop would recover/replay forever
+        if max_total_recoveries is None:
+            # through Config's parser/default so the knob is defined in
+            # exactly one place, with the init-time MLSLError contract
+            from mlsl_tpu.config import Config, _env_int
+
+            try:
+                max_total_recoveries = _env_int(
+                    "MLSL_RESTART_BUDGET", Config.restart_budget
+                )
+            except ValueError as e:
+                raise MLSLError(f"invalid MLSL_RESTART_BUDGET: {e}") from e
+            if max_total_recoveries < 0:
+                raise MLSLError(
+                    f"MLSL_RESTART_BUDGET must be >= 0 "
+                    f"(got {max_total_recoveries})"
+                )
         self.max_total_recoveries = max_total_recoveries
         self.fault_hook = fault_hook
         self.handle_preemption = handle_preemption
@@ -123,6 +146,15 @@ class FaultTolerantLoop:
         tr = obs._tracer
         t0 = tr.now() if tr is not None else 0
         log_info("recovering from %s: %s", type(error).__name__, error)
+        from mlsl_tpu.core import stats as stats_mod
+
+        # rung-4 accounting: the recovery lands in the same DEGRADE record
+        # as breaker trips, so mlsl_stats.log tells the whole ladder's story
+        stats_mod.record_degrade(
+            "loop", "recover",
+            detail=f"#{self.recoveries}/{self.max_total_recoveries} "
+                   f"{type(error).__name__}: {error}",
+        )
         # drain in-flight async saves first: restoring from a half-committed step
         # (or re-saving a step whose original write is still in flight) corrupts
         # the resume point
@@ -157,6 +189,39 @@ class FaultTolerantLoop:
                         error=type(error).__name__, recovery=self.recoveries,
                         resumed_step=restored if restored is not None else -1)
         return trainer, (restored + 1 if restored is not None else 0)
+
+    def _abort(self, step: int, error: BaseException, why: str) -> None:
+        """The ladder's last rung is exhausted: every retry and breaker
+        fallback failed to absorb this fault, and ``why`` names the bound
+        that actually stopped the loop (same-step retry bound vs run-wide
+        restart budget — a post-mortem must point at the right knob).
+        Leave maximal evidence — the error's class, the breaker states, and
+        (when tracing is armed) a flight record of the trailing timeline —
+        then the caller re-raises. Never raises itself: the original error
+        must surface, not an abort-path artifact."""
+        try:
+            cls = supervisor.classify(error)
+            states = {
+                name: st["state"] for name, st in supervisor.status().items()
+            }
+            log_error(
+                "recovery ladder exhausted at step %d (%s; %d/%d recoveries "
+                "spent): %s: %s [class=%s] breakers=%s",
+                step, why, self.recoveries, self.max_total_recoveries,
+                type(error).__name__, error, cls.value, states,
+            )
+            if obs._tracer is not None:
+                from mlsl_tpu.obs import export as obs_export
+
+                path = obs_export.flight_record(
+                    window_s=60.0,
+                    reason=f"{why} at step {step}: "
+                           f"{type(error).__name__}: {error}",
+                )
+                if path:
+                    log_warning("abort flight record written: %s", path)
+        except Exception as e:  # pragma: no cover - defensive (abort path)
+            log_warning("abort diagnostics failed: %s: %s", type(e).__name__, e)
 
     def run(self, batch_fn: Callable, steps: int, on_step: Optional[Callable] = None):
         """Train for ``steps`` steps; returns the final trainer.
@@ -198,6 +263,12 @@ class FaultTolerantLoop:
                         attempts > self.max_retries
                         or self.recoveries >= self.max_total_recoveries
                     ):
+                        self._abort(
+                            step, e,
+                            "same-step retry bound exceeded"
+                            if attempts > self.max_retries
+                            else "restart budget exhausted",
+                        )
                         raise
                     trainer, step = self._recover(trainer, e)
                     last_saved = step - 1 if step > 0 else None

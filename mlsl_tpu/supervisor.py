@@ -1,0 +1,351 @@
+"""Recovery supervisor: error taxonomy, retry policy, and circuit breakers.
+
+PR 1 gave the stack fault *detection* (chaos sites, the request watchdog,
+verified checkpoints); until now the only *responses* were "raise" and
+"restart the whole loop from a checkpoint" (resilience.FaultTolerantLoop).
+This module closes the loop from detection to proportionate response — a
+four-rung escalation ladder:
+
+1. **Classify** (:func:`classify`): every exception at an instrumented site
+   maps to an :class:`ErrorClass` that selects the recovery policy. A
+   transient ``OSError`` is not a corrupted codec is not a caller bug.
+2. **Retry** (``MLSL_COMM_RETRIES`` / ``MLSL_COMM_RETRY_BACKOFF_S``):
+   TRANSIENT failures of collective dispatch/wait retry in place with
+   exponential backoff + jitter (:func:`jittered_backoff`) — the
+   generalization of PR 1's checkpoint-save retry to ``comm/request.py``.
+3. **Degrade** (:class:`CircuitBreaker`): PERSISTENT/CORRUPTION failures
+   (and exhausted retries) count against a per-subsystem breaker. After
+   ``MLSL_BREAKER_THRESHOLD`` classified failures inside a sliding
+   ``MLSL_BREAKER_WINDOW_S`` window the breaker trips OPEN and the subsystem
+   falls back to its always-correct path instead of dying: the quantized
+   ring to the plain allreduce (error-feedback residual flushed), coalesced
+   buckets to individual requests, a tuned algorithm to ``'lax'``, the trace
+   exporter to a no-op. After ``MLSL_BREAKER_COOLDOWN_S`` the breaker goes
+   HALF_OPEN and lets the healthy path probe; one success re-closes it, one
+   failure re-opens.
+4. **Restart** (resilience.FaultTolerantLoop): only what rungs 1-3 could not
+   absorb reaches checkpoint recovery, bounded by ``MLSL_RESTART_BUDGET``
+   across the run, and finally abort-with-flight-record.
+
+Breakers are process-wide (like the chaos registry and the watchdog event
+record): subsystem health must SURVIVE a FaultTolerantLoop teardown/rebuild
+cycle, or a poisoned codec would re-trip identically after every recovery
+and the ladder could never escalate past rung 4's first rung. Knobs are
+(re)applied from :class:`mlsl_tpu.config.Config` at ``Environment.init``
+via :func:`configure`; tests reset state with :func:`reset`.
+
+Hot-path contract (mirrors ``chaos._plans`` / ``obs._tracer``): a closed
+breaker's ``allow()`` is one lock-free attribute compare; uninstrumented
+requests hold no breaker at all (``CommRequest._breaker is None``).
+"""
+
+from __future__ import annotations
+
+import collections
+import enum
+import os
+import random
+import threading
+import time
+from typing import Deque, Dict, Optional
+
+from mlsl_tpu.log import (
+    MLSLCorruptionError,
+    MLSLError,
+    MLSLTimeoutError,
+    log_warning,
+)
+
+
+class ErrorClass(enum.Enum):
+    """Recovery policy classes for the taxonomy table (rung 1)."""
+
+    #: flaky IO / timing: retry in place with backoff (rung 2)
+    TRANSIENT = "transient"
+    #: data integrity (bitrot, codec round-trip mismatch): the producing
+    #: subsystem is suspect — count against its breaker and degrade (rung 3)
+    CORRUPTION = "corruption"
+    #: dispatch/compile/device failure: breaker-countable, and recoverable by
+    #: checkpoint restart when no breaker owns the site (rung 3 then 4)
+    PERSISTENT = "persistent"
+    #: caller bugs and resource exhaustion: surface immediately — retrying a
+    #: ValueError or degrading around a MemoryError only hides the real fault
+    FATAL = "fatal"
+
+
+# Ordered (exception type, class) table: first isinstance match wins, so
+# subclasses must precede their bases (MLSLTimeoutError < MLSLError <
+# RuntimeError; TimeoutError < OSError). MLSLTimeoutError is deliberately
+# PERSISTENT, not TRANSIENT: the watchdog already waited out a full timeout
+# budget — re-arming an identical wait would double the stall, so a wedged
+# request escalates straight past the retry rung.
+_TAXONOMY = (
+    (MLSLCorruptionError, ErrorClass.CORRUPTION),
+    (MLSLTimeoutError, ErrorClass.PERSISTENT),
+    (MLSLError, ErrorClass.PERSISTENT),
+    (TimeoutError, ErrorClass.TRANSIENT),
+    (ConnectionError, ErrorClass.TRANSIENT),
+    (OSError, ErrorClass.TRANSIENT),
+    (MemoryError, ErrorClass.FATAL),
+    (ArithmeticError, ErrorClass.CORRUPTION),  # FloatingPointError etc.
+    (RuntimeError, ErrorClass.PERSISTENT),     # XlaRuntimeError, ChaosError
+)
+
+
+def classify(exc: BaseException) -> ErrorClass:
+    """Map an exception to its recovery policy class.
+
+    Anything outside the table — ValueError, TypeError, KeyboardInterrupt,
+    unknown library exceptions — is FATAL: the ladder only absorbs failure
+    modes it understands."""
+    for typ, cls in _TAXONOMY:
+        if isinstance(exc, typ):
+            return cls
+    return ErrorClass.FATAL
+
+
+# -- retry policy (rung 2) ----------------------------------------------------
+
+# process-wide jitter source; seedable for reproducible soaks (shared with
+# nothing else — chaos has its own RNG for fault scheduling)
+_rng = random.Random()
+
+
+def jittered_backoff(base_s: float, attempt: int,
+                     rng: Optional[random.Random] = None) -> float:
+    """Delay before retry ``attempt`` (0-based): ``base * 2**attempt``
+    scaled by a uniform jitter in [0.5, 1.5) so a fleet of workers retrying
+    the same transient fault does not re-collide in lockstep. Bounds are part
+    of the contract (tests pin them): 0.5*base*2^a <= delay < 1.5*base*2^a."""
+    r = rng if rng is not None else _rng
+    return base_s * (2.0 ** attempt) * (0.5 + r.random())
+
+
+# -- circuit breakers (rung 3) ------------------------------------------------
+
+#: breaker states
+CLOSED, OPEN, HALF_OPEN = "closed", "open", "half_open"
+
+#: the subsystems the ladder knows how to degrade (breakers are created on
+#: demand, but status()/reset() always report the full set)
+SUBSYSTEMS = ("quant", "bucket", "algo", "tracer")
+
+# module defaults, overridden by configure() at Environment.init
+_DEFAULT_THRESHOLD = int(os.environ.get("MLSL_BREAKER_THRESHOLD") or 3)
+_DEFAULT_WINDOW_S = float(os.environ.get("MLSL_BREAKER_WINDOW_S") or 30.0)
+_DEFAULT_COOLDOWN_S = float(os.environ.get("MLSL_BREAKER_COOLDOWN_S") or 10.0)
+
+
+class CircuitBreaker:
+    """closed -> open -> half_open -> closed, with a sliding failure window.
+
+    - CLOSED: healthy. ``record_failure`` appends a timestamp; when
+      ``threshold`` failures land inside the trailing ``window_s`` the
+      breaker trips OPEN (the tripping call site degrades that very
+      dispatch, so the Nth failure is served by the fallback, not raised).
+    - OPEN: ``allow()`` is False — call sites skip the subsystem and run its
+      degraded path. After ``cooldown_s`` the next ``allow()`` transitions
+      to HALF_OPEN and returns True (the probe).
+    - HALF_OPEN: the healthy path runs. One ``record_success`` re-closes
+      (window cleared); one ``record_failure`` re-opens with a fresh
+      cooldown.
+
+    All transitions are recorded via core/stats.record_degrade (DEGRADE
+    lines in mlsl_stats.log + breaker.* instants on the obs timeline).
+    """
+
+    def __init__(self, name: str, threshold: Optional[int] = None,
+                 window_s: Optional[float] = None,
+                 cooldown_s: Optional[float] = None):
+        self.name = name
+        self.threshold = _DEFAULT_THRESHOLD if threshold is None else threshold
+        self.window_s = _DEFAULT_WINDOW_S if window_s is None else window_s
+        self.cooldown_s = (
+            _DEFAULT_COOLDOWN_S if cooldown_s is None else cooldown_s
+        )
+        self._state = CLOSED
+        self._failures: Deque[float] = collections.deque()
+        self._opened_at = 0.0
+        self._trips = 0
+        self._last_error: Optional[str] = None
+        self._lock = threading.Lock()
+
+    # -- hot-path query ----------------------------------------------------
+
+    @property
+    def state(self) -> str:
+        return self._state
+
+    def allow(self) -> bool:
+        """May the healthy path run now? One attribute compare while CLOSED
+        (the only state a healthy run ever sees); the OPEN->HALF_OPEN
+        transition happens here, on the first call past the cooldown."""
+        if self._state == CLOSED:
+            return True
+        with self._lock:
+            if self._state == OPEN:
+                if time.monotonic() - self._opened_at < self.cooldown_s:
+                    return False
+                self._state = HALF_OPEN
+            # HALF_OPEN: let the probe(s) through; the first recorded
+            # outcome decides (a multi-member bucket round is one probe)
+        if self._state == HALF_OPEN:
+            self._record("probe")
+        return True
+
+    # -- transitions -------------------------------------------------------
+
+    def record_failure(self, error: Optional[BaseException] = None) -> bool:
+        """One classified failure of the subsystem. Returns True when the
+        breaker is OPEN afterwards (the call site should degrade)."""
+        now = time.monotonic()
+        with self._lock:
+            if error is not None:
+                self._last_error = f"{type(error).__name__}: {error}"
+            if self._state == HALF_OPEN:
+                # failed probe: straight back to OPEN, fresh cooldown
+                self._state = OPEN
+                self._opened_at = now
+                self._trips += 1
+                tripped = True
+            else:
+                self._failures.append(now)
+                self._prune_locked(now)
+                if self._state == CLOSED and len(self._failures) >= self.threshold:
+                    self._state = OPEN
+                    self._opened_at = now
+                    self._trips += 1
+                    tripped = True
+                else:
+                    tripped = False
+            is_open = self._state == OPEN
+        if tripped:
+            self._record("trip")
+        return is_open
+
+    def record_success(self) -> None:
+        """One healthy-path success. Meaningful in HALF_OPEN (closes the
+        breaker); in CLOSED it is a no-op so call sites may report success
+        unconditionally."""
+        if self._state == CLOSED:
+            return
+        with self._lock:
+            if self._state != HALF_OPEN:
+                return  # OPEN: a stale success from before the trip
+            self._state = CLOSED
+            self._failures.clear()
+        self._record("reset")
+
+    def reset(self) -> None:
+        with self._lock:
+            self._state = CLOSED
+            self._failures.clear()
+            self._opened_at = 0.0
+            self._trips = 0
+            self._last_error = None
+
+    def _prune_locked(self, now: float) -> None:
+        cutoff = now - self.window_s
+        while self._failures and self._failures[0] < cutoff:
+            self._failures.popleft()
+
+    def _record(self, event: str) -> None:
+        # lazy: core.stats imports jax; the breaker itself must stay
+        # importable from anywhere in the stack
+        from mlsl_tpu.core import stats as stats_mod
+
+        stats_mod.record_degrade(self.name, event, detail=self._last_error or "")
+        if event == "trip":
+            log_warning(
+                "circuit breaker %r tripped OPEN (%d failures in %.0fs "
+                "window; cooldown %.1fs; last: %s): subsystem degrades to "
+                "its fallback path",
+                self.name, len(self._failures) or self.threshold,
+                self.window_s, self.cooldown_s, self._last_error,
+            )
+
+    def status(self) -> dict:
+        with self._lock:
+            return {
+                "state": self._state,
+                "failures_in_window": len(self._failures),
+                "threshold": self.threshold,
+                "window_s": self.window_s,
+                "cooldown_s": self.cooldown_s,
+                "trips": self._trips,
+                "last_error": self._last_error,
+            }
+
+
+# -- registry ----------------------------------------------------------------
+
+_breakers: Dict[str, CircuitBreaker] = {}
+_registry_lock = threading.Lock()
+
+
+def breaker(name: str) -> CircuitBreaker:
+    """The process-wide breaker for ``name`` (created on first use with the
+    configured defaults)."""
+    br = _breakers.get(name)
+    if br is None:
+        with _registry_lock:
+            br = _breakers.get(name)
+            if br is None:
+                br = CircuitBreaker(name)
+                _breakers[name] = br
+    return br
+
+
+def degraded(name: str) -> bool:
+    """Is ``name`` currently running its fallback path? (False for a breaker
+    that was never created — no failure ever recorded.)"""
+    br = _breakers.get(name)
+    return br is not None and br.state != CLOSED
+
+
+def configure(config=None, threshold: Optional[int] = None,
+              window_s: Optional[float] = None,
+              cooldown_s: Optional[float] = None) -> None:
+    """(Re)apply breaker knobs — from a Config (Environment.init) or
+    explicitly (tests). Existing breakers keep their STATE (health survives
+    an Environment rebuild) but adopt the new thresholds."""
+    global _DEFAULT_THRESHOLD, _DEFAULT_WINDOW_S, _DEFAULT_COOLDOWN_S
+    if config is not None:
+        threshold = getattr(config, "breaker_threshold", threshold)
+        window_s = getattr(config, "breaker_window_s", window_s)
+        cooldown_s = getattr(config, "breaker_cooldown_s", cooldown_s)
+    if threshold is not None:
+        _DEFAULT_THRESHOLD = int(threshold)
+    if window_s is not None:
+        _DEFAULT_WINDOW_S = float(window_s)
+    if cooldown_s is not None:
+        _DEFAULT_COOLDOWN_S = float(cooldown_s)
+    with _registry_lock:
+        for br in _breakers.values():
+            if threshold is not None:
+                br.threshold = int(threshold)
+            if window_s is not None:
+                br.window_s = float(window_s)
+            if cooldown_s is not None:
+                br.cooldown_s = float(cooldown_s)
+
+
+def status() -> Dict[str, dict]:
+    """Per-subsystem breaker status (subsystems never touched report a
+    virgin closed breaker) — surfaced by FaultTolerantLoop's abort log and
+    importable for dashboards."""
+    out = {}
+    for name in sorted(set(SUBSYSTEMS) | set(_breakers)):
+        br = _breakers.get(name)
+        out[name] = br.status() if br is not None else {
+            "state": CLOSED, "failures_in_window": 0, "trips": 0,
+        }
+    return out
+
+
+def reset() -> None:
+    """Close every breaker and clear its history (tests; a production run
+    never resets — health carries across recovery cycles by design)."""
+    with _registry_lock:
+        for br in _breakers.values():
+            br.reset()

@@ -1,0 +1,13 @@
+#!/usr/bin/env bash
+# Run the randomized multi-site chaos soak standalone (like run_chaos.sh), so
+# CI can wire it as its own job separately from tier-1. The full soak
+# (tests/test_soak.py::test_soak_full) drives >= 200 supervised trainer steps
+# with seeded probabilistic faults (the MLSL_CHAOS %p grammar) at >= 4 sites
+# and requires zero unhandled exceptions, exact loss/param parity vs the
+# fault-free run, and every retry / breaker trip / degraded dispatch /
+# recovery attributable in mlsl_stats.log and the exported Perfetto trace.
+# The fast bounded variant (test_soak_fast_bounded) runs inside tier-1.
+set -euo pipefail
+cd "$(dirname "$0")/.."
+exec env JAX_PLATFORMS=cpu python -m pytest tests/test_soak.py -q -m soak \
+    -p no:cacheprovider "$@"

@@ -42,6 +42,30 @@ def _clean_singleton():
 
 
 @pytest.fixture(autouse=True)
+def _reset_supervisor():
+    """Close every circuit breaker and clear the degrade counters between
+    tests: breakers are process-wide BY DESIGN (subsystem health survives
+    Environment rebuilds), so without this a test that trips one would
+    silently degrade every later test's fast path."""
+    yield
+    from mlsl_tpu import supervisor
+    from mlsl_tpu.core import stats
+
+    supervisor.reset()
+    # restore the knob defaults too: tests shorten/zero the cooldown to
+    # admit half-open probes deterministically, and configure() is
+    # process-wide by design (defaults come from Config so they cannot
+    # drift from the real ones)
+    from mlsl_tpu.config import Config
+
+    c = Config()
+    supervisor.configure(threshold=c.breaker_threshold,
+                         window_s=c.breaker_window_s,
+                         cooldown_s=c.breaker_cooldown_s)
+    stats.reset_degrade_counters()
+
+
+@pytest.fixture(autouse=True)
 def _route_artifacts(tmp_path, monkeypatch):
     """Route mlsl_stats.log and trace-*.json into the test's tmp dir: a test
     run must never litter the CWD (core/stats.stats_path and obs.trace_dir

@@ -111,9 +111,8 @@ print(f"proc {pid} OK csum={csum:.10f}", flush=True)
 '''
 
 
-@pytest.mark.filterwarnings("ignore")
-def test_two_process_world(tmp_path):
-    worker = tmp_path / "worker.py"
+def _run_world(tmp_path, tag):
+    worker = tmp_path / f"worker{tag}.py"
     worker.write_text(WORKER)
     with socket.socket() as s:
         s.bind(("127.0.0.1", 0))
@@ -140,6 +139,32 @@ def test_two_process_world(tmp_path):
                 q.kill()
             pytest.fail(f"proc {i} timed out")
         outs.append(out)
+    return procs, outs
+
+
+def _gloo_transport_race(procs, outs):
+    """The known pre-existing gloo TCP flake (KNOWN_FAILURES.md): a worker
+    dies on `gloo::EnforceNotMet ... op.preamble.length <= op.nbytes` (a
+    transport-level race in gloo's TCP pair, load-dependent, observed at
+    pre-PR-6 HEAD ~2-in-5 under load) and the surviving worker aborts ~100s
+    later on the coordination-service heartbeat timeout. Both land as
+    SIGABRT (-6). Only this infrastructure signature is retryable — a
+    Python-level failure (returncode 1, wrong csum) is a real bug and fails
+    immediately."""
+    if not any(p.returncode == -6 for p in procs):
+        return False
+    text = "".join(outs)
+    return ("gloo" in text and "preamble" in text) or "heartbeat timeout" in text
+
+
+@pytest.mark.filterwarnings("ignore")
+def test_two_process_world(tmp_path):
+    for attempt in range(3):
+        procs, outs = _run_world(tmp_path, attempt)
+        if all(p.returncode == 0 for p in procs):
+            break
+        if not (attempt < 2 and _gloo_transport_race(procs, outs)):
+            break  # non-retryable failure (or retries exhausted): assert below
     for i, (p, out) in enumerate(zip(procs, outs)):
         assert p.returncode == 0, f"proc {i} failed:\n{out[-2000:]}"
         assert f"proc {i} OK" in out, out[-2000:]

@@ -369,6 +369,10 @@ def test_stats_log_routed_through_stats_dir(tmp_path, monkeypatch):
 
     d = tmp_path / "statsdir"
     d.mkdir()
+    # hermetic CWD: the nothing-in-CWD assertion below must not fail on a
+    # stray mlsl_stats.log left in the repo root by an ad-hoc (non-pytest)
+    # run from before this suite started
+    monkeypatch.chdir(tmp_path)
     monkeypatch.setenv("MLSL_STATS_DIR", str(d))
     stats.record_watchdog_event("routecheck allreduce", "wait", 1.0)
     log = d / stats.STATS_OUTPUT_FILE
