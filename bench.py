@@ -194,35 +194,55 @@ def main():
     # adjacent blocks, which drift cannot skew).
     fw_best = min(fw_blocks)
 
-    # Input-pipeline throughput: AsyncLoader prefetch feeding the framework
-    # trainer with fresh batches each step (the reference's endpoint-server
-    # file-IO offload streaming into shm while the trainer computes) — the
-    # steady-state number a real training job sees, input pipeline included.
+    # Input-pipeline throughput: the wire-compressed device feed
+    # (mlsl_tpu.data: uint8 wire + HBM dataset cache + prefetch) feeding the
+    # framework trainer — the steady-state number a real training job sees,
+    # input pipeline included. Epoch 0 stages the dataset over the link in
+    # uint8 (4x fewer bytes than f32); replays decode straight from HBM, so
+    # the timed loop measures compute + decode, not the tunnel.
     pipe_ms = h2d_mbps = None
+    input_stall_ms = wire_mb_per_batch = feed_cache_hits = None
     loader = None
     try:
-        import ml_dtypes
+        from mlsl_tpu.core import stats as core_stats
+        from mlsl_tpu.data import synthetic_source
 
-        from mlsl_tpu.data import AsyncLoader, synthetic_source
-
-        # bf16 on the host: the model casts inputs to bf16 on device anyway,
-        # so this is identical math with half the h2d bytes (the tunnel's
-        # ~26 MB/s effective h2d is the pipeline bottleneck)
-        loader = AsyncLoader(
-            synthetic_source(batch, (hw, hw, 3), classes, seed=1,
-                             dtype=ml_dtypes.bfloat16),
-            lambda bx, by: trainer.shard_batch(bx, by), depth=3,
+        n_data = 8  # distinct batches; the whole "dataset" pins in HBM
+        cache_mb = n_data * batch * hw * hw * 3 // (1 << 20) + 64
+        loader = trainer.feed(
+            lambda: synthetic_source(batch, (hw, hw, 3), classes, seed=1,
+                                     steps=n_data),
+            wire="uint8", cache_mb=cache_mb, epochs=None, depth=3,
         )
         it = iter(loader)
-        for _ in range(2):
+        # warm: epoch 0 stages + pins every batch, compiles the decode.
+        # Sync every other step: on the 8-dev CPU proof mesh the per-layer
+        # trainer queues ~54 collectives per step, and the backend wedges
+        # past ~dozens in flight (the PR 2 windowed-schedule hazard) — ten
+        # unsynced steps reproducibly deadlocked the rendezvous.
+        for i in range(n_data + 2):
             trainer.step(next(it))
+            if i % 2 == 1:
+                _sync(trainer.params)
         _sync(trainer.params)
+        f0 = dict(core_stats.FEED_COUNTERS)
+        st0 = loader.stats()
         n_pipe = max(6, args.iters // 3)
         t0 = time.perf_counter()
         for _ in range(n_pipe):
             trainer.step(next(it))
         _sync(trainer.params)
         pipe_ms = (time.perf_counter() - t0) / n_pipe * 1e3
+        f1 = dict(core_stats.FEED_COUNTERS)
+        st1 = loader.stats()
+        # stall during the timed window; wire MB/batch over every batch that
+        # actually crossed the link (steady state ships ~0 — that is the
+        # point; the staged average documents the wire cost when it does)
+        input_stall_ms = (st1["stall_ms"] - st0["stall_ms"]) / n_pipe
+        wire_mb_per_batch = (
+            f1["wire_bytes"] / 1e6 / max(int(f1["batches_staged"]), 1)
+        )
+        feed_cache_hits = int(f1["cache_hits"] - f0["cache_hits"])
     except Exception as e:
         print(f"bench: pipeline measurement skipped ({e})", file=sys.stderr)
     finally:
@@ -326,6 +346,17 @@ def main():
         "batch": batch,
         "pipeline_step_ms": round(pipe_ms, 3) if pipe_ms is not None else None,
         "images_per_s": round(batch / (pipe_ms / 1e3)) if pipe_ms else None,
+        "pipeline_efficiency": (
+            round(fw_ms / pipe_ms, 4) if pipe_ms else None
+        ),
+        "input_stall_ms": (
+            round(input_stall_ms, 3) if input_stall_ms is not None else None
+        ),
+        "wire_mb_per_batch": (
+            round(wire_mb_per_batch, 3) if wire_mb_per_batch is not None
+            else None
+        ),
+        "feed_cache_hits": feed_cache_hits,
         "h2d_mbps": round(h2d_mbps, 1) if h2d_mbps else None,
         "tflops": round(tflops, 3) if tflops else None,
         "mfu": round(mfu, 4) if mfu else None,
@@ -382,13 +413,19 @@ print("OVERLAP=" + json.dumps(max(fracs) if fracs else None))
 """
 
 
-def _overlap_probe_cpu_mesh(timeout: float = 600.0):
-    """-> (overlap_fraction or None, backend tag). The per-layer comm/compute
-    overlap measured on the 8-device CPU proof mesh in a subprocess, via the
-    test-driven per-layer loop (overlap_updates: each layer's update runs the
-    moment its collective lands — the schedule the reference's canonical loop
-    uses, mlsl_test.cpp:660-698). Keeps the overlap trajectory tracked in
-    BENCH_MEASURED.json even when the attached accelerator is one chip."""
+def _overlap_probe_cpu_mesh(timeout: float = 600.0, attempts: int = 2):
+    """-> (overlap_fraction or None, backend tag — NEVER None). The per-layer
+    comm/compute overlap measured on the 8-device CPU proof mesh in a
+    subprocess, via the test-driven per-layer loop (overlap_updates: each
+    layer's update runs the moment its collective lands — the schedule the
+    reference's canonical loop uses, mlsl_test.cpp:660-698). Keeps the
+    overlap trajectory tracked in BENCH_MEASURED.json even when the attached
+    accelerator is one chip.
+
+    A probe that cannot produce a number records WHY in the backend tag
+    (``skipped:<reason>``) instead of leaving both fields null — a null
+    overlap with no tag is indistinguishable from the probe never running,
+    which is exactly how the BENCH_r05 overlap regression went unnoticed."""
     import subprocess
 
     env_vars = dict(
@@ -411,23 +448,34 @@ def _overlap_probe_cpu_mesh(timeout: float = 600.0):
     env_vars.pop("MLSL_TUNE", None)
     env_vars.pop("MLSL_TUNE_PROFILE", None)
     env_vars.pop("MLSL_ALGO", None)
-    try:
-        out = subprocess.run(
-            [sys.executable, "-c", _OVERLAP_PROBE_SRC],
-            capture_output=True, text=True, timeout=timeout, env=env_vars,
-            cwd=os.path.dirname(os.path.abspath(__file__)),
-        )
-        for line in out.stdout.splitlines():
-            if line.startswith("OVERLAP="):
-                v = json.loads(line[len("OVERLAP="):])
-                if v is not None:
-                    return float(v), "cpu-mesh-proof"
-        tail = (out.stderr or "").strip().splitlines()
-        print("bench: cpu overlap probe produced no number"
-              + (f" ({tail[-1]})" if tail else ""), file=sys.stderr)
-    except Exception as e:
-        print(f"bench: cpu overlap probe failed ({e})", file=sys.stderr)
-    return None, None
+    # chip-sized feed knobs (wire dtype / HBM cache budget) have no business
+    # in the probe's tiny MLP loop
+    for k in ("MLSL_FEED_WIRE_DTYPE", "MLSL_FEED_CACHE_MB",
+              "MLSL_FEED_DEPTH"):
+        env_vars.pop(k, None)
+    reason = "unknown"
+    for attempt in range(attempts):
+        try:
+            out = subprocess.run(
+                [sys.executable, "-c", _OVERLAP_PROBE_SRC],
+                capture_output=True, text=True, timeout=timeout, env=env_vars,
+                cwd=os.path.dirname(os.path.abspath(__file__)),
+            )
+            for line in out.stdout.splitlines():
+                if line.startswith("OVERLAP="):
+                    v = json.loads(line[len("OVERLAP="):])
+                    if v is not None:
+                        return float(v), "cpu-mesh-proof"
+            tail = (out.stderr or "").strip().splitlines()
+            reason = (f"no-number rc={out.returncode}"
+                      + (f" {tail[-1][:120]}" if tail else ""))
+        except subprocess.TimeoutExpired:
+            reason = f"timeout {timeout:.0f}s"
+        except Exception as e:
+            reason = repr(e)[:160]
+        print(f"bench: cpu overlap probe attempt {attempt + 1}/{attempts} "
+              f"failed ({reason})", file=sys.stderr)
+    return None, f"skipped:{reason}"
 
 
 def _is_oom(e: BaseException) -> bool:

@@ -116,6 +116,8 @@ def main():
              [sys.executable, "benchmarks/quant_bucket_bench.py"], 1800),
             ("trace_overhead",
              [sys.executable, "benchmarks/trace_overhead_bench.py"], 900),
+            ("input_pipeline",
+             [sys.executable, "benchmarks/input_pipeline_bench.py"], 1200),
             ("algo_sweep",
              [sys.executable, "benchmarks/algo_sweep_bench.py", "--quant"],
              1800),

@@ -64,7 +64,9 @@ SITES: Dict[str, str] = {
     "codec.roundtrip": "quantized ring codec round-trip (comm/quant_ring.py)",
     "checkpoint.save": "CheckpointManager.save (checkpoint.py); supports bitrot",
     "checkpoint.restore": "CheckpointManager.restore (checkpoint.py)",
-    "data.prefetch": "AsyncLoader worker batch read (data.py)",
+    "data.prefetch": "feed batch read (data/: AsyncLoader worker and "
+                     "DeviceFeed source reads; bitrot rots the encoded "
+                     "wire payload through the codec + cache paths)",
 }
 
 KINDS = ("error", "delay", "hang", "bitrot")

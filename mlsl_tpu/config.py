@@ -44,6 +44,9 @@ _ENV_FIELDS = {
     "MLSL_GRAD_BUCKET_MB": "grad_bucket_mb",
     "MLSL_NUM_SERVERS": "num_servers",
     "MLSL_QUANT_BLOCK_ELEMS": "quant_block_elems",
+    "MLSL_FEED_DEPTH": "feed_depth",
+    "MLSL_FEED_CACHE_MB": "feed_cache_mb",
+    "MLSL_FEED_WIRE_DTYPE": "feed_wire_dtype",
 }
 
 
@@ -108,6 +111,24 @@ class Config:
     # Loaded tuner.TunedProfile (or None): consulted by comm/algos.select
     # for every engine collective. Set by Environment.init, never from env.
     tuned_profile: object = None
+
+    # --- device feed pipeline (mlsl_tpu.data; docs/TUNING.md §12) ---
+    # Wire dtype for host->device batch transfer: '' = full width (off),
+    # 'uint8' (images: 4x vs f32), 'bf16' (2x), 'int8' (block codec shared
+    # with the quantized collectives). Per-leaf overrides ride in the same
+    # string ('uint8,y=none'); parsed/validated by data.wire.parse_wire_spec
+    # at validate(). The data package reads the SAME env var per feed, so
+    # standalone DeviceFeed construction honors it without a Config handle.
+    feed_wire_dtype: str = ""       # MLSL_FEED_WIRE_DTYPE
+    # HBM budget (MiB) for the feed cache: wire batches pin on device after
+    # first touch and epoch replays skip h2d entirely. 0 = off.
+    feed_cache_mb: int = 0          # MLSL_FEED_CACHE_MB
+    # Prefetch depth: batches in flight device-side (2 = double buffering).
+    # Tunable via a tuner profile (tuner.KNOB_RANGES) — an exported env var
+    # always wins (the Config._explicit contract).
+    feed_depth: int = 2             # MLSL_FEED_DEPTH
+    # TRANSIENT source-read retries per batch (supervisor taxonomy, rung 2).
+    feed_retries: int = 2           # MLSL_FEED_RETRIES
 
     # --- compression ---
     quant_block_elems: int = 256
@@ -249,6 +270,28 @@ class Config:
             self.restart_budget >= 0,
             "MLSL_RESTART_BUDGET must be >= 0 (got %d)", self.restart_budget,
         )
+        try:
+            # common, not wire: the grammar parser is dependency-free, so
+            # validate() does not drag in jax/numpy/the Pallas kernels
+            from mlsl_tpu.data.common import parse_wire_spec
+
+            parse_wire_spec(self.feed_wire_dtype)
+        except ValueError as e:
+            from mlsl_tpu.log import MLSLError
+
+            raise MLSLError(f"MLSL_FEED_WIRE_DTYPE: {e}") from e
+        mlsl_assert(
+            self.feed_depth >= 1,
+            "MLSL_FEED_DEPTH must be >= 1 (got %d)", self.feed_depth,
+        )
+        mlsl_assert(
+            self.feed_cache_mb >= 0,
+            "MLSL_FEED_CACHE_MB must be >= 0 (got %d)", self.feed_cache_mb,
+        )
+        mlsl_assert(
+            self.feed_retries >= 0,
+            "MLSL_FEED_RETRIES must be >= 0 (got %d)", self.feed_retries,
+        )
 
     @staticmethod
     def from_env() -> "Config":
@@ -283,6 +326,12 @@ class Config:
         c.collective_algo = os.environ.get("MLSL_ALGO", c.collective_algo)
         c.tune = _env_bool("MLSL_TUNE", c.tune)
         c.tune_profile = os.environ.get("MLSL_TUNE_PROFILE", c.tune_profile)
+        c.feed_wire_dtype = os.environ.get(
+            "MLSL_FEED_WIRE_DTYPE", c.feed_wire_dtype
+        )
+        c.feed_cache_mb = _env_int("MLSL_FEED_CACHE_MB", c.feed_cache_mb)
+        c.feed_depth = _env_int("MLSL_FEED_DEPTH", c.feed_depth)
+        c.feed_retries = _env_int("MLSL_FEED_RETRIES", c.feed_retries)
         c.quant_block_elems = _env_int("MLSL_QUANT_BLOCK_ELEMS", c.quant_block_elems)
         c.topk_ratio = _env_float("MLSL_TOPK_RATIO", c.topk_ratio)
         c.watchdog_timeout_s = _env_float("MLSL_WATCHDOG_TIMEOUT", c.watchdog_timeout_s)

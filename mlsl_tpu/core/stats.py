@@ -211,6 +211,61 @@ def reset_degrade_counters() -> None:
     DEGRADE_EVENTS.clear()
 
 
+# Feed-pipeline accounting (mlsl_tpu.data): process-wide like the bucket
+# counters — the feed stages batches from a loader worker thread with no
+# Session handle. Wire bytes are what actually crossed the h2d link;
+# bytes_saved is the full-width f32 baseline minus that; stall_ms is time the
+# TRAINING LOOP blocked on an empty prefetch queue (the number the pipeline
+# exists to drive to zero); producer_wait_ms is healthy backpressure (the
+# worker waiting for a free slot). Statistics.print_ renders the totals as
+# the FEED line in mlsl_stats.log.
+FEED_COUNTERS: Dict[str, float] = {
+    "batches_staged": 0,     # batches that crossed the h2d link
+    "wire_bytes": 0,         # bytes actually shipped (payload + scales)
+    "bytes_saved": 0,        # f32-baseline bytes minus wire bytes
+    "cache_hits": 0,         # batches served from the HBM cache (no h2d)
+    "cache_misses": 0,
+    "cache_rejects": 0,      # batches the cache budget refused to pin
+    "stall_ms": 0.0,         # consumer blocked on an empty prefetch queue
+    "producer_wait_ms": 0.0,  # worker blocked on a full queue (backpressure)
+    "retries": 0,            # TRANSIENT source-read retries (rung 2)
+}
+
+
+def record_feed_stage(wire_bytes: int, full_bytes: int) -> None:
+    """One batch staged over the wire (called by FeedCodec.stage; the
+    h2d.transfer span is recorded there too)."""
+    FEED_COUNTERS["batches_staged"] += 1
+    FEED_COUNTERS["wire_bytes"] += wire_bytes
+    FEED_COUNTERS["bytes_saved"] += max(0, full_bytes - wire_bytes)
+
+
+def record_feed_cache(event: str) -> None:
+    """One cache lookup outcome: 'hit' / 'miss' / 'reject'."""
+    key = "cache_misses" if event == "miss" else f"cache_{event}s"
+    FEED_COUNTERS[key] += 1
+
+
+def record_feed_stall(ms: float) -> None:
+    """Consumer blocked on the prefetch queue for ``ms`` (AsyncLoader)."""
+    FEED_COUNTERS["stall_ms"] += ms
+
+
+def record_feed_wait(ms: float) -> None:
+    """Producer backpressure wait (AsyncLoader worker, full queue)."""
+    FEED_COUNTERS["producer_wait_ms"] += ms
+
+
+def record_feed_retry() -> None:
+    """One TRANSIENT source-read retry (MLSL_FEED_RETRIES)."""
+    FEED_COUNTERS["retries"] += 1
+
+
+def reset_feed_counters() -> None:
+    for k in FEED_COUNTERS:
+        FEED_COUNTERS[k] = 0 if isinstance(FEED_COUNTERS[k], int) else 0.0
+
+
 # Per-algorithm dispatch accounting (comm/algos): process-wide like the
 # bucket counters — dispatch fires at the request layer with no Session
 # handle. Key = (kind, algorithm name); value = launches. The point: traces
@@ -584,6 +639,29 @@ class Statistics:
                         f" wait_p95 {obs._percentile(durs, 95) / 1e6:.2f} ms"
                     )
             lines.append(bucket_line)
+        fc = FEED_COUNTERS
+        if (fc["batches_staged"] or fc["cache_hits"] or fc["cache_misses"]
+                or fc["stall_ms"] or fc["retries"]):
+            # stall/retries alone must also surface the line: a plain
+            # AsyncLoader (no wire path) that stalled the training loop is
+            # exactly the input-bound run this line exists to expose
+            # the feed line: how many bytes the wire codecs + HBM cache kept
+            # off the h2d link, and whether the training loop ever waited on
+            # its input (stall) — one grep ('FEED') answers "is this run
+            # input-bound"
+            staged = max(int(fc["batches_staged"]), 1)
+            lines.append(
+                f"{'FEED':<16} {'PIPELINE':<8} "
+                f"staged {int(fc['batches_staged'])} "
+                f"wire {fc['wire_bytes'] / 1e6:.1f} MB "
+                f"({fc['wire_bytes'] / 1e6 / staged:.2f} MB/batch) "
+                f"saved {fc['bytes_saved'] / 1e6:.1f} MB "
+                f"cache {int(fc['cache_hits'])}h/{int(fc['cache_misses'])}m/"
+                f"{int(fc['cache_rejects'])}r "
+                f"stall {fc['stall_ms']:.1f} ms "
+                f"bp_wait {fc['producer_wait_ms']:.1f} ms "
+                f"retries {int(fc['retries'])}"
+            )
         if ALGO_COUNTERS:
             # per-algorithm dispatch attribution (comm/algos): which program
             # family actually carried each collective kind this run

@@ -1,0 +1,53 @@
+"""Device feed pipeline: wire-compressed h2d transfer, on-device decode,
+prefetch, and an HBM-resident dataset cache.
+
+Grown from the original single-module ``mlsl_tpu.data`` (background prefetch
+only — the TPU analog of the reference's endpoint-server file-IO offload,
+ENABLE_FILEIO / eplib fread_nb) into a package that also minimizes BYTES on
+the host->device link and hides what remains under compute:
+
+- :mod:`mlsl_tpu.data.wire`    — wire codecs (uint8 / bf16 / int8 block
+  codec shared with the quantized collectives), sharded zero-staging
+  placement, jitted on-device decode (``FeedCodec``);
+- :mod:`mlsl_tpu.data.cache`   — HBM-resident dataset cache
+  (``MLSL_FEED_CACHE_MB``): epoch replays skip h2d entirely;
+- :mod:`mlsl_tpu.data.feed`    — ``DeviceFeed``, composing codec + cache +
+  epoch/shuffle bookkeeping;
+- :mod:`mlsl_tpu.data.loader`  — ``AsyncLoader``, depth-N device-side
+  buffering with backpressure accounting and supervised retry
+  (``MLSL_FEED_DEPTH`` / ``MLSL_FEED_RETRIES``);
+- :mod:`mlsl_tpu.data.sources` — host batch sources (``file_source``,
+  ``synthetic_source``).
+
+See docs/DESIGN.md "Device feed pipeline" and docs/TUNING.md §12.
+"""
+
+# Lazy exports (PEP 562): importing the package — or its dependency-free
+# submodules (data.common, which Config.validate uses for the wire-spec
+# grammar) — must not drag in the jax/numpy/Pallas kernel stack behind
+# wire.py. Submodules load on first attribute access.
+_EXPORTS = {
+    "AsyncLoader": "mlsl_tpu.data.loader",
+    "DeviceFeed": "mlsl_tpu.data.feed",
+    "FeedCache": "mlsl_tpu.data.cache",
+    "FeedCodec": "mlsl_tpu.data.wire",
+    "WIRE_KINDS": "mlsl_tpu.data.common",
+    "parse_wire_spec": "mlsl_tpu.data.common",
+    "file_source": "mlsl_tpu.data.sources",
+    "synthetic_source": "mlsl_tpu.data.sources",
+}
+
+__all__ = sorted(_EXPORTS)
+
+
+def __getattr__(name: str):
+    target = _EXPORTS.get(name)
+    if target is None:
+        raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
+    import importlib
+
+    return getattr(importlib.import_module(target), name)
+
+
+def __dir__():
+    return sorted(set(globals()) | set(__all__))

@@ -1,0 +1,85 @@
+"""HBM-resident dataset cache: epoch replays skip the host->device link.
+
+Small/synthetic datasets (bench loops, eval sets, distillation corpora) are
+re-shipped over the slow h2d link every epoch even though they fit in device
+HBM many times over. This cache pins WIRE-format batches (compact: a uint8
+image batch costs 4x less HBM than its decoded f32 form) on first touch,
+under an ``MLSL_FEED_CACHE_MB`` budget; a replayed epoch decodes straight
+from HBM — zero wire bytes.
+
+Eviction policy: admission-capped, no eviction. Epoch replay touches every
+entry exactly once per epoch, so evicting entry A to admit entry B converts
+A's future hits into misses one-for-one — LRU would just rotate the misses.
+A batch that does not fit is simply not cached (counted as a reject) and
+keeps streaming over the wire.
+
+Budget accounting uses global logical bytes (`.nbytes` over the sharded wire
+arrays); per-device HBM is that divided by the data-parallel degree for
+batch-sharded leaves.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional
+
+import jax
+
+from mlsl_tpu.obs import tracer as obs_trace
+
+
+class FeedCache:
+    """Wire-batch cache keyed by position-in-epoch."""
+
+    def __init__(self, budget_mb: float):
+        self.budget_bytes = int(budget_mb * (1 << 20))
+        self._slots: Dict[int, object] = {}
+        self.bytes = 0
+        self.hits = 0
+        self.misses = 0
+        self.rejects = 0
+
+    def __len__(self) -> int:
+        return len(self._slots)
+
+    def get(self, key: int):
+        """Cached wire batch or None. Counts hits/misses into FEED_COUNTERS
+        and drops a ``feed.cache_hit`` instant on the obs timeline."""
+        from mlsl_tpu.core import stats
+
+        item = self._slots.get(key)
+        if item is None:
+            self.misses += 1
+            stats.record_feed_cache("miss")
+            return None
+        self.hits += 1
+        stats.record_feed_cache("hit")
+        tr = obs_trace._tracer
+        if tr is not None:
+            tr.instant("feed.cache_hit", "feed", batch=key)
+        return item
+
+    def put(self, key: int, wire_batch) -> bool:
+        """Admit a staged wire batch if the budget allows; False = rejected
+        (the caller may then donate the buffers to decode)."""
+        from mlsl_tpu.core import stats
+
+        if key in self._slots:
+            return True
+        nbytes = sum(
+            leaf.nbytes for leaf in jax.tree.leaves(wire_batch)
+        )
+        if self.bytes + nbytes > self.budget_bytes:
+            self.rejects += 1
+            stats.record_feed_cache("reject")
+            return False
+        self._slots[key] = wire_batch
+        self.bytes += nbytes
+        return True
+
+    def complete(self, n: Optional[int]) -> bool:
+        """True when every one of the dataset's ``n`` batches is pinned."""
+        return n is not None and len(self._slots) == n
+
+    def clear(self) -> None:
+        self._slots.clear()
+        self.bytes = 0

@@ -1,0 +1,94 @@
+"""Shared helpers for the feed pipeline: the wire-spec grammar, env
+defaults, and the rung-2 retry gate — ONE implementation serving both
+AsyncLoader (worker reads) and DeviceFeed (source reads), so the two layers
+of the same recovery-ladder rung cannot drift apart. Deliberately free of
+jax/numpy imports: Config.validate() parses the wire grammar through this
+module without dragging in the kernel stack."""
+
+from __future__ import annotations
+
+import os
+import time
+from typing import Callable, Dict, Optional, Tuple
+
+from mlsl_tpu.log import log_warning
+
+#: canonical wire kinds; spec strings may use the aliases below
+WIRE_KINDS = ("none", "bf16", "uint8", "int8")
+
+_KIND_ALIASES = {
+    "": "none", "none": "none", "f32": "none", "float32": "none", "off": "none",
+    "bf16": "bf16", "bfloat16": "bf16",
+    "uint8": "uint8", "u8": "uint8",
+    "int8": "int8", "i8": "int8",
+}
+
+
+def parse_wire_spec(spec: Optional[str]) -> Tuple[str, Dict[str, str]]:
+    """``MLSL_FEED_WIRE_DTYPE`` grammar -> (default kind, per-leaf overrides).
+
+    ``"uint8"`` applies uint8 to every eligible leaf; ``"uint8,y=none"`` or
+    ``"x=uint8"`` override single leaves. Leaf names are flattened tree paths
+    (``"0"``, ``"1"``, dict keys joined with ``.``); ``x``/``y`` additionally
+    alias the first/second leaf of the canonical (x, y) batch TUPLE — the
+    alias is resolved at lookup against positional keys only, so a dict
+    batch whose key is literally ``"x"`` matches its own name, never the
+    alias. Unknown kinds or malformed entries raise ValueError
+    (Config.validate turns that into an MLSLError at init)."""
+    default = "none"
+    overrides: Dict[str, str] = {}
+    for entry in filter(None, (e.strip() for e in (spec or "").split(","))):
+        name, sep, kind = entry.partition("=")
+        if not sep:
+            name, kind = None, entry
+        k = _KIND_ALIASES.get(kind.strip().lower())
+        if k is None:
+            raise ValueError(
+                f"unknown feed wire dtype {kind!r} in {spec!r}; "
+                f"known: {sorted(set(_KIND_ALIASES))}"
+            )
+        if name is None:
+            default = k
+        else:
+            overrides[name.strip()] = k
+    return default, overrides
+
+
+def env_int(name: str, default: int) -> int:
+    v = os.environ.get(name)
+    return int(v) if v not in (None, "") else default
+
+
+def env_default(name: str, fallback):
+    """Env override typed like ``fallback`` (str fallbacks pass through)."""
+    v = os.environ.get(name)
+    if v in (None, ""):
+        return fallback
+    return type(fallback)(v) if not isinstance(fallback, str) else v
+
+
+def retry_or_raise(e: BaseException, attempt: int, retries: int,
+                   backoff_s: float,
+                   stopping: Optional[Callable[[], bool]] = None) -> int:
+    """Rung-2 gate (supervisor taxonomy): sleep with exponential backoff and
+    return ``attempt + 1`` for a retryable TRANSIENT failure; re-raise ``e``
+    for anything else (PERSISTENT/CORRUPTION/FATAL, retries exhausted, or
+    the owner shutting down)."""
+    from mlsl_tpu import supervisor
+    from mlsl_tpu.core import stats
+
+    if (
+        supervisor.classify(e) is not supervisor.ErrorClass.TRANSIENT
+        or attempt >= retries
+        or (stopping is not None and stopping())
+    ):
+        raise e
+    attempt += 1
+    delay = backoff_s * (2 ** (attempt - 1))
+    stats.record_feed_retry()
+    log_warning(
+        "feed: transient source error (%r); retry %d/%d in %.3fs",
+        e, attempt, retries, delay,
+    )
+    time.sleep(delay)
+    return attempt

@@ -1,0 +1,258 @@
+"""DeviceFeed: the wire-compressed, HBM-cached, epoch-aware device feed.
+
+Composes the three feed-pipeline pieces over one dataset:
+
+1. :class:`mlsl_tpu.data.wire.FeedCodec` — host batches cross the h2d link
+   in the configured wire dtype and a jitted on-device decode restores the
+   training dtype;
+2. :class:`mlsl_tpu.data.cache.FeedCache` — wire batches pin in HBM under
+   ``MLSL_FEED_CACHE_MB``; epoch replays decode straight from HBM (zero wire
+   bytes);
+3. epoch bookkeeping — per-epoch shuffle from a fixed seed, identical with
+   the cache on or off (parity pinned by tests/test_feed.py), so enabling
+   the cache is a pure transport optimization, never a data change.
+
+Iteration yields DECODED distributed-buffer batches — the same layout
+``DataParallelTrainer.shard_batch`` produces — so ``trainer.step`` consumes
+them unchanged. Wrap in :class:`mlsl_tpu.data.AsyncLoader` (or use
+``DataParallelTrainer.feed``) for background prefetch.
+
+Source forms:
+
+- a **sequence** of host batches (list/tuple): random access — per-epoch
+  shuffle works with or without the cache;
+- a **callable** returning a fresh iterator per epoch (e.g.
+  ``lambda: synthetic_source(...)``): sequential replay — once the cache
+  holds the full epoch the source is never consulted again;
+- a **one-shot iterator**: epoch 0 streams it; later epochs replay from the
+  cache and raise MLSLError if the cache does not hold the full dataset.
+
+``shuffle_seed`` requires a sequence source: shuffle is a property of the
+FEED, so it must produce the same order whether batches come over the wire
+or out of the cache — a streaming source cannot be replayed out of order.
+
+The ``data.prefetch`` chaos site fires per batch read (error/delay/hang act
+in place; ``bitrot`` corrupts the encoded wire payload so a bad host read
+flows through the codec + cache paths instead of crashing them).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Callable, Optional, Sequence, Tuple
+
+import jax.numpy as jnp
+
+from mlsl_tpu import chaos
+from mlsl_tpu.data.cache import FeedCache
+from mlsl_tpu.data.common import env_default as _env_default, retry_or_raise
+from mlsl_tpu.data.wire import FeedCodec
+from mlsl_tpu.log import MLSLError, mlsl_assert
+
+
+class DeviceFeed:
+    """One dataset's wire-compressed device feed (see module docstring).
+
+    epochs: passes over the source (None = cycle forever);
+    shuffle_seed: per-epoch deterministic batch-order shuffle (None = in
+    order; sequence sources only); wire/cache_mb/retries default from
+    ``MLSL_FEED_WIRE_DTYPE`` / ``MLSL_FEED_CACHE_MB`` (0 = no cache) /
+    ``MLSL_FEED_RETRIES``; normalize/train_dtype/augment/quant_block pass
+    through to :class:`FeedCodec`.
+    """
+
+    #: AsyncLoader reads this to avoid double-firing the chaos site
+    _chaos_site = "data.prefetch"
+
+    def __init__(self, source, topology, *,
+                 wire: Optional[str] = None,
+                 cache_mb: Optional[float] = None,
+                 epochs: Optional[int] = 1,
+                 shuffle_seed: Optional[int] = None,
+                 normalize: Optional[Tuple] = None,
+                 train_dtype=jnp.float32,
+                 augment: Optional[Callable] = None,
+                 quant_block: Optional[int] = None,
+                 retries: Optional[int] = None):
+        if wire is None:
+            wire = os.environ.get("MLSL_FEED_WIRE_DTYPE", "")
+        if cache_mb is None:
+            cache_mb = float(_env_default("MLSL_FEED_CACHE_MB", 0.0))
+        self.codec = FeedCodec(
+            topology, wire, normalize=normalize, train_dtype=train_dtype,
+            augment=augment, quant_block=int(quant_block or 256),
+        )
+        self.cache = FeedCache(cache_mb) if cache_mb > 0 else None
+        self.epochs = epochs
+        self.shuffle_seed = shuffle_seed
+        self.retries = (retries if retries is not None
+                        else int(_env_default("MLSL_FEED_RETRIES", 2)))
+        self._seq: Optional[Sequence] = (
+            source if isinstance(source, (list, tuple)) else None
+        )
+        self._factory = source if callable(source) else None
+        self._iter = (iter(source)
+                      if self._seq is None and self._factory is None else None)
+        self._n: Optional[int] = (
+            len(self._seq) if self._seq is not None else None
+        )
+        mlsl_assert(
+            shuffle_seed is None or self._seq is not None,
+            "DeviceFeed: shuffle_seed requires a sequence source (random "
+            "access) — a streaming source cannot replay out of order",
+        )
+        self._gen = self._drive(self._serve)
+
+    # -- epoch machinery ----------------------------------------------------
+
+    def _order(self, epoch: int):
+        """Batch visit order for one epoch. The SAME order with the cache on
+        or off: shuffling is a property of the feed, the cache only changes
+        where the bytes come from."""
+        if self.shuffle_seed is None:
+            return range(self._n)
+        import numpy as np
+
+        rng = np.random.default_rng((self.shuffle_seed, epoch))
+        return rng.permutation(self._n)
+
+    def _retry_or_raise(self, e: BaseException, attempt: int) -> int:
+        return retry_or_raise(e, attempt, self.retries, 0.05)
+
+    def _read_host(self, index: Optional[int], it):
+        """One host batch (sequence index, or iterator step), with the chaos
+        site and the TRANSIENT-retry loop (rung 2 of the recovery ladder,
+        applied to the feed). Returns (host_batch, bitrot_fired).
+
+        Only re-attemptable reads retry: a sequence index can be fetched
+        again, and a chaos-site fault fires before the source is touched. An
+        ITERATOR whose frame raised is dead — next() on it would yield
+        StopIteration, which ``_drive`` reads as a (truncated!) end of epoch
+        and would pin ``self._n`` to the short length forever — so iterator
+        failures propagate immediately with the original exception."""
+        attempt = 0
+        while True:
+            fired = None
+            if chaos._plans:
+                try:
+                    fired = chaos.inject("data.prefetch", batch=index)
+                except BaseException as e:
+                    attempt = self._retry_or_raise(e, attempt)
+                    continue
+            try:
+                host = self._seq[index] if it is None else next(it)
+            except StopIteration:
+                raise
+            except BaseException as e:
+                if it is not None:
+                    raise  # dead iterator: not re-attemptable (see above)
+                attempt = self._retry_or_raise(e, attempt)
+                continue
+            return host, (fired is not None and fired.kind == "bitrot")
+
+    def _serve(self, key: int, it):
+        """One decoded batch: a cache hit decodes from HBM; a miss reads the
+        source, stages over the wire, and pins the wire batch if the budget
+        allows. Fresh-staged batches that did NOT get cached donate their
+        wire buffers to decode (the staging HBM is reclaimed immediately).
+
+        A STREAMING epoch (``it`` not None) always advances the iterator
+        first — a partially-cached epoch must stay aligned with the source —
+        and the cache then only short-circuits the h2d transfer; random
+        access (``it`` None) skips the host read entirely on a hit."""
+        wire_batch, donate = self._serve_wire(key, it)
+        return self.codec.decode(wire_batch, donate=donate)
+
+    @property
+    def cache_complete(self) -> bool:
+        return (self.cache is not None and self._n is not None
+                and self.cache.complete(self._n))
+
+    def _stream_iter(self, epoch: int):
+        if self._factory is not None:
+            return iter(self._factory())
+        if epoch == 0:
+            return self._iter
+        raise MLSLError(
+            "DeviceFeed: source is a one-shot iterator and the feed cache "
+            "does not hold the full dataset (%d of %s batches cached) — "
+            "epoch %d cannot replay. Pass a sequence / factory source or "
+            "raise MLSL_FEED_CACHE_MB." % (
+                0 if self.cache is None else len(self.cache), self._n, epoch,
+            )
+        )
+
+    def _serve_wire(self, key: int, it):
+        """The wire half of :meth:`_serve`: -> (wire_batch, donate). Runs on
+        whatever thread drives the stream (the AsyncLoader worker under
+        prefetch); the DECODE program is dispatched separately so a
+        background thread never launches device programs concurrently with
+        the training loop's own dispatches — on the CPU proof mesh that
+        cross-thread interleaving starves the collective rendezvous
+        (observed wedging the 8-dev per-layer trainer)."""
+        if it is not None:
+            host, rot = self._read_host(None, it)
+            # a fired bitrot must corrupt what is SERVED: skip the cache
+            # shortcut so the rotted read flows through stage+decode (the
+            # pinned clean copy is kept — transient rot, not a poisoned pin)
+            if self.cache is not None and not rot:
+                cached = self.cache.get(key)
+                if cached is not None:
+                    return cached, False
+        else:
+            if self.cache is not None:
+                cached = self.cache.get(key)
+                if cached is not None:
+                    return cached, False
+            host, rot = self._read_host(key, None)
+        wire_batch, _, _ = self.codec.stage(host, corrupt=rot)
+        kept = self.cache is not None and self.cache.put(key, wire_batch)
+        return wire_batch, not kept
+
+    def _consumer_decode(self, item):
+        """Decode hook the AsyncLoader applies on the CONSUMER thread (see
+        _serve_wire): (wire_batch, donate) -> decoded batch."""
+        wire_batch, donate = item
+        return self.codec.decode(wire_batch, donate=donate)
+
+    def _prefetch_iter(self):
+        """Wire-batch stream for AsyncLoader prefetch: the worker runs the
+        host encode + h2d staging ahead of use, the consumer dispatches
+        decode."""
+        return self._drive(self._serve_wire)
+
+    def _drive(self, emit):
+        epoch = 0
+        while self.epochs is None or epoch < self.epochs:
+            if self._seq is not None:
+                for i in self._order(epoch):
+                    yield emit(int(i), None)
+            elif self.cache_complete:
+                # full epoch pinned in HBM: the source is never touched again
+                for i in range(self._n):
+                    yield emit(i, None)
+            else:
+                it = self._stream_iter(epoch)
+                i = 0
+                while True:
+                    try:
+                        item = emit(i, it)
+                    except StopIteration:
+                        break
+                    yield item
+                    i += 1
+                if self._n is None:
+                    self._n = i
+                else:
+                    mlsl_assert(
+                        self._n == i,
+                        "source epoch length changed (%d, then %d)",
+                        self._n, i,
+                    )
+            epoch += 1
+
+    def __iter__(self):
+        return self._gen
+
+    def __next__(self):
+        return next(self._gen)

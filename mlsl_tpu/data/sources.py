@@ -1,0 +1,42 @@
+"""Host batch sources: the producers the feed pipeline pulls from.
+
+The analog of the reference's endpoint-server file reads (EPLIB_fopen/
+fread_nb, eplib/eplib.h:51-58): a source yields host batches; the loader's
+worker thread performs the disk read AND the host->device transfer while the
+trainer computes, so the training loop never blocks on IO.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+
+
+def file_source(paths, epochs: Optional[int] = 1):
+    """Stream (x, y) batches from ``.npz`` files (keys 'x' and 'y').
+    ``epochs=None`` cycles forever."""
+    paths = list(paths)  # a one-shot iterable must survive multiple epochs
+    e = 0
+    while epochs is None or e < epochs:
+        for p in paths:
+            with np.load(p) as z:
+                yield z["x"], z["y"]
+        e += 1
+
+
+def synthetic_source(batch: int, shape, num_classes: int, seed: int = 0,
+                     steps: Optional[int] = None, dtype=np.float32):
+    """Deterministic synthetic (x, y) batches (the reference tests likewise use
+    generated algebraic data rather than real datasets). Pass
+    dtype=ml_dtypes.bfloat16 to cast on the host — or, better, feed through
+    :class:`mlsl_tpu.data.DeviceFeed` with a wire dtype, which also moves the
+    cast/normalize work onto the device (docs/DESIGN.md 'Device feed
+    pipeline')."""
+    rng = np.random.default_rng(seed)
+    produced = 0
+    while steps is None or produced < steps:
+        x = rng.normal(size=(batch, *shape)).astype(dtype)
+        y = rng.integers(0, num_classes, size=(batch,)).astype(np.int32)
+        produced += 1
+        yield x, y

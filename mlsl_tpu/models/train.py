@@ -755,6 +755,30 @@ class DataParallelTrainer:
         gy = (r, d, s, m, local_b, *y.shape[1:])
         return topo.shard_buffer_local(xs, gx), topo.shard_buffer_local(ys, gy)
 
+    def feed(self, source, *, depth: Optional[int] = None, **kw):
+        """Build the wire-compressed prefetching device feed for this
+        trainer's topology: an :class:`mlsl_tpu.data.AsyncLoader` over a
+        :class:`mlsl_tpu.data.DeviceFeed` whose decoded batches are the SAME
+        distributed buffers :meth:`shard_batch` produces — ``step`` consumes
+        them unchanged, but batches cross the h2d link in the configured
+        wire dtype and epoch replays can serve straight from the HBM cache.
+
+        Defaults come from the environment's Config (``MLSL_FEED_*``,
+        docs/TUNING.md §12); any DeviceFeed kwarg (wire, cache_mb, epochs,
+        shuffle_seed, normalize, augment, ...) can be overridden here.
+        Remember to ``close()`` the returned loader."""
+        from mlsl_tpu.data import AsyncLoader, DeviceFeed
+
+        cfg = self.env.config
+        kw.setdefault("wire", cfg.feed_wire_dtype if cfg else None)
+        kw.setdefault("cache_mb", cfg.feed_cache_mb if cfg else None)
+        kw.setdefault("retries", cfg.feed_retries if cfg else None)
+        kw.setdefault("quant_block", cfg.quant_block_elems if cfg else None)
+        if depth is None:
+            depth = cfg.feed_depth if cfg else None
+        dev_feed = DeviceFeed(source, self.dist.topology, **kw)
+        return AsyncLoader(dev_feed, depth=depth)
+
     # -- the training step (reference loop mlsl_test.cpp:660-698) ----------
 
     def step_accum(self, batches) -> jax.Array:

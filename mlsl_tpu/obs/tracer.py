@@ -6,7 +6,9 @@ deferred, and why a watchdog tripped*. Every instrumented layer — request
 Start/defer/dispatch/wait (comm/request.py), bucket rounds (core/bucketing.py),
 quant ring round-trips (comm/quant_ring.py), checkpoint save/restore
 (checkpoint.py), recovery cycles (resilience.py), trainer step phases
-(models/train.py), chaos injections (chaos.py) — appends typed events to one
+(models/train.py), the device feed pipeline (data/: ``h2d.transfer`` +
+``feed.decode`` spans, ``feed.cache_hit`` instants), chaos injections
+(chaos.py) — appends typed events to one
 bounded ring buffer, which ``obs.export`` renders as Chrome/Perfetto
 ``trace_event`` JSON and the watchdog dumps as a flight record on a trip.
 
@@ -119,6 +121,19 @@ class Tracer:
                 key = str((ev[ARGS] or {}).get("req") or ev[TRACK] or "?")
                 groups.setdefault(key, []).append(ev[DUR])
         return groups
+
+    def span_durations(self, name: str, cat: Optional[str] = None
+                       ) -> List[int]:
+        """Raw durations (ns) of every complete span named ``name``
+        (optionally filtered by category) still in the ring — e.g.
+        ``span_durations("h2d.transfer", "feed")`` for the staging-time
+        distribution the input-pipeline bench reports."""
+        return [
+            ev[DUR]
+            for ev in self.snapshot()
+            if ev[PH] == "X" and ev[NAME] == name
+            and (cat is None or ev[CAT] == cat)
+        ]
 
     def wait_stall_stats(self) -> Dict[str, dict]:
         """Per-request wait-stall summary:
