@@ -18,7 +18,12 @@ from mlsl_tpu.types import (
     CompressionType,
     QuantParams,
 )
-from mlsl_tpu.log import MLSLError, MLSLTimeoutError
+from mlsl_tpu.log import (
+    MLSLCorruptionError,
+    MLSLError,
+    MLSLIntegrityError,
+    MLSLTimeoutError,
+)
 from mlsl_tpu.core.environment import Environment
 from mlsl_tpu.core.distribution import Distribution
 from mlsl_tpu.core.session import Session, Operation, OperationRegInfo
@@ -47,4 +52,6 @@ __all__ = [
     "Statistics",
     "MLSLError",
     "MLSLTimeoutError",
+    "MLSLCorruptionError",
+    "MLSLIntegrityError",
 ]

@@ -24,8 +24,11 @@ Env config (comma-separated)::
     MLSL_CHAOS="request.wait:error@6,collective.dispatch:hang=30,data.prefetch:delay=0.05x*"
 
 Grammar per entry: ``site:kind[=value][@after][xN][%p]`` — *value* is the
-exception name for ``error`` (oserror, runtimeerror, mlslerror, ...) or
-seconds for ``delay``/``hang``; ``@after`` skips the first N hits; ``xN``
+exception name for ``error`` (oserror, runtimeerror, mlslerror, ...),
+seconds for ``delay``/``hang``, or the corruption magnitude for ``silent``
+(``train.params:silent`` flips a bit, ``train.grads:silent=nan`` poisons an
+element — applied by the call site via sentinel.corrupt_silent, never
+raising); ``@after`` skips the first N hits; ``xN``
 fires at most N times (default 1; ``x*`` = unlimited); ``%p`` makes each
 eligible hit fire with probability *p* (e.g.
 ``collective.dispatch:errorx*%0.05`` — a 5% flaky dispatch; ``%p`` is the
@@ -67,9 +70,22 @@ SITES: Dict[str, str] = {
     "data.prefetch": "feed batch read (data/: AsyncLoader worker and "
                      "DeviceFeed source reads; bitrot rots the encoded "
                      "wire payload through the codec + cache paths)",
+    # SILENT corruption sites (models/train.py): the fired plan is returned
+    # and the trainer applies the corruption via sentinel.corrupt_silent —
+    # state/payload is flipped or perturbed WITHOUT raising, the class of
+    # fault only the integrity sentinel (mlsl_tpu.sentinel) can catch. The
+    # per-layer graph path applies them; the no-comm fused shortcut has no
+    # gradient boundary to corrupt (and an armed sentinel gate disables it).
+    "train.params": "trainer parameters at step entry (models/train.py); "
+                    "silent corrupts ONE replica's copy (audit quarry)",
+    "train.opt_state": "optimizer state at step entry (models/train.py); "
+                       "silent corrupts one replica/shard copy",
+    "train.grads": "local gradients before the quality gate and gradient "
+                   "comm (models/train.py); silent=nan/inf poisons an "
+                   "element the gate's nonfinite screen must catch",
 }
 
-KINDS = ("error", "delay", "hang", "bitrot")
+KINDS = ("error", "delay", "hang", "bitrot", "silent")
 
 _EXC_NAMES = {
     "chaoserror": ChaosError,
@@ -108,6 +124,10 @@ class Plan:
     after: int = 0
     times: Optional[int] = 1
     prob: float = 1.0
+    #: 'silent' corruption magnitude: None = flip one random bit in one
+    #: element; a finite value adds mag * (|x| + 1); nan/inf overwrite the
+    #: element (the applier is sentinel.corrupt_silent)
+    mag: Optional[float] = None
     hits: int = 0
     fires: int = 0
     cancelled: bool = False
@@ -139,11 +159,13 @@ def plan(
     after: int = 0,
     times: Optional[int] = 1,
     prob: float = 1.0,
+    mag: Optional[float] = None,
 ) -> Plan:
     """Arm a fault at ``site``. Returns the Plan (counters readable by tests).
     ``prob`` < 1 makes each eligible hit fire with that probability (the
     ``%p`` grammar — randomized soak faults with no hand-scheduled
-    budgets); pair it with ``times=None`` for an indefinitely flaky site."""
+    budgets); pair it with ``times=None`` for an indefinitely flaky site.
+    ``mag`` applies to ``kind='silent'`` only (see Plan.mag)."""
     if site not in SITES:
         raise ValueError(f"unknown chaos site {site!r}; known: {sorted(SITES)}")
     if kind not in KINDS:
@@ -151,7 +173,7 @@ def plan(
     if not 0.0 < prob <= 1.0:
         raise ValueError(f"chaos probability must be in (0, 1] (got {prob!r})")
     p = Plan(site=site, kind=kind, exc=exc, seconds=seconds, after=after,
-             times=times, prob=prob)
+             times=times, prob=prob, mag=mag)
     with _lock:
         _plans.setdefault(site, []).append(p)
     log_info("chaos armed: %s %s after=%d times=%s prob=%s",
@@ -207,9 +229,10 @@ def inject(site: str, **ctx) -> Optional[Plan]:
 
     ``error`` raises the plan's exception, ``delay`` sleeps, ``hang`` sleeps
     until its duration elapses or the plan is cancelled (clear()/remove()).
-    Site-specific kinds (``bitrot``) don't act here — the fired Plan is
-    returned and the call site applies the effect (checkpoint.py corrupts the
-    committed files). ``ctx`` is free-form, logged for diagnosis.
+    Site-specific kinds (``bitrot``, ``silent``) don't act here — the fired
+    Plan is returned and the call site applies the effect (checkpoint.py
+    corrupts the committed files; models/train.py corrupts live state via
+    sentinel.corrupt_silent). ``ctx`` is free-form, logged for diagnosis.
     """
     if not _plans:
         return None
@@ -287,6 +310,10 @@ def _parse_entry(entry: str) -> dict:
                     f"unknown exception {value!r} in MLSL_CHAOS entry {entry!r}; "
                     f"known: {sorted(_EXC_NAMES)}"
                 ) from None
+        elif kind == "silent":
+            # silent corruption magnitude ('nan'/'inf' accepted — they
+            # overwrite the element); no value = flip one random bit
+            kw["mag"] = float(value)
         else:
             kw["seconds"] = float(value)
     return kw

@@ -4,22 +4,41 @@ TPU-native analog of the reference's PointerChecker (src/pointer_checker.{hpp,cp
 a debug allocator-range tracker consulted before every MPI call under
 ENABLE_CHKP_INT). Raw pointers don't exist here; the failure modes that do are wrong
 global shape, wrong dtype, wrong sharding (buffer laid out for a different topology)
-and non-finite payloads. Enabled via MLSL_CHKP=1 (off by default — it syncs the
-device to inspect values when MLSL_CHKP=2).
+and non-finite payloads. Enabled via MLSL_CHKP=1 (off by default; MLSL_CHKP=2 adds
+payload finiteness).
+
+Threaded through THREE boundaries (the reference checks only the MPI call):
+request Start (comm/request.py), the bucket pack — each member buffer is
+validated against its own request descriptor before it joins a coalesced
+round (core/bucketing.py) — and feed decode outputs (data/feed.py via
+:func:`check_feed_batch`).
+
+CHKP_VALUES batches its finiteness verdicts per round instead of syncing the
+device per buffer: ``check_buffer`` queues one tiny on-device ``isfinite.all``
+program per Start (async — no host sync), and :func:`flush_values` resolves
+every queued verdict with ONE device_get at the next completion boundary
+(CommRequest.wait/test). A full backward pass of N layers costs one sync, not
+N. The check therefore RAISES AT THE ROUND'S FIRST WAIT, naming every
+offending buffer — not at the Start that queued it.
+
+Hit/violation counters live in core/stats (CHKP line in mlsl_stats.log).
 """
 
 from __future__ import annotations
+
+import threading
+from typing import List, Tuple
 
 import numpy as np
 import jax
 
 from mlsl_tpu.config import _env_int
-from mlsl_tpu.log import mlsl_assert
+from mlsl_tpu.log import MLSLError
 from mlsl_tpu.types import jnp_dtype
 
 CHKP_OFF = 0
 CHKP_SHAPE = 1   # shape/dtype/sharding checks (cheap, no sync)
-CHKP_VALUES = 2  # + finiteness check (syncs the device)
+CHKP_VALUES = 2  # + finiteness check (batched; one sync per round)
 
 
 def level() -> int:
@@ -28,57 +47,142 @@ def level() -> int:
     return _env_int("MLSL_CHKP", 0)
 
 
+# queued CHKP_VALUES verdicts: (domain, label, on-device bool scalar).
+# Process-wide like the stats counters — Starts and Waits can come from
+# different threads (the dispatcher's progress thread completes deferred
+# rounds). The DOMAIN keeps subsystems' rounds separate: a comm wait must
+# never drain (and raise) a feed batch's queued verdict or vice versa —
+# the error has to surface at the boundary whose recovery ladder owns it.
+_pending: List[Tuple[str, str, jax.Array]] = []
+_plock = threading.Lock()
+
+
+def _record(event: str, n: int = 1) -> None:
+    # lazy import: core.stats pulls in the obs tracer; the checker must stay
+    # importable from the bottom of the comm stack
+    from mlsl_tpu.core import stats as stats_mod
+
+    stats_mod.record_chkp(event, n)
+
+
+def _violation(msg: str, *args) -> None:
+    _record("violations")
+    raise MLSLError(msg % args if args else msg)
+
+
 def check_buffer(buf, desc, lvl: int = None) -> None:
     """Validate a distributed buffer against its request descriptor.
 
     Raises MLSLError (like the reference's CHECK_RANGE failures) on mismatch.
+    At CHKP_VALUES the finiteness verdict is QUEUED, not synced — it raises
+    at the round's next :func:`flush_values` (CommRequest.wait/test).
     """
     if lvl is None:
         lvl = level()
     if lvl == CHKP_OFF:
         return
+    _record("checks")
     topo = desc.group.topology
-    mlsl_assert(
-        hasattr(buf, "shape") and buf.ndim >= 5,
-        "CHKP: buffer must be a distributed (R,D,S,M,n) array, got %r",
-        type(buf).__name__,
-    )
-    mlsl_assert(
-        tuple(buf.shape[:4]) == topo.grid_shape,
-        "CHKP: buffer grid %s does not match topology %s",
-        tuple(buf.shape[:4]),
-        topo.grid_shape,
-    )
+    if not (hasattr(buf, "shape") and buf.ndim >= 5):
+        _violation(
+            "CHKP: buffer must be a distributed (R,D,S,M,n) array, got %r",
+            type(buf).__name__,
+        )
+    if tuple(buf.shape[:4]) != topo.grid_shape:
+        _violation(
+            "CHKP: buffer grid %s does not match topology %s",
+            tuple(buf.shape[:4]),
+            topo.grid_shape,
+        )
     want_elems = desc.count
     got_elems = int(np.prod(buf.shape[4:]))
-    mlsl_assert(
-        got_elems >= want_elems,
-        "CHKP: buffer payload %d < descriptor count %d (OUT_OF_RANGE)",
-        got_elems,
-        want_elems,
-    )
+    if got_elems < want_elems:
+        _violation(
+            "CHKP: buffer payload %d < descriptor count %d (OUT_OF_RANGE)",
+            got_elems,
+            want_elems,
+        )
     want_dt = np.dtype(jnp_dtype(desc.data_type))
-    mlsl_assert(
-        np.dtype(buf.dtype) == want_dt,
-        "CHKP: buffer dtype %s != descriptor dtype %s",
-        buf.dtype,
-        want_dt,
-    )
+    if np.dtype(buf.dtype) != want_dt:
+        _violation(
+            "CHKP: buffer dtype %s != descriptor dtype %s", buf.dtype, want_dt
+        )
     if isinstance(buf, jax.Array) and buf.sharding is not None:
         # the buffer must be laid out on this topology's mesh (UNKNOWN_PTR analog)
         try:
             buf_mesh = buf.sharding.mesh
-            mlsl_assert(
+            if not (
                 tuple(buf_mesh.axis_names) == tuple(topo.mesh.axis_names)
-                and buf_mesh.devices.shape == topo.mesh.devices.shape,
-                "CHKP: buffer sharded over mesh %s, request targets mesh %s",
-                buf_mesh.devices.shape,
-                topo.mesh.devices.shape,
-            )
+                and buf_mesh.devices.shape == topo.mesh.devices.shape
+            ):
+                _violation(
+                    "CHKP: buffer sharded over mesh %s, request targets mesh %s",
+                    buf_mesh.devices.shape,
+                    topo.mesh.devices.shape,
+                )
         except AttributeError:
             pass
-    if lvl >= CHKP_VALUES and np.issubdtype(buf.dtype, np.floating):
-        mlsl_assert(
-            bool(jax.device_get(jax.numpy.isfinite(buf).all())),
-            "CHKP: buffer contains non-finite values",
+    if lvl >= CHKP_VALUES and jax.numpy.issubdtype(
+        buf.dtype, jax.numpy.floating
+    ):
+        _queue_finite(
+            "comm", f"{desc.kind}[{desc.count}]",
+            jax.numpy.isfinite(buf).all(),
         )
+
+
+def _queue_finite(domain: str, label: str, verdict) -> None:
+    _record("value_checks")
+    with _plock:
+        _pending.append((domain, label, verdict))
+
+
+def flush_values(domain: str = "comm") -> None:
+    """Resolve the queued finiteness verdicts of ``domain`` with one device
+    sync; raises MLSLError naming ALL offending buffers of the round. Called
+    by CommRequest.wait/test at completion (the comm round boundary) and by
+    check_feed_batch after queueing one batch's leaves — each drains only
+    its own domain, so the error surfaces at the boundary whose recovery
+    ladder owns it. No-op (one len check) when nothing is queued."""
+    if not _pending:
+        return
+    with _plock:
+        batch = [e for e in _pending if e[0] == domain]
+        _pending[:] = [e for e in _pending if e[0] != domain]
+    if not batch:
+        return
+    _record("value_syncs")
+    verdicts = jax.device_get([v for _, _, v in batch])
+    bad = [label for (_, label, _), ok in zip(batch, verdicts) if not bool(ok)]
+    if bad:
+        _record("violations", len(bad))
+        raise MLSLError(
+            "CHKP: buffer contains non-finite values: " + ", ".join(bad)
+        )
+
+
+def check_feed_batch(batch, lvl: int = None) -> None:
+    """Validate one decoded feed batch (data/feed.py): every float leaf must
+    be finite at CHKP_VALUES — a wire-codec or cache fault that produced
+    garbage surfaces HERE, at the decode boundary, instead of poisoning the
+    step. One device sync per batch (the leaves' verdicts are queued then
+    flushed together, in the 'feed' domain so a concurrent comm wait never
+    steals or mis-surfaces them)."""
+    if lvl is None:
+        lvl = level()
+    if lvl < CHKP_VALUES:
+        return
+    leaves = jax.tree_util.tree_leaves(batch)
+    n = 0
+    for i, leaf in enumerate(leaves):
+        # jnp.issubdtype: ml_dtypes bfloat16 is not np.floating, and a bf16
+        # training dtype is exactly what the wire's bf16 path restores
+        if hasattr(leaf, "dtype") and jax.numpy.issubdtype(
+            leaf.dtype, jax.numpy.floating
+        ):
+            _record("checks")
+            _queue_finite("feed", f"feed.decode[leaf{i}]",
+                          jax.numpy.isfinite(leaf).all())
+            n += 1
+    if n:
+        flush_values("feed")

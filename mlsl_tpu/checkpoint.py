@@ -19,6 +19,11 @@ tests/test_chaos.py):
   newest *verified* step instead of dying on a corrupt latest.
 - **Save retry.** Transient IO errors (OSError) during save dispatch retry with
   exponential backoff (MLSL_CKPT_SAVE_RETRIES / MLSL_CKPT_RETRY_BACKOFF_S).
+- **Verified-good steps.** A save made with a passing sentinel audit
+  fingerprint (mlsl_tpu.sentinel) records it in the step manifest;
+  ``restore_trainer`` prefers the newest VERIFIED step over newer
+  unverified ones, so a silently corrupted checkpoint is never the
+  preferred resume point once any verified one exists.
 """
 
 from __future__ import annotations
@@ -70,6 +75,10 @@ class CheckpointManager:
         )
         self._unverified: set = set()  # steps saved but not yet checksummed
         self._bitrot: set = set()      # chaos: steps to corrupt post-manifest
+        # step -> passing sentinel audit digest, recorded into the step's
+        # manifest at flush (the "verified-good" half of the integrity
+        # sentinel: restore_trainer prefers steps that carry one)
+        self._fingerprints: dict = {}
         self._mgr = ocp.CheckpointManager(
             self.directory,
             options=ocp.CheckpointManagerOptions(
@@ -89,13 +98,21 @@ class CheckpointManager:
 
     # -- save/restore ------------------------------------------------------
 
-    def save(self, step: int, state: Any, wait: bool = False) -> None:
+    def save(self, step: int, state: Any, wait: bool = False,
+             fingerprint: Optional[str] = None) -> None:
         """Dispatch an async save of ``state`` (any pytree of arrays).
+
+        ``fingerprint`` is a PASSING sentinel audit digest of this state
+        (mlsl_tpu.sentinel); it is recorded in the step's manifest, marking
+        the step *verified* — ``restore_trainer`` prefers verified steps and
+        FaultTolerantLoop's post-restore re-audit compares against it.
 
         Transient IO errors (OSError) at dispatch retry with exponential
         backoff; anything else propagates (recoverable by FaultTolerantLoop).
         """
         self.check_errors()
+        if fingerprint is not None:
+            self._fingerprints[step] = fingerprint
         tr = obs._tracer
         t0 = tr.now() if tr is not None else 0
         delay = self.retry_backoff_s
@@ -239,6 +256,12 @@ class CheckpointManager:
                 continue  # still in flight
             manifest = {"step": step, "written_at": time.time(),
                         "files": self._checksum_tree(d)}
+            fp = self._fingerprints.pop(step, None)
+            if fp is not None:
+                # verified-good marker: the state in this step passed the
+                # sentinel's consistency audit at save time, and this digest
+                # identifies those exact bytes (post-restore re-audit target)
+                manifest["sentinel"] = {"fingerprint": fp}
             tmp = self._manifest_path(step) + ".tmp"
             with open(tmp, "w") as f:
                 json.dump(manifest, f)
@@ -279,6 +302,20 @@ class CheckpointManager:
             f.seek(size // 2)
             f.write(bytes(b ^ 0xFF for b in chunk))
         log_warning("chaos: bit-rot injected into step %d (%s)", step, target)
+
+    def recorded_fingerprint(self, step: int) -> Optional[str]:
+        """The sentinel audit digest this step's manifest records, or None
+        (no manifest yet, or the step was saved without one — an unverified
+        checkpoint)."""
+        fp = self._fingerprints.get(step)
+        if fp is not None:
+            return fp  # save dispatched, manifest not yet flushed
+        try:
+            with open(self._manifest_path(step)) as f:
+                manifest = json.load(f)
+        except (OSError, ValueError):
+            return None
+        return (manifest.get("sentinel") or {}).get("fingerprint")
 
     def verify(self, step: int) -> Optional[bool]:
         """True: manifest present and every file matches. False: corrupt
@@ -326,21 +363,28 @@ def _apply_state(trainer, state) -> int:
     return int(state["step"])
 
 
-def save_trainer(mgr: CheckpointManager, trainer, step: int, wait: bool = False) -> None:
+def save_trainer(mgr: CheckpointManager, trainer, step: int, wait: bool = False,
+                 fingerprint: Optional[str] = None) -> None:
     """Persist a DataParallelTrainer/HybridTrainer's parameters (and optimizer
-    state, when the trainer carries one)."""
-    mgr.save(step, _trainer_state(trainer, step), wait=wait)
+    state, when the trainer carries one). ``fingerprint`` marks the step
+    sentinel-verified (see CheckpointManager.save)."""
+    mgr.save(step, _trainer_state(trainer, step), wait=wait,
+             fingerprint=fingerprint)
 
 
 def restore_trainer(mgr: CheckpointManager, trainer, step: Optional[int] = None) -> Optional[int]:
     """Restore parameters (and optimizer state) in place; returns the restored
     step or None when the directory holds no checkpoints.
 
-    With ``step=None`` the steps are tried newest-first: a step that fails
-    checksum verification, or whose restore raises, is skipped with a warning
-    and the next older step is tried — a corrupt latest checkpoint costs a
-    longer replay, not the run. If checkpoints exist but none restores, raise
-    (silently restarting from scratch would discard the entire run's
+    With ``step=None`` the candidate order is newest VERIFIED first:
+    steps whose manifest records a passing sentinel audit fingerprint
+    (newest to oldest), then unverified steps (newest to oldest) — a
+    checkpoint that might hold silently corrupted state is only used when
+    no verified one restores. Within that order, a step that fails checksum
+    verification, or whose restore raises, is skipped with a warning and
+    the next candidate is tried — a corrupt latest checkpoint costs a
+    longer replay, not the run. If checkpoints exist but none restores,
+    raise (silently restarting from scratch would discard the entire run's
     progress)."""
     template = _trainer_state(trainer, 0)
     if step is not None:
@@ -350,7 +394,16 @@ def restore_trainer(mgr: CheckpointManager, trainer, step: Optional[int] = None)
     if not steps:
         return None
     mgr._flush_manifests()  # checksum anything committed-but-unverified
-    for s in sorted(steps, reverse=True):
+    newest_first = sorted(steps, reverse=True)
+    verified = [s for s in newest_first if mgr.recorded_fingerprint(s)]
+    unverified = [s for s in newest_first if s not in verified]
+    if verified and unverified and unverified[0] > verified[0]:
+        log_warning(
+            "preferring newest VERIFIED checkpoint step %d over newer "
+            "unverified step %d (no passing audit fingerprint recorded)",
+            verified[0], unverified[0],
+        )
+    for s in verified + unverified:
         verdict = mgr.verify(s)
         if verdict is False:
             log_warning(
@@ -367,9 +420,9 @@ def restore_trainer(mgr: CheckpointManager, trainer, step: Optional[int] = None)
             continue
         if state is None:
             continue
-        if s != steps[-1]:
+        if s != newest_first[0]:
             log_info("restored fallback step %d (latest step %d unusable)",
-                     s, steps[-1])
+                     s, newest_first[0])
         return _apply_state(trainer, state)
     raise MLSLError(
         f"no restorable checkpoint in {mgr.directory}: all {len(steps)} steps "

@@ -28,10 +28,11 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
-from mlsl_tpu import chaos, supervisor
+from mlsl_tpu import chaos, checker, supervisor
 from mlsl_tpu.obs import tracer as obs
 from mlsl_tpu.comm.mesh import NUM_GRID_AXES, ProcessGroup
 from mlsl_tpu.log import (
+    MLSLError,
     MLSLTimeoutError,
     mlsl_assert,
     log_debug,
@@ -371,8 +372,6 @@ class CommRequest:
         if chaos._plans:
             chaos.inject("request.start", request=self.name or self.uid,
                          kind=self.desc.kind)
-        from mlsl_tpu import checker  # module cached after first call
-
         chkp = checker.level()
         if chkp:
             checker.check_buffer(buf, self.desc, chkp)
@@ -757,6 +756,11 @@ class CommRequest:
                     or attempt >= getattr(cfg, "comm_retries", 0)
                     or self._last_buf is None
                 ):
+                    # the round is failing: drain its queued CHKP verdicts
+                    # (logged, never raised here — the real error must stay
+                    # primary) so a LATER healthy request's wait cannot
+                    # inherit and mis-surface them
+                    self._drain_chkp_logged()
                     raise
                 delay = supervisor.jittered_backoff(
                     getattr(cfg, "comm_retry_backoff_s", 0.05), attempt
@@ -780,6 +784,11 @@ class CommRequest:
         # retains a gradient-sized device array between rounds
         self._last_buf = None
         self._ef_snapshot = (None, None)
+        if checker._pending:
+            # CHKP_VALUES round boundary: resolve every finiteness verdict
+            # queued since the last completion with ONE device sync (raises
+            # MLSLError naming all offending buffers of the round)
+            checker.flush_values()
         if tr is not None:
             # the wait STALL: host time blocked for this request (dispatch
             # race + device completion) — the per-op overlap-loss signal
@@ -808,6 +817,21 @@ class CommRequest:
         self._block_ready(out, deadline)
         return out
 
+    def _drain_chkp_logged(self) -> None:
+        """Resolve any queued CHKP_VALUES verdicts on a FAILING round without
+        letting a CHKP violation replace the round's real error: the verdict
+        outcome is logged (and counted), the queue is clean for the next
+        round."""
+        if not checker._pending:
+            return
+        try:
+            checker.flush_values()
+        except MLSLError as ce:
+            log_warning(
+                "CHKP verdicts from the failed round of %s: %s",
+                self.name or self.uid, ce,
+            )
+
     def test(self) -> tuple:
         """Non-blocking completion poll -> (is_completed, result_or_None)."""
         if not self.is_started:
@@ -819,6 +843,7 @@ class CommRequest:
         if self._dispatch_error is not None:
             err, self._dispatch_error = self._dispatch_error, None
             self.is_started = False
+            self._drain_chkp_logged()
             raise err
         # A dispatch racing on the progress thread builds _results incrementally;
         # check in-flight FIRST — once it clears, _results is fully built.
@@ -831,6 +856,8 @@ class CommRequest:
             self.is_started = False
             self._last_buf = None  # round over: release the retry buffer
             self._ef_snapshot = (None, None)
+            if checker._pending:
+                checker.flush_values()  # CHKP_VALUES round boundary
             tr = obs._tracer
             if tr is not None:
                 tr.instant("test.done", "req", track=self._trace_name,

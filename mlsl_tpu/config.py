@@ -47,6 +47,7 @@ _ENV_FIELDS = {
     "MLSL_FEED_DEPTH": "feed_depth",
     "MLSL_FEED_CACHE_MB": "feed_cache_mb",
     "MLSL_FEED_WIRE_DTYPE": "feed_wire_dtype",
+    "MLSL_SENTINEL_EVERY": "sentinel_every",
 }
 
 
@@ -171,6 +172,32 @@ class Config:
     # (like the checkpoint retry knobs: recorded here for discoverability —
     # override via the FaultTolerantLoop ctor, not by mutating this field).
     restart_budget: int = 20            # MLSL_RESTART_BUDGET
+    # --- integrity sentinel (mlsl_tpu.sentinel; docs/TUNING.md §13) ---
+    # Step quality gate response: '' = gate off; 'warn' logs and continues,
+    # 'skip_step' discards the poisoned update (EF residuals and data order
+    # stay consistent — the step behaves as if it never ran), 'rollback'
+    # raises MLSLIntegrityError so FaultTolerantLoop restores the newest
+    # VERIFIED checkpoint. An armed gate disables the no-comm fused step
+    # shortcut (the gate needs the gradient boundary).
+    sentinel_gate: str = ""             # MLSL_SENTINEL_GATE
+    # Cross-replica consistency audit interval in steps (0 = off): a
+    # blockwise int32 fingerprint of params + optimizer state is compared
+    # across replicas via on-device pmin/pmax equality (no host gather).
+    # Tunable via a tuner profile (tuner.KNOB_RANGES); exported env wins.
+    sentinel_every: int = 0             # MLSL_SENTINEL_EVERY
+    # Grad-norm spike screen: fire when the global gradient norm exceeds
+    # this factor times its EMA (armed after sentinel_warmup healthy steps).
+    sentinel_spike: float = 10.0        # MLSL_SENTINEL_SPIKE
+    # Loss z-score screen: fire when |loss - EMA mean| exceeds this many
+    # EMA standard deviations (armed after warmup).
+    sentinel_zmax: float = 8.0          # MLSL_SENTINEL_ZMAX
+    # Healthy steps observed before the spike/z-score screens arm (the
+    # nonfinite screen is always armed — it needs no history).
+    sentinel_warmup: int = 5            # MLSL_SENTINEL_WARMUP
+    # Fingerprint block size in elements: one int32 checksum per block.
+    # Smaller blocks localize a corruption better but grow the on-device
+    # fingerprint vector (total_elems / block int32s).
+    sentinel_block: int = 4096          # MLSL_SENTINEL_BLOCK
     # Fault-injection spec; parsed by mlsl_tpu.chaos
     # (site:kind[=v][@after][xN][%p], comma-separated). Kept here for
     # discoverability/printing only.
@@ -270,6 +297,32 @@ class Config:
             self.restart_budget >= 0,
             "MLSL_RESTART_BUDGET must be >= 0 (got %d)", self.restart_budget,
         )
+        mlsl_assert(
+            self.sentinel_gate in ("", "warn", "skip_step", "rollback"),
+            "MLSL_SENTINEL_GATE must be one of '', 'warn', 'skip_step', "
+            "'rollback' (got %r)", self.sentinel_gate,
+        )
+        mlsl_assert(
+            self.sentinel_every >= 0,
+            "MLSL_SENTINEL_EVERY must be >= 0 (got %d)", self.sentinel_every,
+        )
+        mlsl_assert(
+            self.sentinel_spike > 1.0,
+            "MLSL_SENTINEL_SPIKE must be > 1 (got %r)", self.sentinel_spike,
+        )
+        mlsl_assert(
+            self.sentinel_zmax > 0,
+            "MLSL_SENTINEL_ZMAX must be > 0 (got %r)", self.sentinel_zmax,
+        )
+        mlsl_assert(
+            self.sentinel_warmup >= 0,
+            "MLSL_SENTINEL_WARMUP must be >= 0 (got %d)",
+            self.sentinel_warmup,
+        )
+        mlsl_assert(
+            self.sentinel_block > 0,
+            "MLSL_SENTINEL_BLOCK must be > 0 (got %d)", self.sentinel_block,
+        )
         try:
             # common, not wire: the grammar parser is dependency-free, so
             # validate() does not drag in jax/numpy/the Pallas kernels
@@ -345,6 +398,12 @@ class Config:
             "MLSL_BREAKER_COOLDOWN_S", c.breaker_cooldown_s
         )
         c.restart_budget = _env_int("MLSL_RESTART_BUDGET", c.restart_budget)
+        c.sentinel_gate = os.environ.get("MLSL_SENTINEL_GATE", c.sentinel_gate)
+        c.sentinel_every = _env_int("MLSL_SENTINEL_EVERY", c.sentinel_every)
+        c.sentinel_spike = _env_float("MLSL_SENTINEL_SPIKE", c.sentinel_spike)
+        c.sentinel_zmax = _env_float("MLSL_SENTINEL_ZMAX", c.sentinel_zmax)
+        c.sentinel_warmup = _env_int("MLSL_SENTINEL_WARMUP", c.sentinel_warmup)
+        c.sentinel_block = _env_int("MLSL_SENTINEL_BLOCK", c.sentinel_block)
         c.ckpt_save_retries = _env_int("MLSL_CKPT_SAVE_RETRIES", c.ckpt_save_retries)
         c.ckpt_retry_backoff_s = _env_float(
             "MLSL_CKPT_RETRY_BACKOFF_S", c.ckpt_retry_backoff_s

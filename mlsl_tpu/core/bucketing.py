@@ -41,7 +41,7 @@ from typing import List
 import jax
 import jax.numpy as jnp
 
-from mlsl_tpu import supervisor
+from mlsl_tpu import checker, supervisor
 from mlsl_tpu.comm.request import CommDesc, CommRequest, ComputeType
 from mlsl_tpu.core import stats as stats_mod
 from mlsl_tpu.obs import tracer as obs
@@ -261,6 +261,19 @@ class GradBucket:
                 # Mid-round members keep registering so an admitted round
                 # always completes or fails as a unit.
                 return False
+            chkp = checker.level()
+            if chkp:
+                # CHKP through the pack: validate the member buffer against
+                # ITS OWN request descriptor before it joins the coalesced
+                # round — the contract its individual Start would enforce,
+                # so a bad buffer is named per member instead of blending
+                # into the packed concatenation. Checked only on the
+                # REGISTERING paths: a declined round (abandon / open
+                # breaker, above) runs the individual request, whose own
+                # Start performs this exact check — doing it here too would
+                # double-count every buffer in the CHKP stats.
+                checker.check_buffer(buf, getattr(ps, self.req_attr).desc,
+                                     chkp)
             self._bufs[i] = buf  # a pre-dispatch restart supersedes
             if len(self._bufs) == len(self.members):
                 # _error is necessarily None here: every member passed the

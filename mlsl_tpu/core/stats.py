@@ -193,6 +193,56 @@ def record_degrade(subsystem: str, event: str, detail: str = "") -> None:
             pass
 
 
+# Integrity-sentinel accounting (mlsl_tpu.sentinel): gate screens/fires and
+# consistency audits — process-wide like the degrade counters (the sentinel
+# fires from the trainer with no Session handle). Statistics.print_ renders
+# the totals as the SENTINEL line in mlsl_stats.log; gate fires and audit
+# mismatches also land on the obs timeline as integrity.* instants (emitted
+# by the sentinel itself, which owns the step/reason context).
+SENTINEL_COUNTERS: Dict[str, int] = {
+    "screened": 0,        # steps the quality gate inspected
+    "gate_warn": 0,       # gate fired with response 'warn' (run continued)
+    "gate_skip": 0,       # gate fired with response 'skip_step'
+    "gate_rollback": 0,   # gate fired with response 'rollback' (raised)
+    "audits": 0,          # cross-replica consistency audits run
+    "audit_mismatch": 0,  # audits that found replica divergence
+    "verified_saves": 0,  # checkpoints saved with a passing fingerprint
+    "reaudits": 0,        # post-restore re-audits (rollback verification)
+}
+
+
+def record_sentinel(event: str) -> None:
+    """One sentinel event: 'screened', 'gate_<response>', 'audits',
+    'audit_mismatch', 'verified_saves', or 'reaudits'."""
+    SENTINEL_COUNTERS[event] += 1
+
+
+def reset_sentinel_counters() -> None:
+    for k in SENTINEL_COUNTERS:
+        SENTINEL_COUNTERS[k] = 0
+
+
+# Buffer-checker accounting (mlsl_tpu.checker): how many buffers CHKP
+# inspected, how many violated the contract, and how many device syncs the
+# batched CHKP_VALUES finiteness path actually paid (the point of batching:
+# value_checks >> value_syncs on a multi-request round).
+CHKP_COUNTERS: Dict[str, int] = {
+    "checks": 0,        # buffers validated (shape/dtype/sharding tier)
+    "violations": 0,    # checks that raised (any tier)
+    "value_checks": 0,  # finiteness verdicts queued (CHKP_VALUES)
+    "value_syncs": 0,   # device syncs paid to resolve queued verdicts
+}
+
+
+def record_chkp(event: str, n: int = 1) -> None:
+    CHKP_COUNTERS[event] += n
+
+
+def reset_chkp_counters() -> None:
+    for k in CHKP_COUNTERS:
+        CHKP_COUNTERS[k] = 0
+
+
 def record_comm_retry(phase: str, request: str, error: BaseException,
                       attempt: int, delay_s: float) -> None:
     """One rung-2 retry of a transient dispatch/wait failure (called by
@@ -672,6 +722,29 @@ class Statistics:
             lines.append(
                 f"{'ALGO':<16} {'DISPATCH':<8} " + " ".join(parts)
             )
+        sc = SENTINEL_COUNTERS
+        if any(sc.values()):
+            # the integrity story: how many steps the gate screened, what it
+            # fired, and whether the consistency audit ever saw replicas
+            # diverge — one grep ('SENTINEL') answers "did this run's state
+            # stay trustworthy"
+            lines.append(
+                f"{'SENTINEL':<16} {'GATE':<8} "
+                f"screened {sc['screened']} "
+                f"warn {sc['gate_warn']} skip {sc['gate_skip']} "
+                f"rollback {sc['gate_rollback']} audits {sc['audits']} "
+                f"mismatch {sc['audit_mismatch']} "
+                f"verified_saves {sc['verified_saves']} "
+                f"reaudits {sc['reaudits']}"
+            )
+        kc = CHKP_COUNTERS
+        if any(kc.values()):
+            lines.append(
+                f"{'CHKP':<16} {'BUFFERS':<8} checks {kc['checks']} "
+                f"violations {kc['violations']} "
+                f"value_checks {kc['value_checks']} "
+                f"value_syncs {kc['value_syncs']}"
+            )
         dc = DEGRADE_COUNTERS
         if any(dc.values()) or DEGRADE_FALLBACKS:
             # the ladder summary: every trip/probe/reset, retry, degraded
@@ -683,7 +756,8 @@ class Statistics:
             states = " ".join(
                 f"{name}:{st['state']}"
                 for name, st in supervisor.status().items()
-                if st.get("trips") or st["state"] != supervisor.CLOSED
+                if (st["state"] == "tripped" if name == "sentinel"
+                    else st.get("trips") or st["state"] != supervisor.CLOSED)
             )
             fb = " ".join(
                 f"{name}={n}" for name, n in sorted(DEGRADE_FALLBACKS.items())

@@ -161,7 +161,21 @@ class DeviceFeed:
         and the cache then only short-circuits the h2d transfer; random
         access (``it`` None) skips the host read entirely on a hit."""
         wire_batch, donate = self._serve_wire(key, it)
-        return self.codec.decode(wire_batch, donate=donate)
+        return self._checked_decode(wire_batch, donate)
+
+    def _checked_decode(self, wire_batch, donate):
+        """Decode + the CHKP boundary: under MLSL_CHKP=2 every float leaf of
+        the decoded batch is finiteness-verified (one batched device sync —
+        mlsl_tpu.checker) so a wire-codec or cache fault that produced
+        garbage surfaces at the decode boundary, not three layers later as a
+        poisoned gradient."""
+        batch = self.codec.decode(wire_batch, donate=donate)
+        from mlsl_tpu import checker
+
+        lvl = checker.level()
+        if lvl >= checker.CHKP_VALUES:
+            checker.check_feed_batch(batch, lvl)
+        return batch
 
     @property
     def cache_complete(self) -> bool:
@@ -213,7 +227,7 @@ class DeviceFeed:
         """Decode hook the AsyncLoader applies on the CONSUMER thread (see
         _serve_wire): (wire_batch, donate) -> decoded batch."""
         wire_batch, donate = item
-        return self.codec.decode(wire_batch, donate=donate)
+        return self._checked_decode(wire_batch, donate)
 
     def _prefetch_iter(self):
         """Wire-batch stream for AsyncLoader prefetch: the worker runs the

@@ -45,6 +45,17 @@ class MLSLCorruptionError(MLSLError):
     it to the always-correct path rather than retrying in place."""
 
 
+class MLSLIntegrityError(MLSLCorruptionError):
+    """TRAINING-STATE integrity failure, raised by the integrity sentinel
+    (mlsl_tpu.sentinel): a step-quality gate escalated to rollback, a
+    cross-replica consistency audit found params/optimizer state diverged,
+    or a post-restore re-audit did not reproduce the recorded fingerprint.
+    Subclasses MLSLCorruptionError, so the supervisor taxonomy classifies it
+    CORRUPTION; FaultTolerantLoop answers it with rollback to the newest
+    VERIFIED checkpoint (one whose manifest carries a passing audit
+    fingerprint) instead of the newest step."""
+
+
 def set_log_level(level: int | LogLevel) -> None:
     global _level
     _level = LogLevel(int(level))

@@ -26,6 +26,7 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import NamedSharding, PartitionSpec as P
 
+from mlsl_tpu import chaos
 from mlsl_tpu.comm.collectives import _BUF_SPEC
 from mlsl_tpu.comm.mesh import (
     DATA_AXIS,
@@ -306,10 +307,28 @@ class DataParallelTrainer:
         needs_comm = any(
             self.ops[n].get_parameter_set(0).need_comm for n in layers
         )
+        # Integrity sentinel (mlsl_tpu.sentinel): the step quality gate and
+        # the cross-replica consistency audit, armed from Config
+        # (MLSL_SENTINEL_*). Public: FaultTolerantLoop drives the audit
+        # cadence and verified-checkpoint fingerprints through it.
+        self.sentinel = None
+        cfg = env.config
+        if cfg is not None:
+            from mlsl_tpu import sentinel as sentinel_mod
+
+            if sentinel_mod.armed(cfg):
+                self.sentinel = sentinel_mod.Sentinel.from_config(
+                    cfg, self.mesh
+                )
         # force_graph_path bypasses the fused shortcut so the per-layer
         # Start/Wait machinery can be measured even when no comm is needed
-        # (bench.py times it against the fused program on one chip).
-        use_fused = not needs_comm and not force_graph_path
+        # (bench.py times it against the fused program on one chip). An
+        # armed quality gate does the same: the gate screens at the
+        # gradient boundary, which the fused program never exposes.
+        use_fused = (
+            not needs_comm and not force_graph_path
+            and not (self.sentinel is not None and self.sentinel.gate_armed)
+        )
         self.donate_params = bool(donate_params)
         sharding = NamedSharding(self.mesh, P())
         # Donation happens on the fused and barrier-update paths; the
@@ -779,6 +798,56 @@ class DataParallelTrainer:
         dev_feed = DeviceFeed(source, self.dist.topology, **kw)
         return AsyncLoader(dev_feed, depth=depth)
 
+    # -- silent-corruption chaos sites + the sentinel quality gate ---------
+
+    def _chaos_state_sites(self) -> None:
+        """``train.params`` / ``train.opt_state`` silent-corruption sites:
+        a fired ``silent`` plan flips/perturbs ONE replica's copy of live
+        state without raising (sentinel.corrupt_silent) — the SDC class only
+        the consistency audit can catch. Called at step entry."""
+        from mlsl_tpu import sentinel as sentinel_mod
+
+        p = chaos.inject("train.params", step=self._step_no)
+        if p is not None and p.kind == "silent":
+            self.params = sentinel_mod.corrupt_silent(self.params, p)
+        if self._opt_state is not None or self._du_opt_state:
+            # only consult the site when there IS state to corrupt: firing
+            # (and burning a plan's xN budget) against a stateless SGD
+            # trainer would make a soak's "every fire was detected"
+            # accounting vacuous
+            p = chaos.inject("train.opt_state", step=self._step_no)
+            if p is not None and p.kind == "silent":
+                if self._opt_state is not None:
+                    self._opt_state = sentinel_mod.corrupt_silent(
+                        self._opt_state, p
+                    )
+                else:
+                    name = sorted(self._du_opt_state)[
+                        chaos._rng.randrange(len(self._du_opt_state))
+                    ]
+                    self._du_opt_state[name] = sentinel_mod.corrupt_silent(
+                        self._du_opt_state[name], p
+                    )
+
+    def _screen(self, loss, grads):
+        """``train.grads`` silent site + the step quality gate, between the
+        gradient program and any gradient comm. -> (grads, proceed): proceed
+        False means the gate chose ``skip_step`` — the caller returns the
+        loss without syncing or updating, so no comm starts, error-feedback
+        residuals never advance, and the step behaves exactly as if it had
+        not run (lockstep-twin parity, tests/test_sentinel.py)."""
+        if chaos._plans:
+            p = chaos.inject("train.grads", step=self._step_no)
+            if p is not None and p.kind == "silent":
+                from mlsl_tpu import sentinel as sentinel_mod
+
+                grads = sentinel_mod.corrupt_silent(grads, p)
+        if self.sentinel is not None and self.sentinel.gate_armed:
+            if not self.sentinel.gate(loss, grads, self.params,
+                                      self._step_no):
+                return grads, False
+        return grads, True
+
     # -- the training step (reference loop mlsl_test.cpp:660-698) ----------
 
     def step_accum(self, batches) -> jax.Array:
@@ -789,6 +858,8 @@ class DataParallelTrainer:
         k micro-batches. Returns the mean loss."""
         mlsl_assert(len(batches) >= 1, "step_accum needs at least one batch")
         self._step_no += 1
+        if chaos._plans:
+            self._chaos_state_sites()
         if self._accum_fns is None:
             def add(a, b):
                 return jax.tree.map(jnp.add, a, b)
@@ -809,10 +880,16 @@ class DataParallelTrainer:
         if tr is not None:
             tr.complete("step.grad", "step", t0, step=self._step_no,
                         micro_batches=k)
-        return self._sync_and_update(scale_fn(total, k), loss_sum / k)
+        loss = loss_sum / k
+        grads, proceed = self._screen(loss, scale_fn(total, k))
+        if not proceed:
+            return loss
+        return self._sync_and_update(grads, loss)
 
     def step(self, batch) -> jax.Array:
         self._step_no += 1
+        if chaos._plans:
+            self._chaos_state_sites()
         tr = obs_trace._tracer
         t0 = tr.now() if tr is not None else 0
         if self._fused_fn is not None:
@@ -830,6 +907,9 @@ class DataParallelTrainer:
             # host-side dispatch of the local-gradient program (async: device
             # compute overlaps the comm Starts that follow)
             tr.complete("step.grad", "step", t0, step=self._step_no)
+        grads, proceed = self._screen(loss, grads)
+        if not proceed:
+            return loss
         return self._sync_and_update(grads, loss)
 
     def _sync_and_update(self, grads, loss) -> jax.Array:

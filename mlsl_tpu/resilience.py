@@ -21,7 +21,13 @@ import jax
 
 from mlsl_tpu import supervisor
 from mlsl_tpu.checkpoint import CheckpointManager, restore_trainer, save_trainer
-from mlsl_tpu.log import MLSLError, log_error, log_info, log_warning
+from mlsl_tpu.log import (
+    MLSLError,
+    MLSLIntegrityError,
+    log_error,
+    log_info,
+    log_warning,
+)
 from mlsl_tpu.obs import tracer as obs
 
 
@@ -182,6 +188,29 @@ class FaultTolerantLoop:
             )
         trainer = self.make_trainer()
         restored = restore_trainer(self.ckpt, trainer)
+        # Post-restore re-audit (integrity sentinel): a rollback answering a
+        # CORRUPTION fault must PROVE the restored state is the verified one
+        # — replicas consistent AND the fingerprint bit-exact against what
+        # the manifest recorded at save time. A failure here means even the
+        # rollback substrate is compromised; surface it rather than resume.
+        sent = getattr(trainer, "sentinel", None)
+        if sent is not None and restored is not None:
+            res = sent.audit_now(trainer, step=restored)
+            stats_mod.record_sentinel("reaudits")
+            want = self.ckpt.recorded_fingerprint(restored)
+            if not res.equal or (want is not None and res.digest != want):
+                raise MLSLIntegrityError(
+                    f"post-restore re-audit failed at step {restored}: "
+                    f"replicas_equal={res.equal}, digest "
+                    f"{res.digest[:16]} vs recorded "
+                    f"{(want or '<unverified>')[:16]} — the restored "
+                    "checkpoint does not reproduce its verified state"
+                ) from error
+            log_info(
+                "post-restore re-audit passed at step %d (digest %s%s)",
+                restored, res.digest[:16],
+                ", matches manifest" if want is not None else "",
+            )
         if tr is not None:
             # one span per recovery cycle: drain + teardown + rebuild +
             # restore — on the timeline this is the gap a fault cost the run
@@ -189,6 +218,27 @@ class FaultTolerantLoop:
                         error=type(error).__name__, recovery=self.recoveries,
                         resumed_step=restored if restored is not None else -1)
         return trainer, (restored + 1 if restored is not None else 0)
+
+    def _warn_if_sentinel_unwired(self, trainer) -> None:
+        """MLSL_SENTINEL_* armed but the trainer type carries no sentinel
+        (only DataParallelTrainer is wired today): say so LOUDLY — an
+        operator who exported the knobs believes the integrity layer is on,
+        and a silent no-op is exactly the failure mode this subsystem
+        exists to eliminate."""
+        if getattr(trainer, "sentinel", None) is not None:
+            return
+        from mlsl_tpu import sentinel as sentinel_mod
+        from mlsl_tpu.core.environment import Environment
+
+        env = Environment._instance
+        cfg = env.config if env is not None else None
+        if cfg is not None and sentinel_mod.armed(cfg):
+            log_warning(
+                "MLSL_SENTINEL_* is armed but %s carries no integrity "
+                "sentinel — gates, audits, and verified checkpoints are "
+                "INACTIVE for this run (sentinel wiring currently covers "
+                "DataParallelTrainer only)", type(trainer).__name__,
+            )
 
     def _abort(self, step: int, error: BaseException, why: str) -> None:
         """The ladder's last rung is exhausted: every retry and breaker
@@ -229,6 +279,7 @@ class FaultTolerantLoop:
         Returns early (with ``self.preempted`` set and a final checkpoint on
         disk) when a handled preemption signal arrives mid-run."""
         trainer = self.make_trainer()
+        self._warn_if_sentinel_unwired(trainer)
         restored = restore_trainer(self.ckpt, trainer)
         step = restored + 1 if restored is not None else 0
         # retry accounting is keyed to the step that failed: resuming several
@@ -249,10 +300,24 @@ class FaultTolerantLoop:
                         )
                     loss = trainer.step(batch_fn(trainer, step))
                     jax.block_until_ready(trainer.params)
+                    sent = getattr(trainer, "sentinel", None)
+                    if sent is not None:
+                        # cadence audit (MLSL_SENTINEL_EVERY): divergence
+                        # raises MLSLIntegrityError -> the recovery path
+                        # below, where restore prefers verified steps
+                        sent.maybe_audit(trainer, step)
                     if step % self.save_every == 0:
                         # inside the try: a device fault surfacing during the save's
                         # device read must take the recovery path too
-                        save_trainer(self.ckpt, trainer, step=step)
+                        fp = None
+                        if sent is not None:
+                            # audit at the checkpoint boundary: a passing
+                            # digest marks the step VERIFIED in its manifest;
+                            # divergence raises instead of poisoning the
+                            # checkpoint history
+                            fp = sent.checkpoint_fingerprint(trainer, step)
+                        save_trainer(self.ckpt, trainer, step=step,
+                                     fingerprint=fp)
                         last_saved = step
                 except RECOVERABLE as e:
                     if step == failed_step:
@@ -290,7 +355,14 @@ class FaultTolerantLoop:
                                 "preemption: writing final checkpoint at step %d",
                                 step,
                             )
-                            save_trainer(self.ckpt, trainer, step=step, wait=True)
+                            sent = getattr(trainer, "sentinel", None)
+                            save_trainer(
+                                self.ckpt, trainer, step=step, wait=True,
+                                fingerprint=(
+                                    sent.checkpoint_fingerprint(trainer, step)
+                                    if sent is not None else None
+                                ),
+                            )
                         self.ckpt.wait()
                         log_info(
                             "preemption drain complete; stopping at step %d", step

@@ -1,0 +1,537 @@
+"""Training integrity sentinel: silent-corruption detection and response.
+
+Every defense below this layer fires on a *loud* fault — the watchdog needs a
+hang, the supervisor ladder needs an exception, checkpoint fallback needs a
+checksum mismatch at read time. A silent fault (a bit-flip in a parameter or
+optimizer slot, a NaN gradient from a bad batch, SPMD replicas drifting apart
+after an SDC) passes through untouched, poisons the model, and gets
+*checkpointed* — so even a restart replays the corruption. The reference's
+PointerChecker (src/pointer_checker.{hpp,cpp}, ENABLE_CHKP_INT) is the
+paper's acknowledgment that payload integrity needs runtime verification;
+``checker.py`` reproduces it at the request boundary, and this module guards
+the training STATE itself with three detection layers and a verified-good
+response:
+
+1. **Step quality gate** (:meth:`Sentinel.gate`): cheap fused on-device
+   screens between the gradient program and the gradient comm — nonfinite
+   count over the local grads, global grad-norm vs an EMA spike threshold,
+   loss z-score (a corrupt PARAM poisons the loss/grads it produces the
+   step it is read, so these screens cover it without a per-step scan of
+   the replicated state) — with a configurable response ladder
+   ``MLSL_SENTINEL_GATE``:
+   ``warn`` logs and continues, ``skip_step`` discards the poisoned update
+   (the step behaves exactly as if it never ran: no comm started, so
+   error-feedback residuals and data-order bookkeeping stay consistent —
+   pinned by lockstep-twin parity tests), ``rollback`` raises
+   :class:`MLSLIntegrityError`.
+2. **Cross-replica consistency audit** (:meth:`Sentinel.audit_now`): every
+   ``MLSL_SENTINEL_EVERY`` steps, a blockwise int32 fingerprint of params +
+   optimizer state is reduced via pmin/pmax equality ON DEVICE (no host
+   gather) — replicas that drifted apart after an SDC disagree in some
+   block, and ``pmin != pmax`` exposes it. Sharded (ZeRO-1) optimizer state
+   contributes an exact integer psum to the fingerprint instead (each rank's
+   shard is unique — divergence does not apply, but identity does).
+3. **Verified-good checkpoints + rollback** (checkpoint.py + resilience.py):
+   ``CheckpointManager.save`` records the passing audit fingerprint in the
+   step manifest, ``restore_trainer`` prefers the newest *verified* step,
+   and ``FaultTolerantLoop`` answers :class:`MLSLIntegrityError` with
+   rollback-to-last-verified plus a post-restore re-audit, counted against
+   ``MLSL_RESTART_BUDGET``.
+
+The fingerprint is integer math end to end (float bits bitcast to int32,
+blockwise wraparound sums): any reduction order gives the same result, so
+the same logical state fingerprints identically through the plain, bucketed,
+and quantized comm paths (pinned by tests/test_sentinel.py), and a single
+flipped bit changes its block's checksum.
+
+``corrupt_silent`` is the proof harness: it applies a chaos ``silent`` plan
+(mlsl_tpu.chaos — flip/perturb one element of one replica's copy, never
+raising) so soak tests can assert the sentinel catches exactly the class of
+fault every other rung misses.
+
+Knobs (docs/TUNING.md §13): MLSL_SENTINEL_GATE, MLSL_SENTINEL_EVERY,
+MLSL_SENTINEL_SPIKE, MLSL_SENTINEL_ZMAX, MLSL_SENTINEL_WARMUP,
+MLSL_SENTINEL_BLOCK — validated in Config.validate(); the audit interval is
+tuner-tunable (tuner.KNOB_RANGES).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import hashlib
+import math
+from typing import Dict, Optional, Tuple
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from mlsl_tpu import chaos
+from mlsl_tpu.core import stats as stats_mod
+from mlsl_tpu.log import MLSLIntegrityError, log_warning
+from mlsl_tpu.obs import tracer as obs
+
+#: EMA decay for the spike/z-score screens: ~last 10 healthy steps dominate.
+EMA_DECAY = 0.9
+
+#: gate responses, mildest first (Config.validate pins the legal set)
+GATE_RESPONSES = ("warn", "skip_step", "rollback")
+
+# last completed audit, process-wide (like the watchdog event record): the
+# supervisor dashboard and post-mortems need "when did we last KNOW the
+# state was consistent" even after the trainer that ran it is gone
+_last_audit: Optional[dict] = None
+
+
+def armed(config) -> bool:
+    """Does this Config arm any sentinel layer?"""
+    return bool(
+        config is not None
+        and (getattr(config, "sentinel_gate", "")
+             or getattr(config, "sentinel_every", 0) > 0)
+    )
+
+
+def status() -> dict:
+    """Sentinel summary for ``supervisor.status()`` dashboards: counters plus
+    the last completed audit. ``state`` mirrors the breaker vocabulary:
+    'idle' (never ran), 'armed' (screening/auditing, nothing found),
+    'tripped' (a gate escalated or an audit found divergence)."""
+    c = dict(stats_mod.SENTINEL_COUNTERS)
+    if c["gate_rollback"] or c["audit_mismatch"]:
+        state = "tripped"
+    elif c["screened"] or c["audits"]:
+        state = "armed"
+    else:
+        state = "idle"
+    out = {"state": state, **c}
+    if _last_audit is not None:
+        out["last_audit"] = dict(_last_audit)
+    return out
+
+
+@dataclasses.dataclass
+class AuditResult:
+    """One consistency audit: ``equal`` is the on-device pmin/pmax verdict
+    over the replicated state's fingerprint; ``digest`` identifies the state
+    (sha256 of the fingerprint vector) and is what checkpoint manifests
+    record / post-restore re-audits compare."""
+
+    equal: bool
+    digest: str
+    step: int
+    blocks: int
+
+
+class Sentinel:
+    """Per-trainer integrity sentinel (construct via :meth:`from_config`).
+
+    The screen and audit programs are built lazily against the trainer's
+    actual tree structure and cached; a rebuilt trainer (recovery cycle)
+    carries a fresh Sentinel with cold caches — correctness never depends on
+    cross-trainer cache reuse."""
+
+    def __init__(self, mesh, gate: str = "", every: int = 0,
+                 spike: float = 10.0, zmax: float = 8.0, warmup: int = 5,
+                 block: int = 4096):
+        self.mesh = mesh
+        self.gate_response = gate
+        self.every = int(every)
+        self.spike = float(spike)
+        self.zmax = float(zmax)
+        self.warmup = int(warmup)
+        self.block = int(block)
+        # EMA state for the history-armed screens (healthy steps only)
+        self._n = 0
+        self._ema_norm: Optional[float] = None
+        self._loss_mean: Optional[float] = None
+        self._loss_var = 0.0
+        # program caches
+        self._screen_fn = None
+        self._count_fn = None
+        self._screen_key: Optional[Tuple] = None
+        self._audit_fn = None
+        self._audit_key: Optional[Tuple] = None
+        self._last: Optional[AuditResult] = None
+
+    @classmethod
+    def from_config(cls, config, mesh) -> "Sentinel":
+        return cls(
+            mesh,
+            gate=config.sentinel_gate,
+            every=config.sentinel_every,
+            spike=config.sentinel_spike,
+            zmax=config.sentinel_zmax,
+            warmup=config.sentinel_warmup,
+            block=config.sentinel_block,
+        )
+
+    @property
+    def gate_armed(self) -> bool:
+        return bool(self.gate_response)
+
+    @property
+    def audit_armed(self) -> bool:
+        return self.every > 0
+
+    # -- layer 1: the step quality gate -----------------------------------
+
+    def _build_screen_fns(self, grads, loss):
+        # THE gate cost model. Healthy path: ONE fused pass over the
+        # (sharded) gradient buffers computing the per-device squared-norm
+        # partial — Σg² alone detects nonfinite payloads (NaN/Inf propagate
+        # through the sum; a finite overflow lands Inf, which also deserves
+        # the gate), so no separate isfinite pass is paid per step. Partials
+        # come back as tiny (R,D,S,M) arrays the HOST sums — zero in-program
+        # collectives, because a psum pays a cross-device rendezvous per
+        # step. The loss value rides through the same program so the gate's
+        # one host sync reads two tiny arrays instead of gathering the
+        # sharded loss buffer. The nonfinite COUNT (the diagnostic the log
+        # line reports) runs as a second program ONLY after a verdict fires.
+        # The PARAMS are deliberately not scanned per step — a corrupt
+        # parameter poisons the loss/gradients it produces the very step it
+        # is read, so the grads+loss screens already catch it, while a
+        # replicated-params scan would pay the full parameter footprint per
+        # device per step; parameter *state* integrity is the audit's layer.
+        from jax.sharding import PartitionSpec as P
+
+        from mlsl_tpu.comm.collectives import smap
+        from mlsl_tpu.comm.mesh import GRID_AXES, NUM_GRID_AXES
+
+        grid1 = (1,) * len(GRID_AXES)
+        specs = jax.tree.map(
+            lambda l: P(*GRID_AXES, *([None] * (l.ndim - NUM_GRID_AXES))),
+            grads,
+        )
+        loss_spec = P(*GRID_AXES, *([None] * (loss.ndim - NUM_GRID_AXES)))
+        out = P(*GRID_AXES)
+
+        def float_leaves(g):
+            return [l for l in jax.tree.leaves(g)
+                    if jnp.issubdtype(l.dtype, jnp.floating)]
+
+        def sq_body(g, lv):
+            sq = jnp.float32(0.0)
+            for leaf in float_leaves(g):
+                sq += jnp.sum(jnp.square(leaf.astype(jnp.float32)))
+            return (
+                sq.reshape(grid1),
+                lv.reshape(-1)[0].astype(jnp.float32).reshape(grid1),
+            )
+
+        def nf_body(g):
+            nf = jnp.int32(0)
+            for leaf in float_leaves(g):
+                nf += jnp.sum(~jnp.isfinite(leaf), dtype=jnp.int32)
+            return nf.reshape(grid1)
+
+        screen = jax.jit(smap(sq_body, self.mesh,
+                              in_specs=(specs, loss_spec),
+                              out_specs=(out, out), check=False))
+        count = jax.jit(smap(nf_body, self.mesh, in_specs=(specs,),
+                             out_specs=out, check=False))
+        return screen, count
+
+    def gate(self, loss, grads, params, step: int) -> bool:
+        """Screen one step's (loss, local grads) before any gradient comm
+        starts. Returns True to proceed with the update, False to skip it
+        (response ``skip_step``); raises :class:`MLSLIntegrityError` under
+        ``rollback``. Healthy steps feed the EMA state; fired steps never do
+        (a poisoned norm must not drag the threshold up to meet the next
+        poisoned step). ``params`` rides along for response context only —
+        see _build_screen_fns for why the screen never scans it."""
+        if not self.gate_response:
+            return True
+        tr = obs._tracer
+        t0 = tr.now() if tr is not None else 0
+        key = (
+            jax.tree.structure(grads),
+            tuple((l.shape, str(l.dtype)) for l in jax.tree.leaves(grads)),
+        )
+        if self._screen_fn is None or key != self._screen_key:
+            self._screen_fn, self._count_fn = self._build_screen_fns(
+                grads, loss
+            )
+            self._screen_key = key
+        # one host sync for the whole verdict (the gate's entire step cost):
+        # two tiny per-device partial arrays, summed on the host
+        sq_a, lv_a = jax.device_get(self._screen_fn(grads, loss))
+        sq = float(np.sum(sq_a, dtype=np.float64))
+        lv = float(np.asarray(lv_a).reshape(-1)[0])
+        norm = math.sqrt(sq) if math.isfinite(sq) and sq >= 0 else float("inf")
+        stats_mod.record_sentinel("screened")
+
+        reason = None
+        if not math.isfinite(sq) or not np.isfinite(lv_a).all():
+            # lv_a carries every device's LOCAL loss, so a single replica's
+            # poisoned forward pass is caught even when device 0 looks fine.
+            # The element count is diagnostics for the fired path only (a
+            # second pass the healthy path never pays).
+            nf_g = int(np.sum(jax.device_get(self._count_fn(grads))))
+            reason = (f"nonfinite: {nf_g} grad elements, "
+                      f"sqnorm={sq!r}, loss={lv!r}")
+        elif self._n >= self.warmup:
+            if (self._ema_norm is not None and self._ema_norm > 0
+                    and norm > self.spike * self._ema_norm):
+                reason = (f"grad-norm spike: {norm:.4g} > {self.spike:g} x "
+                          f"EMA {self._ema_norm:.4g}")
+            elif self._loss_mean is not None:
+                sd = math.sqrt(max(self._loss_var, 0.0))
+                if sd > 0 and abs(lv - self._loss_mean) > self.zmax * sd:
+                    reason = (f"loss outlier: {lv:.4g} vs EMA "
+                              f"{self._loss_mean:.4g} +- {self.zmax:g} x "
+                              f"{sd:.4g}")
+
+        if tr is not None:
+            tr.complete("sentinel.gate", "sentinel", t0, step=step,
+                        grad_norm=round(norm, 6) if math.isfinite(norm)
+                        else None,
+                        fired=reason)
+        if reason is None:
+            self._observe(norm, lv)
+            return True
+        resp = self.gate_response
+        short = {"warn": "warn", "skip_step": "skip",
+                 "rollback": "rollback"}[resp]
+        stats_mod.record_sentinel(f"gate_{short}")
+        log_warning("sentinel gate fired at step %d (%s): %s", step, resp,
+                    reason)
+        if tr is not None:
+            tr.instant("integrity.gate", "sentinel", step=step,
+                       response=resp, reason=reason)
+        if resp == "rollback":
+            raise MLSLIntegrityError(
+                f"step quality gate at step {step}: {reason} "
+                "(response=rollback)"
+            )
+        return resp != "skip_step"
+
+    def _observe(self, norm: float, loss: float) -> None:
+        self._n += 1
+        if self._ema_norm is None:
+            self._ema_norm = norm
+        else:
+            self._ema_norm = EMA_DECAY * self._ema_norm + (1 - EMA_DECAY) * norm
+        if self._loss_mean is None:
+            self._loss_mean = loss
+        else:
+            dev = loss - self._loss_mean
+            self._loss_mean += (1 - EMA_DECAY) * dev
+            self._loss_var = (EMA_DECAY * self._loss_var
+                              + (1 - EMA_DECAY) * dev * dev)
+
+    # -- layer 2: the cross-replica consistency audit ----------------------
+
+    def _leaf_blocks(self, x):
+        """One leaf's local view -> its blockwise int32 checksum vector.
+        Integer math end to end: bitcast (not cast) preserves every payload
+        bit, and int32 wraparound addition is exact and order-independent,
+        so the fingerprint is deterministic across comm paths and reduction
+        orders — and any single flipped bit changes its block's sum."""
+        flat = x.reshape(-1)
+        if flat.dtype == jnp.float32:
+            v = jax.lax.bitcast_convert_type(flat, jnp.int32)
+        elif flat.dtype in (jnp.bfloat16, jnp.float16):
+            v = jax.lax.bitcast_convert_type(flat, jnp.int16).astype(jnp.int32)
+        elif flat.dtype == jnp.float64:
+            # x64 mode: bitcast to int64 and XOR-fold the halves — a cast to
+            # f32 would round away low-mantissa bit flips and fingerprint a
+            # corrupted replica as clean
+            v64 = jax.lax.bitcast_convert_type(flat, jnp.int64)
+            v = (v64 ^ (v64 >> 32)).astype(jnp.int32)
+        elif flat.dtype in (jnp.int64, jnp.uint64):
+            v64 = flat.astype(jnp.int64)
+            v = (v64 ^ (v64 >> 32)).astype(jnp.int32)
+        else:
+            v = flat.astype(jnp.int32)
+        pad = (-v.shape[0]) % self.block
+        if pad:
+            v = jnp.pad(v, (0, pad))
+        return v.reshape(-1, self.block).sum(axis=1, dtype=jnp.int32)
+
+    def _build_audit_fn(self, rep_tree, sh_tree):
+        from jax.sharding import PartitionSpec as P
+
+        from mlsl_tpu.comm.collectives import smap
+        from mlsl_tpu.comm.mesh import GRID_AXES, NUM_GRID_AXES
+
+        axes = tuple(GRID_AXES)
+        rep_specs = jax.tree.map(lambda _: P(), rep_tree)
+        sh_specs = jax.tree.map(
+            lambda l: P(*GRID_AXES, *([None] * (l.ndim - NUM_GRID_AXES))),
+            sh_tree,
+        )
+
+        def body(rep, sh):
+            rep_fp = jnp.concatenate(
+                [self._leaf_blocks(l) for l in jax.tree.leaves(rep)]
+            )
+            mn = jax.lax.pmin(rep_fp, axes)
+            mx = jax.lax.pmax(rep_fp, axes)
+            equal = jnp.all(mn == mx)
+            parts = [mn]
+            sh_leaves = jax.tree.leaves(sh)
+            if sh_leaves:
+                parts.append(jax.lax.psum(
+                    jnp.concatenate([self._leaf_blocks(l) for l in sh_leaves]),
+                    axes,
+                ))
+            return equal, jnp.concatenate(parts)
+
+        sm = smap(body, self.mesh, in_specs=(rep_specs, sh_specs),
+                  out_specs=(P(), P()), check=False)
+        return jax.jit(sm)
+
+    @staticmethod
+    def _audit_state(trainer) -> Tuple[dict, dict]:
+        """(replicated, sharded) state trees the audit covers: params + the
+        replicated optax state go through pmin/pmax replica comparison; the
+        ZeRO-1 owned-shard state (per-rank unique) joins the fingerprint via
+        an exact integer psum instead."""
+        rep: Dict[str, object] = {"params": trainer.params}
+        if getattr(trainer, "_opt_state", None) is not None:
+            rep["opt_state"] = trainer._opt_state
+        sh: Dict[str, object] = {}
+        if getattr(trainer, "_du_opt_state", None):
+            sh["du_opt_state"] = trainer._du_opt_state
+        return rep, sh
+
+    def audit_now(self, trainer, step: int) -> AuditResult:
+        """Run the consistency audit immediately (no cadence check) and
+        return the verdict + state digest. Never raises on mismatch — the
+        policy (raise, log, prefer another checkpoint) belongs to the
+        caller; :meth:`maybe_audit` applies the standard one."""
+        global _last_audit
+        tr = obs._tracer
+        t0 = tr.now() if tr is not None else 0
+        rep, sh = self._audit_state(trainer)
+        key = (
+            jax.tree.structure((rep, sh)),
+            tuple((l.shape, str(l.dtype))
+                  for l in jax.tree.leaves((rep, sh))),
+        )
+        if self._audit_fn is None or key != self._audit_key:
+            self._audit_fn = self._build_audit_fn(rep, sh)
+            self._audit_key = key
+        equal_dev, fp_dev = self._audit_fn(rep, sh)
+        equal = bool(jax.device_get(equal_dev))
+        fp = np.asarray(jax.device_get(fp_dev), dtype="<i4")
+        digest = hashlib.sha256(fp.tobytes()).hexdigest()
+        res = AuditResult(equal=equal, digest=digest, step=step,
+                          blocks=int(fp.size))
+        stats_mod.record_sentinel("audits")
+        if not equal:
+            stats_mod.record_sentinel("audit_mismatch")
+        self._last = res
+        _last_audit = {"step": step, "equal": equal, "digest": digest}
+        if tr is not None:
+            tr.complete("sentinel.audit", "sentinel", t0, step=step,
+                        equal=equal, blocks=res.blocks,
+                        digest=digest[:16])
+            if not equal:
+                tr.instant("integrity.violation", "sentinel", step=step,
+                           digest=digest[:16])
+        if not equal:
+            log_warning(
+                "sentinel audit at step %d: replica fingerprints DIVERGE "
+                "(digest %s) — params/optimizer state is corrupt on at "
+                "least one replica", step, digest[:16],
+            )
+        return res
+
+    def maybe_audit(self, trainer, step: int) -> Optional[AuditResult]:
+        """Cadence-gated audit (every ``MLSL_SENTINEL_EVERY`` steps); raises
+        :class:`MLSLIntegrityError` on divergence so FaultTolerantLoop rolls
+        back to the newest verified checkpoint."""
+        if self.every <= 0 or step % self.every:
+            return None
+        res = self.audit_now(trainer, step)
+        if not res.equal:
+            raise MLSLIntegrityError(
+                f"cross-replica consistency audit failed at step {step}: "
+                f"params/optimizer fingerprints diverge across replicas "
+                f"(digest {res.digest[:16]})"
+            )
+        return res
+
+    def checkpoint_fingerprint(self, trainer, step: int) -> str:
+        """Audit at a checkpoint boundary and return the digest the manifest
+        records. Raises on divergence — corrupt state must NEVER be saved as
+        a verified resume point (the raise takes the standard recovery
+        path instead of poisoning the checkpoint history)."""
+        res = self._last
+        if res is None or res.step != step:
+            res = self.audit_now(trainer, step)
+        if not res.equal:
+            raise MLSLIntegrityError(
+                f"refusing to checkpoint step {step}: consistency audit "
+                f"found replica divergence (digest {res.digest[:16]})"
+            )
+        stats_mod.record_sentinel("verified_saves")
+        return res.digest
+
+
+# -- the proof harness: seeded silent corruption ------------------------------
+
+
+def corrupt_silent(tree, plan):
+    """Apply one chaos ``silent`` plan to a pytree of arrays WITHOUT raising
+    — the fault class every loud-path defense misses, injected so soaks can
+    prove the sentinel catches it. Seeded by the chaos RNG
+    (``MLSL_CHAOS_SEED`` / ``chaos.seed``), so a soak schedule replays.
+
+    One float leaf, one element, ONE addressable shard: for a replicated
+    array (trainer params / optax state) that corrupts a single replica's
+    copy — exactly the divergence the consistency audit hunts; for a
+    distributed buffer it perturbs one device's payload slice.
+
+    ``plan.mag`` is None for a random single-bit flip; nan/inf overwrite
+    the element; a finite value adds ``mag * (|x| + 1)``. Returns a new
+    tree (inputs are never mutated in place — jax arrays cannot be)."""
+    leaves, treedef = jax.tree.flatten(tree)
+    # jnp.issubdtype, not np: ml_dtypes bfloat16 is NOT np.floating, and a
+    # bf16 model's "silent fault" must actually corrupt something rather
+    # than burn the plan's budget as a no-op
+    float_idx = [
+        i for i, l in enumerate(leaves)
+        if hasattr(l, "dtype") and jnp.issubdtype(l.dtype, jnp.floating)
+    ]
+    if not float_idx:
+        return tree
+    rng = chaos._rng
+    li = float_idx[rng.randrange(len(float_idx))]
+    leaf = leaves[li]
+    if not isinstance(leaf, jax.Array):
+        leaves[li] = _corrupt_host(np.array(leaf), plan, rng)
+        return jax.tree.unflatten(treedef, leaves)
+    shards = leaf.addressable_shards
+    si = rng.randrange(len(shards))
+    datas = [np.array(s.data) for s in shards]
+    datas[si] = _corrupt_host(datas[si], plan, rng)
+    new_leaf = jax.make_array_from_single_device_arrays(
+        leaf.shape, leaf.sharding,
+        [jax.device_put(d, s.device) for d, s in zip(datas, shards)],
+    )
+    leaves[li] = new_leaf
+    return jax.tree.unflatten(treedef, leaves)
+
+
+def _corrupt_host(arr: np.ndarray, plan, rng) -> np.ndarray:
+    flat = arr.reshape(-1)
+    if flat.size == 0:
+        return arr
+    idx = rng.randrange(flat.size)
+    mag = getattr(plan, "mag", None)
+    if mag is None:
+        # single-bit flip in the element's raw representation (the classic
+        # SDC); the uint view width follows the dtype's byte size
+        width = flat.dtype.itemsize
+        uview = flat[idx:idx + 1].view({1: np.uint8, 2: np.uint16,
+                                        4: np.uint32, 8: np.uint64}[width])
+        uview[0] = int(uview[0]) ^ (1 << rng.randrange(width * 8))
+    elif not math.isfinite(mag):
+        flat[idx] = mag
+    else:
+        v = float(flat[idx])
+        flat[idx] = flat.dtype.type(v + mag * (abs(v) + 1.0))
+    return arr

@@ -332,14 +332,19 @@ def configure(config=None, threshold: Optional[int] = None,
 
 def status() -> Dict[str, dict]:
     """Per-subsystem breaker status (subsystems never touched report a
-    virgin closed breaker) — surfaced by FaultTolerantLoop's abort log and
-    importable for dashboards."""
+    virgin closed breaker), plus the integrity sentinel's state — surfaced
+    by FaultTolerantLoop's abort log and importable for dashboards."""
     out = {}
     for name in sorted(set(SUBSYSTEMS) | set(_breakers)):
         br = _breakers.get(name)
         out[name] = br.status() if br is not None else {
             "state": CLOSED, "failures_in_window": 0, "trips": 0,
         }
+    # lazy: sentinel sits above the comm stack (imports jax/stats); the
+    # breaker machinery must stay importable from anywhere below it
+    from mlsl_tpu import sentinel as _sentinel
+
+    out["sentinel"] = _sentinel.status()
     return out
 
 

@@ -43,6 +43,11 @@ KNOB_RANGES = {
     # depth benchmarks/input_pipeline_bench.py measured best for this
     # machine's h2d link; an exported MLSL_FEED_DEPTH always wins
     "feed_depth": 1,
+    # integrity-sentinel audit interval (mlsl_tpu.sentinel): profiles may
+    # carry the interval benchmarks/sentinel_overhead_bench.py measured to
+    # keep gate+audit overhead under its budget on this machine; an
+    # exported MLSL_SENTINEL_EVERY always wins (0 = audit off)
+    "sentinel_every": 0,
 }
 
 

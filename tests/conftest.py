@@ -63,6 +63,15 @@ def _reset_supervisor():
                          window_s=c.breaker_window_s,
                          cooldown_s=c.breaker_cooldown_s)
     stats.reset_degrade_counters()
+    # the sentinel/checker counters are process-wide for the same reason
+    # (trainer/request layers hold no Session handle) and need the same
+    # between-test isolation
+    stats.reset_sentinel_counters()
+    stats.reset_chkp_counters()
+    from mlsl_tpu import checker, sentinel
+
+    checker._pending.clear()
+    sentinel._last_audit = None
 
 
 @pytest.fixture(autouse=True)

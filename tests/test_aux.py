@@ -30,13 +30,19 @@ class TestChecker:
             dist.all_reduce(buf, 8, DataType.FLOAT, ReductionType.SUM, GroupType.DATA)
 
     def test_checker_catches_nonfinite(self, env, monkeypatch):
+        """CHKP_VALUES batches its finiteness verdicts per round: the verdict
+        is QUEUED at Start (no device sync) and raised at the round's first
+        wait, naming the offending buffer."""
         from mlsl_tpu.log import MLSLError
 
         monkeypatch.setenv("MLSL_CHKP", "2")
         dist = env.create_distribution(8, 1)
         buf = dist.make_buffer(lambda p: np.full(8, np.nan), 8)
-        with pytest.raises(MLSLError):
-            dist.all_reduce(buf, 8, DataType.FLOAT, ReductionType.SUM, GroupType.DATA)
+        req = dist.all_reduce(
+            buf, 8, DataType.FLOAT, ReductionType.SUM, GroupType.DATA
+        )
+        with pytest.raises(MLSLError, match="non-finite"):
+            env.wait(req)
 
     def test_checker_passes_valid(self, env, monkeypatch):
         monkeypatch.setenv("MLSL_CHKP", "2")
@@ -46,6 +52,86 @@ class TestChecker:
             dist.all_reduce(buf, 8, DataType.FLOAT, ReductionType.SUM, GroupType.DATA)
         )
         np.testing.assert_allclose(dist.local_part(out, 0), np.full(8, 28.0))
+
+    def test_checker_counters_and_batched_sync(self, env, monkeypatch):
+        """CHKP accounting (CHKP line in mlsl_stats.log): two Starts queue
+        two finiteness verdicts but the round pays exactly ONE device sync —
+        the point of batching — and counters record hits vs violations."""
+        from mlsl_tpu.core import stats
+
+        monkeypatch.setenv("MLSL_CHKP", "2")
+        stats.reset_chkp_counters()
+        dist = env.create_distribution(8, 1)
+        b1 = dist.make_buffer(lambda p: np.full(8, 1.0), 8)
+        b2 = dist.make_buffer(lambda p: np.full(8, 2.0), 8)
+        r1 = dist.all_reduce(b1, 8, DataType.FLOAT, ReductionType.SUM,
+                             GroupType.DATA)
+        r2 = dist.all_reduce(b2, 8, DataType.FLOAT, ReductionType.SUM,
+                             GroupType.DATA)
+        env.wait(r1)
+        env.wait(r2)
+        c = stats.CHKP_COUNTERS
+        assert c["checks"] == 2
+        assert c["value_checks"] == 2
+        assert c["value_syncs"] == 1, (
+            "two queued verdicts must resolve in one batched sync"
+        )
+        assert c["violations"] == 0
+        stats.reset_chkp_counters()
+
+    def test_checker_failed_round_does_not_leak_verdicts(self, env, monkeypatch):
+        """A round that FAILS before its flush must drain its queued
+        CHKP_VALUES verdicts (logged, the real error stays primary) — a
+        later healthy request's wait must never inherit a stale nonfinite
+        verdict from a dead round."""
+        from mlsl_tpu import chaos
+        from mlsl_tpu.core import stats
+
+        monkeypatch.setenv("MLSL_CHKP", "2")
+        stats.reset_chkp_counters()
+        dist = env.create_distribution(8, 1)
+        bad = dist.make_buffer(lambda p: np.full(8, np.nan), 8)
+        # PERSISTENT (no rung-2 retry): the wait raises the chaos error
+        chaos.plan("request.wait", "error", exc=RuntimeError)
+        req = dist.all_reduce(bad, 8, DataType.FLOAT, ReductionType.SUM,
+                              GroupType.DATA)
+        with pytest.raises(RuntimeError, match="chaos injected"):
+            env.wait(req)
+        chaos.clear()
+        # the dead round's verdict was drained AND counted, not inherited
+        assert stats.CHKP_COUNTERS["violations"] == 1
+        good = dist.make_buffer(lambda p: np.full(8, 1.0), 8)
+        out = env.wait(dist.all_reduce(good, 8, DataType.FLOAT,
+                                       ReductionType.SUM, GroupType.DATA))
+        np.testing.assert_allclose(dist.local_part(out, 0), np.full(8, 8.0))
+        assert stats.CHKP_COUNTERS["violations"] == 1  # no stale re-raise
+
+    def test_checker_validates_bucket_members(self, monkeypatch):
+        """CHKP through the bucket pack: a member buffer that violates its
+        own descriptor is rejected AT REGISTRATION (named per member), not
+        blended into the coalesced concatenation."""
+        from mlsl_tpu.core.environment import Environment
+        from mlsl_tpu.log import MLSLError
+        from mlsl_tpu.models.mlp import LAYERS, get_layer, init, loss_fn
+        from mlsl_tpu.models.train import DataParallelTrainer
+
+        monkeypatch.setenv("MLSL_GRAD_BUCKET_MB", "1")
+        import jax as _jax
+
+        env = Environment.get_env().init()  # bucketing knob read at init
+        dist = env.create_distribution(8, 1)
+        sess = env.create_session()
+        sess.set_global_minibatch_size(16)
+        trainer = DataParallelTrainer(
+            env, dist, sess, init(_jax.random.PRNGKey(0)), loss_fn, LAYERS,
+            get_layer, lr=0.1,
+        )
+        ps = trainer.ops[LAYERS[0]].get_parameter_set(0)
+        assert ps.bucket is not None, "bucketing must be armed for this test"
+        monkeypatch.setenv("MLSL_CHKP", "1")
+        bad = dist.make_buffer(lambda p: np.zeros(4, np.float32), 4)  # short
+        with pytest.raises(MLSLError, match="OUT_OF_RANGE"):
+            ps.start_gradient_comm(bad)
 
 
 class TestCheckpoint:

@@ -43,6 +43,10 @@ def _make_factory(cfg: str = "plain"):
             kw["compression"] = CompressionType.QUANTIZATION
         elif cfg == "overlap":
             kw["overlap_updates"] = True
+        elif cfg == "adam":
+            import optax
+
+            kw["optimizer"] = optax.adam(1e-3)
         return DataParallelTrainer(
             env, dist, sess, init(jax.random.PRNGKey(0)), loss_fn, LAYERS,
             get_layer, lr=0.1, **kw,
@@ -137,6 +141,16 @@ SITE_CONFIGS = {
     "checkpoint.save": ("plain", 3),
     "checkpoint.restore": ("plain", 3),
     "data.prefetch": ("plain", 3),
+    # the ISSUE 9 trainer-state sites: an ERROR plan raises at step entry /
+    # the gradient boundary like any other site (recovered here); their
+    # 'silent' kind — corruption without raising — is exercised by
+    # tests/test_sentinel.py and the silent soak in tests/test_soak.py
+    "train.params": ("plain", 3),
+    # the opt_state site is only consulted when the trainer CARRIES state
+    # (a stateless SGD trainer must not burn a plan's budget corrupting
+    # nothing), so its matrix row needs the optax config
+    "train.opt_state": ("adam", 3),
+    "train.grads": ("plain", 3),
 }
 
 

@@ -82,12 +82,18 @@ def test_compat_watchdog_on_divergent_ranks(compat_binary):
     assert "0:1/0" in run.stderr  # rank 0 started, nobody else arrived
 
 
+@pytest.mark.slow
 def test_compat_watchdog_rearms_for_slow_collective(compat_binary):
     """A slow-but-healthy collective (all ranks joined, executor inside the
     transport past the deadline) must NOT be misdiagnosed as divergence: the
     watchdog re-arms for the waiting ranks and the result stays exact. The
     regression this guards: a 1s watchdog against a multi-second 32M-element
-    allreduce used to spuriously abort every rank in Wait."""
+    allreduce used to spuriously abort every rank in Wait.
+
+    Slow-marked for the tier-1 driver budget: the 32M-element allreduce is
+    ~45s on the CPU mesh and load-sensitive (the deliberately-tight 1s
+    watchdog misfires under contention); the divergence-side watchdog test
+    above keeps the compat watchdog in tier-1."""
     env = dict(os.environ)
     env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
     env["MLSL_TPU_PLATFORM"] = "cpu"

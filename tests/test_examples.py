@@ -32,7 +32,11 @@ def test_mlsl_example_runs():
     assert "global allreduce: [36. 36. 36. 36.]" in r.stdout
 
 
+@pytest.mark.slow
 def test_train_transformer_example_runs():
+    # the single heaviest tier-1 test (~7 min of subprocess transformer
+    # training on the CPU mesh): slow-marked for the driver time budget;
+    # the other five example tests keep the example surface in tier-1
     r = _run_example("train_transformer.py")
     assert r.returncode == 0, r.stderr[-2000:]
     assert "transformer example OK" in r.stdout

@@ -157,8 +157,14 @@ def _gloo_transport_race(procs, outs):
     return ("gloo" in text and "preamble" in text) or "heartbeat timeout" in text
 
 
+@pytest.mark.slow
 @pytest.mark.filterwarnings("ignore")
 def test_two_process_world(tmp_path):
+    # slow-marked for the tier-1 driver budget (~70s per attempt, and the
+    # pre-existing gloo preamble race can burn all 3 retries under load —
+    # KNOWN_FAILURES.md): it joins the multiprocess_e2e matrix in the
+    # standalone slow suite, which was already the home of every other
+    # multi-process test
     for attempt in range(3):
         procs, outs = _run_world(tmp_path, attempt)
         if all(p.returncode == 0 for p in procs):
