@@ -144,10 +144,14 @@ def main():
     # itself timed on the chip, not just on the CPU mesh.
     sess_pl = env.create_session()
     sess_pl.set_global_minibatch_size(batch)
+    # overlap_compiled=False EXPLICITLY: this row is the HOST Start/Wait
+    # engine by definition — an exported MLSL_OVERLAP_COMPILED=1 must not
+    # silently reroute it through the compiled engine and collapse the
+    # host-vs-compiled comparison into compiled-vs-compiled.
     trainer_pl = DataParallelTrainer(
         env, dist, sess_pl, params,
         resnet.loss_fn, resnet.layer_names(params), resnet.layer_subtree,
-        lr=0.05, force_graph_path=True,
+        lr=0.05, force_graph_path=True, overlap_compiled=False,
     )
 
     def run_pl(n):
@@ -155,12 +159,38 @@ def main():
             trainer_pl.step(fw_batch)
         _sync(trainer_pl.params)
 
+    # Compiled overlap engine (comm/overlap.py): the same per-layer schedule
+    # as trainer_pl but emitted IN-GRAPH as one single-dispatch program —
+    # per_layer_compiled_ms / compiled_vs_fused track whether moving the comm
+    # schedule into the compiled program beats the host Start/Wait loop
+    # (BENCH_r05's per_layer_vs_fused: 1.0 is the number this exists to move).
+    trainer_cmp = None
+    try:
+        sess_cmp = env.create_session()
+        sess_cmp.set_global_minibatch_size(batch)
+        trainer_cmp = DataParallelTrainer(
+            env, dist, sess_cmp, params,
+            resnet.loss_fn, resnet.layer_names(params), resnet.layer_subtree,
+            lr=0.05, overlap_compiled=True, force_graph_path=True,
+        )
+        if trainer_cmp._overlap is None:
+            trainer_cmp = None
+    except Exception as e:
+        print(f"bench: compiled overlap trainer skipped ({e})", file=sys.stderr)
+
+    def run_cmp(n):
+        for _ in range(n):
+            trainer_cmp.step(fw_batch)
+        _sync(trainer_cmp.params)
+
     # warm up all compiled programs, then measure in ALTERNATING blocks so slow
     # machine/tunnel drift hits all sides equally; medians of per-block means.
     try:
         run_fw(args.warmup)
         run_raw(args.warmup)
         run_pl(args.warmup)
+        if trainer_cmp is not None:
+            run_cmp(args.warmup)
     except Exception as e:
         if not args.quick and batch > 32 and _is_oom(e):
             half = batch // 2
@@ -175,7 +205,7 @@ def main():
     # blocks + medians keep a bad draw from skewing any one side.
     n_blocks = min(9, max(1, args.iters))
     per_block = args.iters // n_blocks  # >= 1; at most n_blocks-1 iters truncated
-    fw_blocks, raw_blocks, pl_blocks = [], [], []
+    fw_blocks, raw_blocks, pl_blocks, cmp_blocks = [], [], [], []
     for _ in range(n_blocks):
         t0 = time.perf_counter()
         run_fw(per_block)
@@ -186,9 +216,14 @@ def main():
         t0 = time.perf_counter()
         run_pl(per_block)
         pl_blocks.append((time.perf_counter() - t0) / per_block * 1e3)
+        if trainer_cmp is not None:
+            t0 = time.perf_counter()
+            run_cmp(per_block)
+            cmp_blocks.append((time.perf_counter() - t0) / per_block * 1e3)
     fw_ms = statistics.median(fw_blocks)
     raw_ms = statistics.median(raw_blocks)
     pl_ms = statistics.median(pl_blocks)
+    cmp_ms = statistics.median(cmp_blocks) if cmp_blocks else None
     # The shared tunnel drifts across minutes; the fastest block is the best
     # estimate of the chip's capability (ratios still come from medians of
     # adjacent blocks, which drift cannot skew).
@@ -202,6 +237,7 @@ def main():
     # the timed loop measures compute + decode, not the tunnel.
     pipe_ms = h2d_mbps = None
     input_stall_ms = wire_mb_per_batch = feed_cache_hits = None
+    feed_cache_state = None
     loader = None
     try:
         from mlsl_tpu.core import stats as core_stats
@@ -243,6 +279,22 @@ def main():
             f1["wire_bytes"] / 1e6 / max(int(f1["batches_staged"]), 1)
         )
         feed_cache_hits = int(f1["cache_hits"] - f0["cache_hits"])
+        # Self-describing cache state for the pipeline row: a steady-state
+        # (warm-cache) number and a cold staging number differ by the whole
+        # h2d wire cost, and BENCH_r05's pipeline_step_ms predates the feed
+        # cache entirely — a comparison that doesn't name the state is
+        # meaningless (BASELINE.md 'Stale pipeline rows').
+        staged = int(f1["batches_staged"])
+        feed_cache_state = (
+            f"warm(hits={feed_cache_hits},staged={staged})"
+            if feed_cache_hits else f"cold(staged={staged})"
+        )
+        if args.quick:
+            print(
+                f"bench: pipeline row: pipeline_step_ms="
+                f"{pipe_ms:.3f} feed_cache={feed_cache_state}",
+                file=sys.stderr,
+            )
     except Exception as e:
         print(f"bench: pipeline measurement skipped ({e})", file=sys.stderr)
     finally:
@@ -281,7 +333,7 @@ def main():
     # previously emitted null), so the per-layer overlap trajectory is instead
     # tracked on the 8-device CPU proof mesh in a subprocess — same per-layer
     # Start/Test engine, tagged with overlap_backend so rows stay comparable.
-    overlap = overlap_backend = None
+    overlap = overlap_backend = overlap_iso = None
     try:
         st = sess_pl.get_stats()
         if not st._isolation_slot_ns:  # MLSL_STATS=1 already replayed at commit
@@ -292,13 +344,28 @@ def main():
             trainer_pl.step(fw_batch)
         _sync(trainer_pl.params)
         st.stop()
-        overlap = st.get_overlap_fraction()
-        if overlap is not None:
-            overlap_backend = "device"
+        # isolation-replay overlap (the PR 2 methodology): reported as its
+        # own field when the chip's comm groups are live — the method chain
+        # below owns the headline overlap_fraction + its method tag
+        overlap_iso = st.get_overlap_fraction()
         st.print_()
     except Exception as e:
         print(f"bench: overlap report skipped ({e})", file=sys.stderr)
+    # Method chain for the headline number — the tag ALWAYS names the method
+    # (a null pair let the BENCH_r05 overlap regression pass unnoticed):
+    #   device-trace:      span-derived estimate from THIS device's obs
+    #                      wait/dispatch spans (needs live gradient requests)
+    #   subprocess-probe:  the 8-dev CPU proof-mesh per-layer schedule
+    #   skipped:<reason>:  nothing could produce a number, and why
+    try:
+        overlap, trace_reason = _overlap_from_trace(trainer_pl, fw_batch, _sync)
+        if overlap is not None:
+            overlap_backend = "device-trace"
+    except Exception as e:
+        trace_reason = repr(e)[:120]
     if overlap is None:
+        print(f"bench: device-trace overlap unavailable ({trace_reason}); "
+              f"falling back to the subprocess probe", file=sys.stderr)
         overlap, overlap_backend = _overlap_probe_cpu_mesh()
 
     # Achieved TFLOP/s and MFU for the framework step. FLOPs come from XLA's own
@@ -341,8 +408,13 @@ def main():
         "best_ms": round(fw_best, 3),
         "per_layer_ms": round(pl_ms, 3),
         "per_layer_vs_fused": round(fw_ms / pl_ms, 4),
+        "per_layer_compiled_ms": round(cmp_ms, 3) if cmp_ms else None,
+        "compiled_vs_fused": round(fw_ms / cmp_ms, 4) if cmp_ms else None,
         "overlap_fraction": round(overlap, 4) if overlap is not None else None,
         "overlap_backend": overlap_backend,
+        "overlap_fraction_isolation": (
+            round(overlap_iso, 4) if overlap_iso is not None else None
+        ),
         "batch": batch,
         "pipeline_step_ms": round(pipe_ms, 3) if pipe_ms is not None else None,
         "images_per_s": round(batch / (pipe_ms / 1e3)) if pipe_ms else None,
@@ -357,6 +429,7 @@ def main():
             else None
         ),
         "feed_cache_hits": feed_cache_hits,
+        "feed_cache_state": feed_cache_state,
         "h2d_mbps": round(h2d_mbps, 1) if h2d_mbps else None,
         "tflops": round(tflops, 3) if tflops else None,
         "mfu": round(mfu, 4) if mfu else None,
@@ -371,6 +444,51 @@ def main():
     print(json.dumps(result))
     if not args.quick:  # --quick CPU runs are smoke tests, not evidence
         _persist_measurement(result)
+
+
+def _overlap_from_trace(trainer, batch, sync, steps: int = 3):
+    """-> (overlap fraction or None, reason when None). Device-derived
+    overlap estimate from the obs span tracer: run ``steps`` per-layer steps
+    with tracing armed and report the mean of
+    ``1 - exposed_wait / comm_window`` per step, where exposed_wait is the
+    host time blocked inside request wait spans and comm_window spans the
+    first request submit to the last wait end (perfectly hidden comm -> wait
+    spans ~0 -> fraction ~1; fully exposed comm -> waits fill the window ->
+    ~0). Needs live gradient requests: a degenerate single-chip comm group
+    emits no wait/dispatch spans, and the caller falls back to the
+    subprocess probe."""
+    from mlsl_tpu.obs import tracer as obs_tr
+
+    pre_enabled = obs_tr.enabled()
+    tr = obs_tr.get_tracer() or obs_tr.enable()
+    fracs = []
+    try:
+        for _ in range(steps):
+            # select this step's events by timestamp — never clear() a
+            # tracer the user armed (MLSL_TRACE=1): the shared ring holds
+            # their whole capture, and the flight-recorder window must
+            # survive this probe
+            t_mark = tr.now()
+            trainer.step(batch)
+            sync(trainer.params)
+            evs = [ev for ev in tr.snapshot() if ev[3] >= t_mark]
+            waits = [(ev[3], ev[4]) for ev in evs
+                     if ev[0] == "X" and ev[1] == "wait" and ev[2] == "req"]
+            submits = [ev[3] for ev in evs
+                       if ev[0] == "i" and ev[1] == "submit"]
+            if not waits or not submits:
+                return None, "no request spans (degenerate comm group)"
+            window = max(ts + d for ts, d in waits) - min(submits)
+            if window <= 0:
+                continue
+            exposed = sum(d for _, d in waits)
+            fracs.append(max(0.0, min(1.0, 1.0 - exposed / window)))
+    finally:
+        if not pre_enabled:
+            obs_tr.disable()
+    if not fracs:
+        return None, "no usable comm windows"
+    return sum(fracs) / len(fracs), None
 
 
 _OVERLAP_PROBE_SRC = """\
@@ -453,6 +571,10 @@ def _overlap_probe_cpu_mesh(timeout: float = 600.0, attempts: int = 2):
     for k in ("MLSL_FEED_WIRE_DTYPE", "MLSL_FEED_CACHE_MB",
               "MLSL_FEED_DEPTH"):
         env_vars.pop(k, None)
+    # the probe measures the HOST per-layer schedule: a chip-armed compiled
+    # overlap engine would reroute its trainer through the in-graph path
+    for k in ("MLSL_OVERLAP_COMPILED", "MLSL_OVERLAP_STAGES"):
+        env_vars.pop(k, None)
     reason = "unknown"
     for attempt in range(attempts):
         try:
@@ -465,7 +587,7 @@ def _overlap_probe_cpu_mesh(timeout: float = 600.0, attempts: int = 2):
                 if line.startswith("OVERLAP="):
                     v = json.loads(line[len("OVERLAP="):])
                     if v is not None:
-                        return float(v), "cpu-mesh-proof"
+                        return float(v), "subprocess-probe"
             tail = (out.stderr or "").strip().splitlines()
             reason = (f"no-number rc={out.returncode}"
                       + (f" {tail[-1][:120]}" if tail else ""))

@@ -114,6 +114,8 @@ def main():
              [sys.executable, "benchmarks/bucketing_bench.py"], 1200),
             ("quant_bucket",
              [sys.executable, "benchmarks/quant_bucket_bench.py"], 1800),
+            ("overlap_compiled",
+             [sys.executable, "benchmarks/overlap_compiled_bench.py"], 1800),
             ("trace_overhead",
              [sys.executable, "benchmarks/trace_overhead_bench.py"], 900),
             ("input_pipeline",

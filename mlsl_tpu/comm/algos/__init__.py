@@ -227,6 +227,78 @@ def _breaker_gate(name: str, kind: str) -> str:
     return name
 
 
+def inline_eligible(algo: str, kind: str, group: ProcessGroup, op=None) -> bool:
+    """Can ``algo`` be embedded IN-GRAPH (compiled overlap, comm/overlap.py)
+    for (kind, group, op)? A strict subset of ``eligible``: the in-graph
+    phase builders ride the group's own mesh axes, and a color group's axes
+    are ``()`` (core/distribution.py builds them over the flat mesh), so NO
+    algorithm — the baseline included — can reduce one in-graph: a psum
+    over zero axes would be a silent identity, not a per-color reduction.
+    Color-group graphs ride the host path (the standalone flat-mesh
+    programs); only degenerate (size-1) color groups pass, where the
+    identity IS the reduction."""
+    if group.colors is not None and int(group.size) > 1:
+        return False
+    return eligible(algo, kind, group, op)
+
+
+def inline_plan(kind: str, group: ProcessGroup, algo: str, count: int, *,
+                op=None, recv_count=None):
+    """The in-graph (compiled-overlap) form of ``algo``: ``(prep, phases,
+    finish)`` closures usable inside a shard_map body over the group's own
+    topology mesh — ``prep(x, mypos) -> carry``, each ``phases[i](carry) ->
+    carry`` is one collective phase (the unit the overlap scheduler
+    interleaves between layers), ``finish(carry) -> result``. ``mypos`` must
+    be the member's flattened group position (collectives._group_rank over
+    the group axes); ``count`` is the static per-member element count.
+
+    ``lax`` is the single-phase baseline (the exact ``_body_allreduce`` /
+    ``_body_reduce_scatter`` ops); ``rhd``/``ring2d`` expose the same phase
+    sequences their standalone ``build`` programs compile — one ppermute
+    round / one ring phase per entry, bit-for-bit the same math.
+    """
+    from mlsl_tpu.comm import collectives
+    from mlsl_tpu.types import ReductionType
+
+    mlsl_assert(
+        inline_eligible(algo, kind, group, op),
+        "algorithm %s cannot lower %s in-graph on group shape %s",
+        algo, kind, group_shape(group),
+    )
+    rop = ReductionType(op) if op is not None else ReductionType.SUM
+    if group.is_self or group.size <= 1:
+        # degenerate group: every reduction is the identity (the compiled
+        # per-layer schedule is still measurable — bench.py's single-chip row)
+        if kind == "reduce_scatter" and recv_count is not None:
+            return (lambda x, mypos: (x, mypos), [],
+                    lambda carry: carry[0][:recv_count])
+        return lambda x, mypos: (x, mypos), [], lambda carry: carry[0]
+    if algo == DEFAULT:
+        sizes = collectives._axis_sizes(group.topology.mesh)
+
+        def lax_phase(carry):
+            cur, mypos = carry
+            if kind == "reduce_scatter":
+                return collectives._body_reduce_scatter(
+                    cur, axes=group.axes, sizes=sizes, op=rop,
+                    recv_count=recv_count,
+                ), mypos
+            return collectives._preduce(cur, group.axes, rop), mypos
+
+        return lambda x, mypos: (x, mypos), [lax_phase], lambda carry: carry[0]
+    if algo == "rhd":
+        from mlsl_tpu.comm.algos import rhd
+
+        ax = group.axes if len(group.axes) > 1 else group.axes[0]
+        return rhd.steps(
+            kind, int(group.size), count, ax, lambda pairs: pairs,
+            op=rop, recv_count=recv_count,
+        )
+    from mlsl_tpu.comm.algos import ring2d
+
+    return ring2d.steps(kind, group, count, op=rop, recv_count=recv_count)
+
+
 def build(kind: str, group: ProcessGroup, dtype, algo: str, **kw) -> Callable:
     """Build (or fetch) the compiled program for ``algo``: global distributed
     buffer -> global result buffer, the exact calling convention of

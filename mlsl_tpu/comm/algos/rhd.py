@@ -22,11 +22,19 @@ Non-power-of-two remainder (the classic pre/post fold):
     extras. reduce_scatter on non-2^k groups (or ragged counts) takes the
     fold + core + doubling path and slices each member's chunk from the full
     result — correct everywhere, wire-optimal only in the 2^k case.
+
+The schedule is exposed in two forms sharing one implementation (``steps``):
+``build`` compiles it standalone over the flat world mesh (the host-dispatch
+engine program, comm/algos), and the compiled overlap engine
+(comm/overlap.py) embeds the same phase sequence IN-GRAPH over the group's
+own mesh axes — each phase is exactly one ppermute round, so the overlap
+scheduler can interleave a layer's rounds between other layers' work and
+XLA's latency-hiding scheduler sees the full comm schedule.
 """
 
 from __future__ import annotations
 
-from typing import Callable
+from typing import Callable, List, Tuple
 
 import jax.numpy as jnp
 from jax import lax
@@ -53,74 +61,148 @@ def _combine(op: ReductionType):
     return lambda a, b: a + b
 
 
+def steps(
+    kind: str,
+    G: int,
+    n: int,
+    ax,
+    pair_map: Callable[[list], list],
+    *,
+    op=None,
+    recv_count=None,
+) -> Tuple[Callable, List[Callable], Callable]:
+    """The staged RHD schedule: ``(prep, phases, finish)``.
+
+    ``prep(x, mypos) -> carry``; each ``phases[i](carry) -> carry`` performs
+    exactly ONE ppermute round (the unit the compiled overlap engine
+    interleaves); ``finish(carry) -> result``. ``ax`` is the mesh axis (or
+    axis tuple) the ppermute rides; ``mypos`` is this member's group
+    position as a traced value; ``pair_map`` expands group-position pairs
+    into the mesh pair list — identity when ``ax`` spans exactly the group
+    (positions ARE the linearized indices over the axis tuple), or the
+    world-row expansion ``build`` uses for the standalone flat-mesh program.
+    ``n`` is the static per-member element count.
+    """
+    op = ReductionType(op) if op is not None else ReductionType.SUM
+    comb = _combine(op)
+    k = G.bit_length() - 1
+    c = 1 << k            # largest power of two <= G
+    r = G - c             # remainder members folded in pre/post phases
+    m = -(-n // c) * c
+    round_pairs = [
+        pair_map([(i, i ^ (c >> (t + 1))) for i in range(c)])
+        for t in range(k)
+    ]
+
+    def prep(x, mypos):
+        # pad lanes only ever combine with other members' pad lanes (same
+        # positions), so zeros are safe for MIN/MAX too — they are stripped
+        # before return.
+        cur = jnp.pad(x, (0, m - n)) if m != n else x
+        return (cur, mypos)
+
+    phases: List[Callable] = []
+
+    if r:
+        pre_pairs = pair_map([(c + j, j) for j in range(r)])
+
+        def pre_fold(carry):
+            cur, mypos = carry
+            got = lax.ppermute(cur, ax, pre_pairs)
+            return jnp.where(mypos < r, comb(cur, got), cur), mypos
+
+        phases.append(pre_fold)
+
+    def halving(t):
+        def phase(carry):
+            cur, mypos = carry
+            h = m >> (t + 1)
+            lo, hi = cur[:h], cur[h:]
+            bit = (mypos >> (k - 1 - t)) & 1
+            send = jnp.where(bit == 0, hi, lo)
+            got = lax.ppermute(send, ax, round_pairs[t])
+            return comb(jnp.where(bit == 0, lo, hi), got), mypos
+
+        return phase
+
+    phases.extend(halving(t) for t in range(k))
+    # after halving: cur = member mypos's fully reduced chunk
+    # [mypos*m/c, (mypos+1)*m/c)
+
+    if (kind == "reduce_scatter" and G == c and recv_count is not None
+            and n == G * recv_count):
+        # exact-placement fast exit when the chunking lines up: member pos's
+        # halving chunk IS its MPI slice — no doubling phase needed
+        return prep, phases, lambda carry: carry[0][:recv_count]
+
+    def doubling(t):
+        def phase(carry):
+            cur, mypos = carry
+            bit = (mypos >> (k - 1 - t)) & 1
+            got = lax.ppermute(cur, ax, round_pairs[t])
+            return (
+                jnp.where(
+                    bit == 0,
+                    jnp.concatenate([cur, got]),
+                    jnp.concatenate([got, cur]),
+                ),
+                mypos,
+            )
+
+        return phase
+
+    phases.extend(doubling(t) for t in reversed(range(k)))
+
+    if r:
+        post_pairs = pair_map([(j, c + j) for j in range(r)])
+
+        def post_fold(carry):
+            cur, mypos = carry
+            got = lax.ppermute(cur, ax, post_pairs)
+            return jnp.where(mypos >= c, got, cur), mypos
+
+        phases.append(post_fold)
+
+    if kind == "reduce_scatter":
+        mlsl_assert(
+            recv_count is not None,
+            "rhd reduce_scatter needs recv_count",
+        )
+
+        def finish_rs(carry):
+            cur, mypos = carry
+            return lax.dynamic_slice_in_dim(
+                cur, mypos * recv_count, recv_count, axis=0
+            )
+
+        return prep, phases, finish_rs
+
+    return prep, phases, lambda carry: carry[0][:n]
+
+
 def build(kind: str, group: ProcessGroup, *, op=None, recv_count=None,
           **_) -> Callable:
     """Compile the RHD program for ``kind`` over ``group``: global distributed
     buffer -> global result buffer (same convention as build_collective)."""
     from mlsl_tpu.comm import collectives
 
-    op = ReductionType(op) if op is not None else ReductionType.SUM
     rows = _member_rows(group)
     G = len(rows[0])
     mlsl_assert(G > 1, "rhd needs a group with >1 member (got %d)", G)
-    comb = _combine(op)
     pos_t = jnp.asarray(collectives._subgroup_tables(rows))
 
-    k = G.bit_length() - 1
-    c = 1 << k            # largest power of two <= G
-    r = G - c             # remainder members folded in pre/post phases
-    pre_pairs = [(row[c + j], row[j]) for row in rows for j in range(r)]
-    post_pairs = [(row[j], row[c + j]) for row in rows for j in range(r)]
-    round_pairs = [
-        [(row[i], row[i ^ (c >> (t + 1))]) for row in rows for i in range(c)]
-        for t in range(k)
-    ]
+    def pair_map(pairs):
+        return [(row[s], row[d]) for row in rows for s, d in pairs]
 
     def body(x):
-        n = x.shape[0]
         mypos = jnp.take(pos_t, lax.axis_index("world"))
-        m = -(-n // c) * c
-        cur = jnp.pad(x, (0, m - n)) if m != n else x
-        # pad lanes only ever combine with other members' pad lanes (same
-        # positions), so zeros are safe for MIN/MAX too — they are stripped
-        # before return.
-        if r:
-            got = lax.ppermute(cur, "world", pre_pairs)
-            cur = jnp.where(mypos < r, comb(cur, got), cur)
-        # --- recursive halving: log2(c) rounds, payload halves each round ---
-        for t in range(k):
-            h = m >> (t + 1)
-            lo, hi = cur[:h], cur[h:]
-            bit = (mypos >> (k - 1 - t)) & 1
-            send = jnp.where(bit == 0, hi, lo)
-            got = lax.ppermute(send, "world", round_pairs[t])
-            cur = comb(jnp.where(bit == 0, lo, hi), got)
-        # cur = member mypos's fully reduced chunk [mypos*m/c, (mypos+1)*m/c)
-        if (kind == "reduce_scatter" and G == c and recv_count is not None
-                and n == G * recv_count):
-            # exact-placement fast exit when the chunking lines up: member
-            # pos's halving chunk IS its MPI slice — no doubling phase needed
-            return cur[:recv_count]
-        # --- recursive doubling: payload doubles back to the full vector ---
-        for t in reversed(range(k)):
-            bit = (mypos >> (k - 1 - t)) & 1
-            got = lax.ppermute(cur, "world", round_pairs[t])
-            cur = jnp.where(
-                bit == 0,
-                jnp.concatenate([cur, got]),
-                jnp.concatenate([got, cur]),
-            )
-        if r:
-            got = lax.ppermute(cur, "world", post_pairs)
-            cur = jnp.where(mypos >= c, got, cur)
-        if kind == "reduce_scatter":
-            mlsl_assert(
-                recv_count is not None,
-                "rhd reduce_scatter needs recv_count",
-            )
-            return lax.dynamic_slice_in_dim(
-                cur, mypos * recv_count, recv_count, axis=0
-            )
-        return cur[:n]
+        prep, phases, finish = steps(
+            kind, G, x.shape[0], "world", pair_map,
+            op=op, recv_count=recv_count,
+        )
+        carry = prep(x, mypos)
+        for phase in phases:
+            carry = phase(carry)
+        return finish(carry)
 
     return collectives._build_flat(body, group.topology, kind, "rhd")

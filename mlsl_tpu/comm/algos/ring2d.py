@@ -21,11 +21,16 @@ Wire per member: n + n/|minor| vs the fused reduction's n per axis — the win
 grows with the torus dimension, which is why 2D/3D-torus allreduce
 implementations (and EQuARX inside XLA) decompose exactly this way.
 SUM only: the scatter phases are ``lax.psum_scatter``.
+
+Like rhd, the schedule is exposed as staged ``steps`` (one collective phase
+per entry) shared by the standalone ``build`` program and the compiled
+overlap engine (comm/overlap.py), which embeds the phases in-graph between
+other layers' work.
 """
 
 from __future__ import annotations
 
-from typing import Callable
+from typing import Callable, List, Tuple
 
 import jax.numpy as jnp
 from jax import lax
@@ -34,14 +39,29 @@ from mlsl_tpu.comm.mesh import ProcessGroup
 from mlsl_tpu.log import mlsl_assert
 
 
-def build(kind: str, group: ProcessGroup, *, op=None, recv_count=None,
-          **_) -> Callable:
+def _live_axes(group: ProcessGroup):
     from mlsl_tpu.comm import collectives
 
-    mesh = group.topology.mesh
-    sizes = collectives._axis_sizes(mesh)
+    sizes = collectives._axis_sizes(group.topology.mesh)
     axes = tuple(group.axes)
-    live = [a for a in axes if sizes[a] > 1]
+    return axes, [a for a in axes if sizes[a] > 1], sizes
+
+
+def steps(
+    kind: str,
+    group: ProcessGroup,
+    n: int,
+    *,
+    op=None,
+    recv_count=None,
+) -> Tuple[Callable, List[Callable], Callable]:
+    """The staged ring-of-rings schedule: ``(prep, phases, finish)``, each
+    phase exactly one collective over ONE named mesh axis set. Bodies run
+    inside a shard_map over the group's own (grid) mesh — shared by
+    ``build`` and the compiled overlap engine. ``prep(x, mypos)``/``finish``
+    take/return the same carry convention as rhd.steps (mypos rides along
+    unused: ring2d placement is axis-index-native)."""
+    axes, live, sizes = _live_axes(group)
     mlsl_assert(
         len(live) >= 2,
         "ring2d needs a group spanning >= 2 non-degenerate mesh axes "
@@ -54,36 +74,74 @@ def build(kind: str, group: ProcessGroup, *, op=None, recv_count=None,
         mlsl_assert(len(live) == 2, "ring2d reduce_scatter is 2D only")
         a0, a1 = live
         A0, A1 = sizes[a0], sizes[a1]
+        mlsl_assert(
+            recv_count is not None and n == A0 * A1 * recv_count,
+            "ring2d reduce_scatter needs count == G*recv_count "
+            "(count %d, G %d, recv_count %s)", n, A0 * A1, recv_count,
+        )
 
-        def body(x):
-            n = x.shape[0]
-            mlsl_assert(
-                recv_count is not None and n == A0 * A1 * recv_count,
-                "ring2d reduce_scatter needs count == G*recv_count "
-                "(count %d, G %d, recv_count %s)", n, A0 * A1, recv_count,
-            )
+        def prep_rs(x, mypos):
             # a1-major chunk order so the two scatters land group chunk
             # i0*A1 + i1 on member (i0, i1) — a local relabeling, no wire
             xr = jnp.transpose(
                 x.reshape(A0, A1, recv_count), (1, 0, 2)
             ).reshape(-1)
-            slab = lax.psum_scatter(xr, a1, scatter_dimension=0, tiled=True)
-            return lax.psum_scatter(slab, a0, scatter_dimension=0, tiled=True)
+            return (xr, mypos)
 
-        return collectives._build_axis(body, mesh, kind, "ring2d")
+        def rs_a1(carry):
+            cur, mypos = carry
+            return lax.psum_scatter(
+                cur, a1, scatter_dimension=0, tiled=True
+            ), mypos
+
+        def rs_a0(carry):
+            cur, mypos = carry
+            return lax.psum_scatter(
+                cur, a0, scatter_dimension=0, tiled=True
+            ), mypos
+
+        return prep_rs, [rs_a1, rs_a0], lambda carry: carry[0]
 
     minor = live[-1]
     rest = tuple(a for a in axes if a != minor)
     A_minor = sizes[minor]
+    m = -(-n // A_minor) * A_minor
+
+    def prep(x, mypos):
+        xp = jnp.pad(x, (0, m - n)) if m != n else x
+        return (xp, mypos)
+
+    def rs_minor(carry):
+        cur, mypos = carry
+        return lax.psum_scatter(
+            cur, minor, scatter_dimension=0, tiled=True
+        ), mypos
+
+    def reduce_rest(carry):
+        cur, mypos = carry
+        return lax.psum(cur, rest), mypos
+
+    def ag_minor(carry):
+        cur, mypos = carry
+        return lax.all_gather(cur, minor, axis=0, tiled=True), mypos
+
+    phases = [rs_minor] + ([reduce_rest] if rest else []) + [ag_minor]
+    return prep, phases, lambda carry: carry[0][:n]
+
+
+def build(kind: str, group: ProcessGroup, *, op=None, recv_count=None,
+          **_) -> Callable:
+    from mlsl_tpu.comm import collectives
+
+    mesh = group.topology.mesh
 
     def body(x):
-        n = x.shape[0]
-        m = -(-n // A_minor) * A_minor
-        xp = jnp.pad(x, (0, m - n)) if m != n else x
-        piece = lax.psum_scatter(xp, minor, scatter_dimension=0, tiled=True)
-        if rest:
-            piece = lax.psum(piece, rest)
-        out = lax.all_gather(piece, minor, axis=0, tiled=True)
-        return out[:n]
+        prep, phases, finish = steps(
+            kind, group, x.shape[0], op=op, recv_count=recv_count
+        )
+        carry = prep(x, jnp.int32(0))
+        for phase in phases:
+            carry = phase(carry)
+        return finish(carry)
 
     return collectives._build_axis(body, mesh, kind, "ring2d")

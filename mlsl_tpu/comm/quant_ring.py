@@ -176,26 +176,17 @@ def _ring_body(x, err, *, axis, G, rc, chunk, block, n_orig, mode, use_pallas):
 _cache: dict = {}
 
 
-def build_quantized_collective(
+def ring_geometry(
     kind: str, group: ProcessGroup, count: int, block: int
-) -> Tuple[Callable, int]:
-    """-> (compiled fn (buf, err) -> (result, new_err), error-feedback length).
-
-    ``kind``: 'allreduce' or 'reduce_scatter' (SUM only — the reference's quantized
-    path is likewise allreduce-SUM, eplib/cqueue.c:1977-1994; callers must reject
-    other ops).
-    Single-axis groups use the compressed ring; degenerate/multi-axis groups fall back
-    to entry-quantization + psum (same numerics contract, uncompressed wire).
-    """
-    from mlsl_tpu.comm.collectives import _group_key
-
-    topo = group.topology
-    mesh = topo.mesh
-    sizes = _axis_sizes(mesh)
+) -> Tuple[int, int, int, int, bool]:
+    """-> (g, rc, chunk, err_len, use_pallas): the quantized-ring layout for
+    (kind, group, count, block). The single source of the geometry both the
+    standalone compiled program (``build_quantized_collective``) and the
+    in-graph compiled-overlap body (``inline_body``) use — parity between
+    the two paths depends on identical rc/chunk placement."""
     g = 1 if group.is_self else group.size
     mlsl_assert(group.colors is None, "quantized collectives require axis-aligned groups")
     use_pallas = use_pallas_for(group, block)
-
     # Per-rank logical slice rc, padded to the block/tile unit -> ring chunk.
     if kind == "reduce_scatter":
         mlsl_assert(count % g == 0, "reduce_scatter count %d %% group %d != 0", count, g)
@@ -204,12 +195,21 @@ def build_quantized_collective(
         rc = -(-count // g)
     unit = _chunk_unit(rc, use_pallas, block)
     chunk = -(-rc // unit) * unit
-    err_len = g * chunk
+    return g, rc, chunk, g * chunk, use_pallas
 
-    key = (kind, _group_key(group), count, block)
-    fn = _cache.get(key)
-    if fn is not None:
-        return fn, err_len
+
+def inline_body(
+    kind: str, group: ProcessGroup, count: int, block: int
+) -> Tuple[Callable, int]:
+    """-> (local body ``(x, err) -> (result, new_err)``, error-feedback
+    length): the quantize -> ring -> dequantize round as an UN-compiled
+    shard_map body, for embedding in a larger program (the compiled overlap
+    engine's in-graph quantized units). Same body selection as
+    ``build_quantized_collective`` — single-axis groups ride the compressed
+    ring, degenerate/multi-axis groups the entry-quantization + psum
+    fallback — so the overlap path is op-for-op the host request's program."""
+    sizes = _axis_sizes(group.topology.mesh)
+    g, rc, chunk, err_len, use_pallas = ring_geometry(kind, group, count, block)
 
     if g > 1 and len(group.axes) == 1:
         body = functools.partial(
@@ -240,6 +240,32 @@ def build_quantized_collective(
             if kind == "reduce_scatter":
                 return red_chunks[0, :rc], new_err
             return red_chunks[:, :rc].reshape(-1)[:count], new_err
+
+    return body, err_len
+
+
+def build_quantized_collective(
+    kind: str, group: ProcessGroup, count: int, block: int
+) -> Tuple[Callable, int]:
+    """-> (compiled fn (buf, err) -> (result, new_err), error-feedback length).
+
+    ``kind``: 'allreduce' or 'reduce_scatter' (SUM only — the reference's quantized
+    path is likewise allreduce-SUM, eplib/cqueue.c:1977-1994; callers must reject
+    other ops).
+    Single-axis groups use the compressed ring; degenerate/multi-axis groups fall back
+    to entry-quantization + psum (same numerics contract, uncompressed wire).
+    """
+    from mlsl_tpu.comm.collectives import _group_key
+
+    mesh = group.topology.mesh
+    _, _, _, err_len, _ = ring_geometry(kind, group, count, block)
+
+    key = (kind, _group_key(group), count, block)
+    fn = _cache.get(key)
+    if fn is not None:
+        return fn, err_len
+
+    body, _ = inline_body(kind, group, count, block)
 
     from mlsl_tpu.comm.collectives import build_stateful_collective
 

@@ -866,6 +866,22 @@ class CommRequest:
         return False, None
 
 
+def in_graph_descriptor(kind: str, name: str, algo: str, count: int,
+                        data_type: DataType, group: ProcessGroup) -> str:
+    """One-line descriptor for an IN-GRAPH collective round (the compiled
+    overlap engine, comm/overlap.py). The rounds never construct a
+    CommRequest — the whole comm segment is one compiled program — but
+    stats/trace tooling reads ONE descriptor grammar, so this mirrors
+    CommRequest.describe() field-for-field with an ``in_graph=1`` marker in
+    place of the epoch (in-graph rounds have no per-round host state)."""
+    payload = count * dtype_size(data_type)
+    return (
+        f"{kind} name={name} algo={algo} count={count} "
+        f"dtype={data_type.name} axes={group.axes} "
+        f"payload={payload}B in_graph=1"
+    )
+
+
 def _unwrap_chaos(fn):
     """The compiled program beneath the chaos instrumentation (the wrappers'
     ``_mlsl_inner`` — the same jit object the dispatch path calls, so the

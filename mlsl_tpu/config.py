@@ -44,6 +44,7 @@ _ENV_FIELDS = {
     "MLSL_GRAD_BUCKET_MB": "grad_bucket_mb",
     "MLSL_NUM_SERVERS": "num_servers",
     "MLSL_QUANT_BLOCK_ELEMS": "quant_block_elems",
+    "MLSL_OVERLAP_STAGES": "overlap_stages",
     "MLSL_FEED_DEPTH": "feed_depth",
     "MLSL_FEED_CACHE_MB": "feed_cache_mb",
     "MLSL_FEED_WIRE_DTYPE": "feed_wire_dtype",
@@ -112,6 +113,18 @@ class Config:
     # Loaded tuner.TunedProfile (or None): consulted by comm/algos.select
     # for every engine collective. Set by Environment.init, never from env.
     tuned_profile: object = None
+
+    # --- compiled overlap engine (comm/overlap.py; docs/TUNING.md §14) ---
+    # Arm the single-dispatch compiled step: the backward pass decomposed
+    # per layer with every gradient collective emitted IN-GRAPH,
+    # newest-first, so XLA's latency-hiding scheduler overlaps ICI DMA with
+    # compute instead of the host per-layer poll loop. The host path stays
+    # the default and the parity oracle.
+    overlap_compiled: bool = False   # MLSL_OVERLAP_COMPILED
+    # Staging depth: a layer's reduce phases are spread over the next this-
+    # many unit starts (stage boundaries pinned with optimization_barrier).
+    # Tunable via a tuner profile (tuner.KNOB_RANGES); exported env wins.
+    overlap_stages: int = 2          # MLSL_OVERLAP_STAGES
 
     # --- device feed pipeline (mlsl_tpu.data; docs/TUNING.md §12) ---
     # Wire dtype for host->device batch transfer: '' = full width (off),
@@ -270,6 +283,10 @@ class Config:
             "MLSL_GRAD_BUCKET_MB must be >= 0 (got %d)", self.grad_bucket_mb,
         )
         mlsl_assert(
+            self.overlap_stages >= 1,
+            "MLSL_OVERLAP_STAGES must be >= 1 (got %d)", self.overlap_stages,
+        )
+        mlsl_assert(
             self.watchdog_timeout_s >= 0,
             "MLSL_WATCHDOG_TIMEOUT must be >= 0 (got %r)",
             self.watchdog_timeout_s,
@@ -385,6 +402,8 @@ class Config:
         c.feed_cache_mb = _env_int("MLSL_FEED_CACHE_MB", c.feed_cache_mb)
         c.feed_depth = _env_int("MLSL_FEED_DEPTH", c.feed_depth)
         c.feed_retries = _env_int("MLSL_FEED_RETRIES", c.feed_retries)
+        c.overlap_compiled = _env_bool("MLSL_OVERLAP_COMPILED", c.overlap_compiled)
+        c.overlap_stages = _env_int("MLSL_OVERLAP_STAGES", c.overlap_stages)
         c.quant_block_elems = _env_int("MLSL_QUANT_BLOCK_ELEMS", c.quant_block_elems)
         c.topk_ratio = _env_float("MLSL_TOPK_RATIO", c.topk_ratio)
         c.watchdog_timeout_s = _env_float("MLSL_WATCHDOG_TIMEOUT", c.watchdog_timeout_s)

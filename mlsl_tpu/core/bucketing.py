@@ -531,12 +531,13 @@ class GradBucket:
         return 2 + self.req.precompile()
 
 
-def _pack_by_size(pss: List, limit: int, size_of) -> List[List]:
+def pack_by_size(pss: List, limit: int, size_of) -> List[List]:
     """Greedy packing in reverse creation (= backward start) order; singleton
     groups are dropped (a 1-member bucket is pure overhead). ``size_of(ps)``
     is the member's WIRE contribution — full local gradient bytes, so an
     already-bandwidth-sized layer is excluded regardless of how its buffer is
-    chunked."""
+    chunked. Public: the compiled overlap engine (comm/overlap.py) reuses
+    this exact policy to coalesce its in-graph bucket units."""
     cur: List = []
     cur_bytes = 0
     groups: List[List] = []
@@ -628,7 +629,7 @@ def build_buckets(session, bucket_mb: int) -> int:
         # bounds the coalesced payload, not the compressed wire image)
         mult = g if kind == "reduce_scatter" else 1
         size_of = lambda ps: ps.owned_kernel_count * ps.kernel_size * esize * mult
-        for members in _pack_by_size(pss, limit_eff, size_of):
+        for members in pack_by_size(pss, limit_eff, size_of):
             bucket = GradBucket(
                 members, session.env, kind=kind, compression=compression
             )

@@ -335,6 +335,43 @@ def reset_algo_counters() -> None:
     ALGO_COUNTERS.clear()
 
 
+# Compiled-overlap engine accounting (comm/overlap.py): the in-graph rounds
+# never construct a CommRequest, so their attribution lands here (and, per
+# algorithm, in ALGO_COUNTERS — the ALGO line covers host AND in-graph
+# launches). Process-wide like the other dispatch-layer counters.
+OVERLAP_COUNTERS: Dict[str, int] = {
+    "steps": 0,          # compiled-overlap steps dispatched
+    "split_steps": 0,    # of which ran the two-program (sentinel-gated) split
+    "units": 0,          # in-graph reduction units dispatched (cumulative)
+    "rounds": 0,         # in-graph collective phases (ppermute rounds etc.)
+    "bytes": 0,          # logical gradient bytes reduced in-graph
+}
+
+
+def record_overlap_step(units: int, rounds: int, nbytes: int, *,
+                        split: bool = False,
+                        breakdown: Optional[Dict[Tuple[str, str], int]] = None
+                        ) -> None:
+    """One compiled-overlap step: bulk attribution for all of its in-graph
+    rounds (a handful of dict upserts per STEP, not per layer — the
+    dispatch-floor budget the engine exists to protect). ``breakdown`` maps
+    (kind, algo) -> unit count and feeds the shared ALGO table."""
+    OVERLAP_COUNTERS["steps"] += 1
+    if split:
+        OVERLAP_COUNTERS["split_steps"] += 1
+    OVERLAP_COUNTERS["units"] += units
+    OVERLAP_COUNTERS["rounds"] += rounds
+    OVERLAP_COUNTERS["bytes"] += nbytes
+    if breakdown:
+        for key, n in breakdown.items():
+            ALGO_COUNTERS[key] = ALGO_COUNTERS.get(key, 0) + n
+
+
+def reset_overlap_counters() -> None:
+    for k in OVERLAP_COUNTERS:
+        OVERLAP_COUNTERS[k] = 0
+
+
 #: jax monitoring event fired once per XLA backend compilation — the
 #: compile-count probe behind the MLSL_PRECOMPILE acceptance check.
 BACKEND_COMPILE_EVENT = "/jax/core/compile/backend_compile_duration"
@@ -721,6 +758,18 @@ class Statistics:
             ]
             lines.append(
                 f"{'ALGO':<16} {'DISPATCH':<8} " + " ".join(parts)
+            )
+        oc = OVERLAP_COUNTERS
+        if oc["steps"]:
+            # the compiled-overlap story: how many steps rode the in-graph
+            # schedule, how many of those split for the sentinel gate, and
+            # the in-graph round/byte volume — one grep ('OVERLAP ENGINE')
+            # answers "did the compiled path actually carry this run"
+            lines.append(
+                f"{'OVERLAP':<16} {'ENGINE':<8} "
+                f"steps {oc['steps']} (split {oc['split_steps']}) "
+                f"units {oc['units']} rounds {oc['rounds']} "
+                f"bytes {oc['bytes'] / 1e6:.1f} MB"
             )
         sc = SENTINEL_COUNTERS
         if any(sc.values()):

@@ -172,6 +172,24 @@ def build_owned_opt_increment_fn(mesh, optimizer, norm: float,
     return jax.jit(inc)
 
 
+def build_local_grads(loss_fn, layers, get_layer, padded):
+    """The local-gradient core shared by the host ``_grad_fn`` and the
+    compiled overlap engine's fused program: ``(params, x, y) -> (scalar
+    loss, {layer: padded flat grad})`` on already-squeezed local shards.
+    ONE implementation on purpose — the flatten/pad policy is what the
+    compiled-vs-host lockstep parity pins, so it must never diverge."""
+
+    def local_grads(params, x, y):
+        loss, grads = jax.value_and_grad(loss_fn)(params, (x, y))
+        flat = {}
+        for name in layers:
+            g = _flatten_layer(get_layer(grads, name))
+            flat[name] = jnp.pad(g, (0, padded[name] - g.shape[0]))
+        return loss, flat
+
+    return local_grads
+
+
 def _unflatten_like(tree, flat: jax.Array):
     leaves, treedef = jax.tree.flatten(tree)
     out, off = [], 0
@@ -212,6 +230,7 @@ class DataParallelTrainer:
         lr: float = 0.05,
         donate_params: bool = True,
         overlap_updates: bool = False,
+        overlap_compiled: Optional[bool] = None,
         force_graph_path: bool = False,
         optimizer=None,
         clip_global_norm: Optional[float] = None,
@@ -229,6 +248,20 @@ class DataParallelTrainer:
         cross-shard have dedicated implementations: pass
         mlsl_tpu.optim.ShardedAdafactor for factored-stats Adafactor under
         ZeRO-1, and clip_global_norm= (below) for global-norm clipping.
+
+        overlap_compiled: arm the compiled overlap engine (comm/overlap.py;
+        None = the MLSL_OVERLAP_COMPILED config default): ONE single-dispatch
+        donation-enabled step program with every layer's gradient collective
+        emitted in-graph, newest-first, staged over MLSL_OVERLAP_STAGES unit
+        starts — XLA's latency-hiding scheduler overlaps the comm instead of
+        the host Start/Wait loop. SGD only (an optax optimizer, ZeRO-1, or
+        overlap_updates impose their own schedules — asserted when requested
+        explicitly); TOPK/custom-codec/color-group graphs fall back to the
+        host path, which stays the default and the parity oracle
+        (tests/test_overlap_compiled.py). With the sentinel quality gate
+        armed the engine runs the two-program split (grad program + one
+        compiled comm/update program) so the gate keeps its host-side
+        gradient boundary.
 
         clip_global_norm: clip the (mean) gradient to this global L2 norm
         BEFORE the optimizer — on every path, including ZeRO-1, where the norm
@@ -387,6 +420,45 @@ class DataParallelTrainer:
             if self.overlap_updates
             else None
         )
+        # Compiled overlap engine (comm/overlap.py): the in-graph per-layer
+        # comm schedule. Explicitly requesting it alongside a mode that
+        # imposes its own schedule is a usage error; the env-armed default
+        # (MLSL_OVERLAP_COMPILED=1) silently skips those graphs instead, so
+        # one exported knob doesn't break unrelated trainers.
+        if overlap_compiled:
+            mlsl_assert(
+                optimizer is None,
+                "overlap_compiled is not supported with an optax optimizer "
+                "(per-layer fused updates would impose their own state "
+                "slicing)",
+            )
+            mlsl_assert(
+                not distributed_update,
+                "overlap_compiled is not supported with distributed_update "
+                "(the increment all-gather imposes its own schedule)",
+            )
+            mlsl_assert(
+                not overlap_updates,
+                "overlap_compiled replaces overlap_updates (the schedule "
+                "lives in the compiled program, not the host poll loop)",
+            )
+        want_overlap = (
+            overlap_compiled if overlap_compiled is not None
+            else bool(cfg is not None and cfg.overlap_compiled)
+        )
+        self._overlap = None
+        if (
+            want_overlap
+            and optimizer is None
+            and not distributed_update
+            and not overlap_updates
+            and self._fused_fn is None
+        ):
+            from mlsl_tpu.comm import overlap as overlap_mod
+
+            # may return None (TOPK / custom codec / color groups ride the
+            # host path)
+            self._overlap = overlap_mod.engine_for_trainer(self, cfg)
         # monotonically increasing step() counter — trace spans
         # (mlsl_tpu.obs) carry it so a timeline row maps back to a step
         self._step_no = 0
@@ -415,8 +487,10 @@ class DataParallelTrainer:
         )
 
     def _build_grad_fn(self):
-        layers, get_layer, loss_fn = self.layers, self.get_layer, self.loss_fn
-        padded = self.padded_counts
+        layers = self.layers
+        core = build_local_grads(
+            self.loss_fn, layers, self.get_layer, self.padded_counts
+        )
 
         def local_grads(params, batch):
             # per-device: local-batch loss -> local grads (NO cross-device sync here;
@@ -424,13 +498,11 @@ class DataParallelTrainer:
             x, y = batch
             x = x.reshape(x.shape[NUM_GRID_AXES:])  # strip grid block dims
             y = y.reshape(y.shape[NUM_GRID_AXES:])
-            loss, grads = jax.value_and_grad(loss_fn)(params, (x, y))
-            flat = {}
-            for name in layers:
-                g = _flatten_layer(get_layer(grads, name))
-                g = jnp.pad(g, (0, padded[name] - g.shape[0]))
-                flat[name] = g[None, None, None, None]
-            return loss[None, None, None, None, None], flat
+            loss, flat = core(params, x, y)
+            return (
+                loss[None, None, None, None, None],
+                {n: g[None, None, None, None] for n, g in flat.items()},
+            )
 
         sm = smap(
             local_grads,
@@ -676,6 +748,14 @@ class DataParallelTrainer:
                 out = self._fused_fn(copy(self.params), copy(self._opt_state), batch)
             jax.block_until_ready(out)
             return
+        if self._overlap is not None:
+            # The engine warms the program step() dispatches on donation-safe
+            # copies: the fused single program, or (gate armed) _grad_fn +
+            # the split sync program. A gate-unarmed step_accum still pays
+            # its first-use sync-program compile — the same contract as the
+            # host path, whose accum add/scale jits are likewise not warmed.
+            self._overlap.precompile(batch)
+            return
         loss, grads = self._grad_fn(self.params, batch)
         if self.overlap_updates:
             for name in self.layers:  # per-layer update fns never donate
@@ -884,6 +964,11 @@ class DataParallelTrainer:
         grads, proceed = self._screen(loss, scale_fn(total, k))
         if not proceed:
             return loss
+        if self._overlap is not None:
+            # accumulated grads ride the engine's split comm/update program
+            # (one compiled dispatch for the whole sync, residuals threaded)
+            self._overlap.step(None, grads=grads, loss=loss)
+            return loss
         return self._sync_and_update(grads, loss)
 
     def step(self, batch) -> jax.Array:
@@ -902,6 +987,8 @@ class DataParallelTrainer:
             if tr is not None:
                 tr.complete("step.fused", "step", t0, step=self._step_no)
             return loss
+        if self._overlap is not None:
+            return self._overlap_step(batch)
         loss, grads = self._grad_fn(self.params, batch)
         if tr is not None:
             # host-side dispatch of the local-gradient program (async: device
@@ -911,6 +998,27 @@ class DataParallelTrainer:
         if not proceed:
             return loss
         return self._sync_and_update(grads, loss)
+
+    def _overlap_step(self, batch) -> jax.Array:
+        """One compiled-overlap step (comm/overlap.py). With the sentinel
+        quality gate armed the two-program split runs — the gate screens at
+        the host gradient boundary and a ``skip_step`` verdict never
+        dispatches the comm program, so EF residuals and data order stay
+        lockstep with the host path; unarmed, the fused single-dispatch
+        program carries the whole step (like the no-comm fused shortcut, it
+        exposes no gradient boundary)."""
+        if self.sentinel is not None and self.sentinel.gate_armed:
+            tr = obs_trace._tracer
+            t0 = tr.now() if tr is not None else 0
+            loss, grads = self._grad_fn(self.params, batch)
+            if tr is not None:
+                tr.complete("step.grad", "step", t0, step=self._step_no)
+            grads, proceed = self._screen(loss, grads)
+            if not proceed:
+                return loss
+            self._overlap.step(batch, grads=grads, loss=loss)
+            return loss
+        return self._overlap.step(batch)
 
     def _sync_and_update(self, grads, loss) -> jax.Array:
         # Start gradient comms newest-gradient-first (reverse layer order), the

@@ -39,6 +39,10 @@ KNOB_RANGES = {
     "large_msg_size_mb": 0,
     "large_msg_chunks": 1,
     "quant_block_elems": 1,
+    # compiled-overlap staging depth (comm/overlap.py): profiles may carry
+    # the measured number of unit-starts a layer's reduce phases spread
+    # over; an exported MLSL_OVERLAP_STAGES always wins
+    "overlap_stages": 1,
     # feed-pipeline prefetch depth (mlsl_tpu.data): profiles may carry the
     # depth benchmarks/input_pipeline_bench.py measured best for this
     # machine's h2d link; an exported MLSL_FEED_DEPTH always wins

@@ -224,6 +224,7 @@ def run_sweep(
 
     if quant:
         knobs.update(_sweep_quant_block(devices, iters))
+    knobs.update(_sweep_overlap_stages(devices, iters))
 
     prof = TunedProfile(
         fingerprint=sysinfo.topology_fingerprint(),
@@ -237,6 +238,44 @@ def run_sweep(
         time.perf_counter() - t_start,
     )
     return prof
+
+
+#: staging depths swept for the compiled-overlap knob cell
+OVERLAP_STAGE_CANDIDATES = (1, 2, 4)
+
+
+def _sweep_overlap_stages(devices, iters: int) -> dict:
+    """Staging-depth cell for the compiled overlap engine (comm/overlap.py):
+    time the staged multi-tensor reduce — a 12-tensor backward-shaped
+    stream, the same program shape the engine's comm segment emits — at
+    each candidate depth on the 1D ring and take the argmin. On sim meshes
+    the depths usually tie (the CPU backend serializes collectives anyway);
+    on a real torus the depth controls how many layers' phases interleave
+    in the scheduled program."""
+    from mlsl_tpu.comm.mesh import ProcessGroup, Topology
+    from mlsl_tpu.comm import overlap
+
+    n = len(devices)
+    if n <= 1:
+        return {}
+    topo = Topology(n, 1, devices=devices)
+    group = ProcessGroup(topo, ("data",))
+    counts = [16 * 1024] * 12
+    bufs = [
+        topo.shard_buffer(np.zeros((*topo.grid_shape, c), dtype=np.float32))
+        for c in counts
+    ]
+    measured = {}
+    for stages in OVERLAP_STAGE_CANDIDATES:
+        fn, _ = overlap.build_multi_reduce(group, counts, stages=stages)
+        measured[stages] = _time_fn(lambda: fn(bufs), (), iters)
+    best = min(measured, key=measured.get)
+    return {
+        "overlap_stages": int(best),
+        "_overlap_measured": {
+            str(s): round(t * 1e6, 2) for s, t in measured.items()
+        },
+    }
 
 
 def _sweep_quant_block(devices, iters: int) -> dict:
