@@ -125,6 +125,15 @@ def main():
             ("algo_sweep",
              [sys.executable, "benchmarks/algo_sweep_bench.py", "--quant"],
              1800),
+            # r06 headline: the fused pallas ring's measured algbw curve vs
+            # the composed lowerings (dense + int8 wire), bidir included —
+            # the kernel-quality acceptance for ROADMAP #1. The same run
+            # also re-validates the stale BENCH_r05 rows (BASELINE.md
+            # "Stale pipeline rows": per_layer_vs_fused, pipeline_step_ms,
+            # overlap_fraction) via the bench/overlap_compiled steps above.
+            ("pallas_ring",
+             [sys.executable, "benchmarks/pallas_ring_bench.py", "--bidir"],
+             2400),
             ("grid_collectives",
              [sys.executable, "benchmarks/grid_collectives.py"], 1200),
             ("transformer",

@@ -22,6 +22,16 @@ extends) the algorithm dimension:
                 fuse a reduction over the whole sub-torus (EQuARX/DynamiQ
                 both report the multi-hop topology-aware decomposition is
                 where large-group allreduce wins live).
+- ``pallas_ring`` — the hand-written fused ring kernel (ops/ring_kernels.py,
+                algos/pallas_ring.py): double-buffered
+                ``make_async_remote_copy`` RDMA per hop with the int8 codec
+                fused inside the kernel at the VMEM boundary. Single-live-
+                axis ring groups on TPU (or under the explicit
+                MLSL_PALLAS_INTERPRET gate off-chip); dense f32/bf16/i32
+                here, and the int8-quantized variant of the same kernel
+                selectable for COMPRESSION=QUANTIZATION requests (the one
+                compressed case the table routes — quant_ring's
+                ``ring='pallas'`` wire).
 
 Selection (``select``) is keyed by (kind, payload bytes, group shape,
 compression) with strict precedence:
@@ -103,12 +113,22 @@ def _eligible_ring2d(kind: str, group: ProcessGroup, op) -> bool:
     return True
 
 
+def _eligible_pallas_ring(kind: str, group: ProcessGroup, op) -> bool:
+    # single-live-axis ring groups, SUM only, and only on a backend that can
+    # run the kernel (TPU, or the explicit interpret gate) — lazily imported
+    # so the registry stays importable from config validation without jax
+    from mlsl_tpu.ops import ring_kernels
+
+    return ring_kernels.eligible_dense(kind, group, op)
+
+
 #: name -> eligibility predicate; builders are resolved lazily (the bodies
 #: import jax)
 _ELIGIBLE = {
     "lax": lambda kind, group, op: True,
     "rhd": _eligible_rhd,
     "ring2d": _eligible_ring2d,
+    "pallas_ring": _eligible_pallas_ring,
 }
 
 ALGORITHMS = tuple(_ELIGIBLE)
@@ -181,34 +201,58 @@ def select(
     if kind not in ENGINE_KINDS or config is None:
         return DEFAULT
     if compression != CompressionType.NONE:
-        # compressed collectives have their own wire formats (quant ring /
-        # sparse top-k); the engine's dense algorithms do not apply. The
-        # selection key still carries compression so tuned profiles can hold
-        # per-compression knob cells (tuner).
+        # Compressed collectives have their own wire formats (quant ring /
+        # sparse top-k); the engine's dense algorithms do not apply — with
+        # ONE exception: the fused pallas ring has an int8-quantized variant
+        # (quant_ring's ring='pallas' wire), so a forced or tuned
+        # 'pallas_ring' is honored for QUANTIZATION when the kernel can
+        # serve the group. Everything else keeps the composed ring.
+        if (
+            compression == CompressionType.QUANTIZATION
+            and getattr(config, "custom_codec", None) is None
+        ):
+            name = _requested(kind, group, payload_bytes, compression, config)
+            if name == "pallas_ring" and _quant_pallas_eligible(group, config):
+                return _breaker_gate(name, kind)
+            if name == "pallas_ring":
+                log_debug(
+                    "pallas_ring not eligible for quantized %s on group %s; "
+                    "keeping the composed quant ring", kind,
+                    group_shape(group),
+                )
         return DEFAULT
+    name = _requested(kind, group, payload_bytes, compression, config)
+    if name and name != DEFAULT:
+        if eligible(name, kind, group, op):
+            return _breaker_gate(name, kind)
+        log_debug(
+            "selected algorithm %s not eligible for %s on group %s; "
+            "falling back to %s", name, kind, group_shape(group), DEFAULT,
+        )
+    return DEFAULT
+
+
+def _requested(kind, group, payload_bytes, compression, config):
+    """The raw forced/tuned choice for this cell, eligibility unchecked:
+    explicit config (MLSL_ALGO) first, else the tuned profile's cell, else
+    None."""
     forced = getattr(config, "_forced_algos", None)
     if forced:
         name = forced.get(kind) or forced.get("*")
         if name:
-            if eligible(name, kind, group, op):
-                return _breaker_gate(name, kind)
-            log_debug(
-                "forced algorithm %s not eligible for %s on group %s; "
-                "falling back to %s", name, kind, group_shape(group), DEFAULT,
-            )
-            return DEFAULT
+            return name
     profile = getattr(config, "tuned_profile", None)
     if profile is not None:
-        name = profile.select(kind, group_shape(group), compression,
+        return profile.select(kind, group_shape(group), compression,
                               payload_bytes)
-        if name and name != DEFAULT:
-            if eligible(name, kind, group, op):
-                return _breaker_gate(name, kind)
-            log_debug(
-                "tuned algorithm %s not eligible for %s on group %s; "
-                "falling back to %s", name, kind, group_shape(group), DEFAULT,
-            )
-    return DEFAULT
+    return None
+
+
+def _quant_pallas_eligible(group: ProcessGroup, config) -> bool:
+    from mlsl_tpu.ops import ring_kernels
+
+    block = int(getattr(config, "quant_block_elems", 256))
+    return ring_kernels.eligible_quant(group, block)
 
 
 def _breaker_gate(name: str, kind: str) -> str:
@@ -236,14 +280,22 @@ def inline_eligible(algo: str, kind: str, group: ProcessGroup, op=None) -> bool:
     over zero axes would be a silent identity, not a per-color reduction.
     Color-group graphs ride the host path (the standalone flat-mesh
     programs); only degenerate (size-1) color groups pass, where the
-    identity IS the reduction."""
+    identity IS the reduction. ``pallas_ring`` additionally requires a
+    backend whose in-graph form can execute (TPU: the Pallas interpreter
+    cannot resolve remote DMA inside the 4-axis grid shard_map, so off-chip
+    the overlap plan falls back to the baseline)."""
     if group.colors is not None and int(group.size) > 1:
         return False
+    if algo == "pallas_ring":
+        from mlsl_tpu.ops import ring_kernels
+
+        if not ring_kernels.inline_ok(group):
+            return False
     return eligible(algo, kind, group, op)
 
 
 def inline_plan(kind: str, group: ProcessGroup, algo: str, count: int, *,
-                op=None, recv_count=None):
+                op=None, recv_count=None, config=None):
     """The in-graph (compiled-overlap) form of ``algo``: ``(prep, phases,
     finish)`` closures usable inside a shard_map body over the group's own
     topology mesh — ``prep(x, mypos) -> carry``, each ``phases[i](carry) ->
@@ -294,6 +346,17 @@ def inline_plan(kind: str, group: ProcessGroup, algo: str, count: int, *,
             kind, int(group.size), count, ax, lambda pairs: pairs,
             op=rop, recv_count=recv_count,
         )
+    if algo == "pallas_ring":
+        from mlsl_tpu.comm.algos import pallas_ring
+
+        # kernel-geometry knobs come from the caller's config (tuned
+        # profiles apply there) — the in-graph kernel must run the same
+        # slot geometry as the host-path requests
+        return pallas_ring.steps(
+            kind, group, count, op=rop, recv_count=recv_count,
+            slots=getattr(config, "pallas_ring_slots", None),
+            bidir=getattr(config, "pallas_ring_bidir", None),
+        )
     from mlsl_tpu.comm.algos import ring2d
 
     return ring2d.steps(kind, group, count, op=rop, recv_count=recv_count)
@@ -322,6 +385,8 @@ def build(kind: str, group: ProcessGroup, dtype, algo: str, **kw) -> Callable:
         return fn
     if algo == "rhd":
         from mlsl_tpu.comm.algos import rhd as impl
+    elif algo == "pallas_ring":
+        from mlsl_tpu.comm.algos import pallas_ring as impl
     else:
         from mlsl_tpu.comm.algos import ring2d as impl
     fn = collectives._chaos_dispatch(impl.build(kind, group, **kw), kind)

@@ -1,0 +1,59 @@
+"""The ``pallas_ring`` algos-engine lowering: hand-written fused ring kernels.
+
+Fourth entry in the selection table (after ``lax``/``rhd``/``ring2d``): the
+dense allreduce / reduce-scatter lowered to the Pallas ring kernel in
+ops/ring_kernels.py — explicit double-buffered ``make_async_remote_copy``
+RDMA per hop instead of ``lax.ppermute`` programs XLA schedules. The
+quantized (int8-fused) variant of the same kernel is NOT built here — it is
+a compressed *wire family* and rides quant_ring.build_quantized_collective
+(``ring='pallas'``), which the request layer selects through the same table.
+
+``build`` compiles the standalone host-dispatch program over the flat world
+mesh (ring neighbors resolved per group instance through world-rank tables,
+the rhd precedent — and the form the Pallas interpreter can execute off-TPU
+for tier-1 parity); ``steps`` exposes the compiled-overlap phase form over
+the group's own grid axes (TPU only — ring_kernels.inline_ok)."""
+
+from __future__ import annotations
+
+from typing import Callable
+
+from mlsl_tpu.comm.mesh import ProcessGroup
+from mlsl_tpu.log import mlsl_assert
+
+
+def eligible(kind: str, group: ProcessGroup, op=None) -> bool:
+    from mlsl_tpu.ops import ring_kernels
+
+    return ring_kernels.eligible_dense(kind, group, op)
+
+
+def steps(kind: str, group: ProcessGroup, count: int, *, op=None,
+          recv_count=None, slots=None, bidir=None):
+    from mlsl_tpu.ops import ring_kernels
+
+    return ring_kernels.steps(kind, group, count, op=op,
+                              recv_count=recv_count, slots=slots,
+                              bidir=bidir)
+
+
+def build(kind: str, group: ProcessGroup, *, op=None, recv_count=None,
+          slots=None, bidir=None, **_) -> Callable:
+    """Compile the pallas-ring program for ``kind`` over ``group``: global
+    distributed buffer -> global result buffer (the build_collective
+    convention). Geometry is resolved at trace time from the buffer length,
+    so one cached program serves every payload size through jit's shape
+    specialization — like the other engine lowerings."""
+    from mlsl_tpu.ops import ring_kernels as rk
+
+    mlsl_assert(eligible(kind, group, op),
+                "pallas_ring cannot lower %s on this group/backend", kind)
+
+    def body(x):
+        inner = rk.dense_ring_body(
+            kind, group, int(x.shape[0]), x.dtype,
+            recv_count=recv_count, slots=slots, bidir=bidir,
+        )
+        return inner(x)
+
+    return rk.build_flat_program(body, group, kind)

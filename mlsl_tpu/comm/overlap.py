@@ -74,7 +74,8 @@ class OverlapUnit:
 
     def __init__(self, names: Tuple[str, ...], counts: Tuple[int, ...],
                  compression: CompressionType, algo: str,
-                 group: ProcessGroup, *, index: int, block: int, dtype=None):
+                 group: ProcessGroup, *, index: int, block: int, dtype=None,
+                 config=None):
         self.names = tuple(names)
         self.counts = tuple(int(c) for c in counts)
         self.total = sum(self.counts)
@@ -97,7 +98,8 @@ class OverlapUnit:
             self.algo = "quant_ring"
         else:
             self._prep, self._phases, self._finish = algos.inline_plan(
-                "allreduce", group, algo, self.total, op=ReductionType.SUM
+                "allreduce", group, algo, self.total, op=ReductionType.SUM,
+                config=config,
             )
             # may be 0: a degenerate (single-member) group reduces nothing —
             # the unit retires at its first tick straight through finish()
@@ -269,14 +271,14 @@ def build_plan(
                 CompressionType.NONE,
                 _unit_algo(group, sum(counts[n] for n in members) * 4,
                            CompressionType.NONE, config, algo),
-                group, index=len(units), block=block,
+                group, index=len(units), block=block, config=config,
             ))
             continue
         emitted.add(name)
         units.append(OverlapUnit(
             (name,), (counts[name],), comps[name],
             _unit_algo(group, counts[name] * 4, comps[name], config, algo),
-            group, index=len(units), block=block,
+            group, index=len(units), block=block, config=config,
         ))
     return OverlapPlan(group, units, stages)
 

@@ -245,7 +245,8 @@ def inline_body(
 
 
 def build_quantized_collective(
-    kind: str, group: ProcessGroup, count: int, block: int
+    kind: str, group: ProcessGroup, count: int, block: int,
+    ring: str = "lax", slots=None, bidir=None,
 ) -> Tuple[Callable, int]:
     """-> (compiled fn (buf, err) -> (result, new_err), error-feedback length).
 
@@ -254,13 +255,39 @@ def build_quantized_collective(
     other ops).
     Single-axis groups use the compressed ring; degenerate/multi-axis groups fall back
     to entry-quantization + psum (same numerics contract, uncompressed wire).
+
+    ``ring`` selects the hop engine: ``'lax'`` is this module's composed
+    ring (ppermute programs, XLA-scheduled); ``'pallas'`` is the fused
+    kernel (ops/ring_kernels.py — in-kernel per-hop codec, double-buffered
+    RDMA), selected by the algos table as ``'pallas_ring'``. Both share the
+    entry error-feedback math and the slice-at-chunk-start layout, so the
+    residual contract (and the supervisor's logical_residual degrade flush)
+    is identical.
     """
     from mlsl_tpu.comm.collectives import _group_key
 
     mesh = group.topology.mesh
+    if ring == "pallas":
+        from mlsl_tpu.ops import ring_kernels as rk
+
+        mlsl_assert(rk.eligible_quant(group, block),
+                    "pallas quantized ring cannot serve this group/backend")
+        slots, bidir = rk.env_slots(slots), rk.env_bidir(bidir)
+        key = (kind, ring, _group_key(group), count, block, slots, bidir)
+        _, _, _, err_len = rk.quant_geometry(kind, group, count, block)
+        fn = _cache.get(key)
+        if fn is None:
+            body, _ = rk.quant_ring_body(kind, group, count, block,
+                                         slots=slots, bidir=bidir)
+            fn = _chaos_roundtrip(
+                rk.build_flat_program(body, group, kind, stateful=True),
+                algo="pallas_ring",
+            )
+            _cache[key] = fn
+        return fn, err_len
+    key = (kind, ring, _group_key(group), count, block)
     _, _, _, err_len, _ = ring_geometry(kind, group, count, block)
 
-    key = (kind, _group_key(group), count, block)
     fn = _cache.get(key)
     if fn is not None:
         return fn, err_len

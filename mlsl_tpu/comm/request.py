@@ -140,6 +140,9 @@ class CommRequest:
         with CommRequest._seq_lock:
             CommRequest._seq += 1
             self.uid = CommRequest._seq
+        # extra dispatch-span attribution (e.g. the pallas_ring 'pallas.hop'
+        # wire plan), precomputed at setup so the hot path pays one **splat
+        self._span_args: dict = {}
         # per-Start hot-path constants (VERDICT r4 item 3: keep the host
         # dispatch floor low — no per-dispatch string building / re-derivation)
         self._trace_name = f"mlsl:{desc.kind}:{name or self.uid}"
@@ -201,11 +204,26 @@ class CommRequest:
             else:
                 from mlsl_tpu.comm import quant_ring
 
-                block = self.dispatcher.config.quant_block_elems
+                cfg = self.dispatcher.config
+                block = cfg.quant_block_elems
+                # hop-engine selection through the PR 4 table: a forced or
+                # tuned 'pallas_ring' routes the SAME compressed wire family
+                # through the fused kernel (identical entry error feedback,
+                # identical residual layout — quant_ring ring='pallas')
+                ring = "lax"
+                ring_kw = {}
+                if algos.select(d.kind, d.group, self._payload,
+                                d.compression, cfg, op=d.op) == "pallas_ring":
+                    ring = "pallas"
+                    self.algo = "pallas_ring"
+                    ring_kw = dict(
+                        slots=int(getattr(cfg, "pallas_ring_slots", 2)),
+                        bidir=bool(getattr(cfg, "pallas_ring_bidir", False)),
+                    )
 
                 def build(n):
                     return quant_ring.build_quantized_collective(
-                        d.kind, d.group, n, block
+                        d.kind, d.group, n, block, ring=ring, **ring_kw
                     )
 
             chunks = self._plan_chunks(compressed_ok=True)
@@ -227,6 +245,16 @@ class CommRequest:
                 self._quant_fn, self._err_len = build(d.count)
                 self._chunk_slices = [slice(None)]
                 self._degrade_geoms = [(d.count, self._err_len)]
+            if self.algo == "pallas_ring":
+                # span reflects the geometry of ONE dispatched program (a
+                # chunked request splits into independent per-chunk rings)
+                self._set_pallas_span(
+                    d, block, quantized=True,
+                    count=(self._chunk_slices[0].stop
+                           - self._chunk_slices[0].start)
+                    if self._chunk_slices[0] != slice(None) else d.count,
+                    programs=len(self._chunk_slices), **ring_kw,
+                )
             # ladder: codec faults count against the quant breaker; when it
             # trips, dispatch degrades to the plain f32 SUM program with the
             # residual flushed (_dispatch_degraded)
@@ -270,7 +298,22 @@ class CommRequest:
             d.kind, d.group, self._payload, d.compression,
             self.dispatcher.config, op=kw.get("op"),
         )
+        lax_kw = dict(kw)
+        if self.algo == "pallas_ring":
+            # kernel-geometry knobs ride the build kw (and so the program
+            # cache key) — but never the 'lax' fallback build below
+            cfg = self.dispatcher.config
+            kw["slots"] = int(getattr(cfg, "pallas_ring_slots", 2))
+            kw["bidir"] = bool(getattr(cfg, "pallas_ring_bidir", False))
         chunks = self._plan_chunks()
+        if self.algo == "pallas_ring":
+            self._set_pallas_span(
+                d, None, quantized=False, slots=kw["slots"],
+                bidir=kw["bidir"],
+                count=(chunks[0].stop - chunks[0].start) if chunks
+                else d.count,
+                programs=len(chunks) if chunks else 1,
+            )
         if chunks is None:
             self._fns = [algos.build(d.kind, d.group, dtype, self.algo, **kw)]
             self._chunk_slices = [slice(None)]
@@ -284,13 +327,45 @@ class CommRequest:
             # (its failures escalate straight to supervised restart)
             self._breaker = supervisor.breaker("algo")
             self._degrade_subsys = "algo"
-            self._lax_build = (dtype, dict(kw))
+            self._lax_build = (dtype, lax_kw)
         # hot-path precomputation: the per-layer dispatch floor must stay in
         # single-digit µs (VERDICT r4 item 3), so nothing re-derived per Start
         self._single_full = (
             len(self._chunk_slices) == 1 and self._chunk_slices[0] == slice(None)
         )
         self.is_setup = True
+
+    def _set_pallas_span(self, d: CommDesc, block: Optional[int], *,
+                         quantized: bool, slots=None, bidir=None,
+                         count: Optional[int] = None,
+                         programs: int = 1) -> None:
+        """Precompute the ``pallas.hop`` dispatch-span argument (hops, slot
+        bytes, codec) for a request the table routed to the fused kernel —
+        the wire plan belongs on the trace next to the algorithm name.
+        ``count`` is the per-program element count (ONE chunk of a split
+        large-message request), ``programs`` the number of chunk rings."""
+        from mlsl_tpu.ops import ring_kernels as rk
+
+        cfg = self.dispatcher.config
+        slots = rk.env_slots(
+            slots if slots is not None
+            else getattr(cfg, "pallas_ring_slots", None)
+        )
+        bidir = rk.env_bidir(
+            bidir if bidir is not None
+            else getattr(cfg, "pallas_ring_bidir", None)
+        )
+        count = d.count if count is None else int(count)
+        if quantized:
+            g, _, chunk, _ = rk.quant_geometry(d.kind, d.group, count, block)
+        else:
+            g, _, chunk = rk.dense_geometry(d.kind, d.group, count)
+        self._span_args = {
+            "pallas.hop": rk.describe_plan(
+                g, chunk, quantized, block or 0, bidir, slots,
+                dense_dtype=jnp_dtype(d.data_type), programs=programs,
+            )
+        }
 
     def precompile(self) -> int:
         """Run every compiled program once on zero buffers so the jit caches
@@ -441,7 +516,7 @@ class CommRequest:
                     # selection table chose (comm/algos).
                     tr.complete("dispatch", "req", t0, track=self._trace_name,
                                 req=self.name or self.uid, epoch=self._epoch,
-                                algo=self.algo)
+                                algo=self.algo, **self._span_args)
 
     def _dispatch_ladder(self, buf: jax.Array) -> None:
         """Rungs 2+3 of the recovery ladder around one dispatch (caller holds

@@ -44,6 +44,7 @@ _ENV_FIELDS = {
     "MLSL_GRAD_BUCKET_MB": "grad_bucket_mb",
     "MLSL_NUM_SERVERS": "num_servers",
     "MLSL_QUANT_BLOCK_ELEMS": "quant_block_elems",
+    "MLSL_PALLAS_RING_SLOTS": "pallas_ring_slots",
     "MLSL_OVERLAP_STAGES": "overlap_stages",
     "MLSL_FEED_DEPTH": "feed_depth",
     "MLSL_FEED_CACHE_MB": "feed_cache_mb",
@@ -113,6 +114,24 @@ class Config:
     # Loaded tuner.TunedProfile (or None): consulted by comm/algos.select
     # for every engine collective. Set by Environment.init, never from env.
     tuned_profile: object = None
+
+    # --- pallas ring kernels (ops/ring_kernels.py; docs/TUNING.md §15) ---
+    # Comm slots per ring direction for the 'pallas_ring' lowering: how many
+    # in-flight recv slots the double-buffered RDMA cycles through (>= 2; a
+    # remote-capacity semaphore handshake guards reuse). More slots = more
+    # hop-pipelining headroom at (slots x chunk) VMEM cost. Tunable via a
+    # tuner profile (tuner.KNOB_RANGES); an exported env var always wins.
+    pallas_ring_slots: int = 2      # MLSL_PALLAS_RING_SLOTS
+    # Bidirectional variant: split the payload's block-rows in half and run
+    # opposite-rotation rings concurrently (both directions of each full-
+    # duplex ICI link). Changes quantization grouping order, so the
+    # quantized EF-parity oracle covers the unidirectional form only.
+    pallas_ring_bidir: bool = False  # MLSL_PALLAS_RING_BIDIR
+    # Interpreter gate, recorded for discoverability like chaos_spec: the
+    # kernels read the SAME env var per build ('1' force-interpret, '0'
+    # force-compiled, '' = compiled on TPU / interpreter elsewhere — but
+    # selection only admits pallas_ring off-TPU when explicitly '1').
+    pallas_interpret: str = ""       # MLSL_PALLAS_INTERPRET
 
     # --- compiled overlap engine (comm/overlap.py; docs/TUNING.md §14) ---
     # Arm the single-dispatch compiled step: the backward pass decomposed
@@ -287,6 +306,16 @@ class Config:
             "MLSL_OVERLAP_STAGES must be >= 1 (got %d)", self.overlap_stages,
         )
         mlsl_assert(
+            self.pallas_ring_slots >= 2,
+            "MLSL_PALLAS_RING_SLOTS must be >= 2 (the ring needs a double "
+            "buffer; got %d)", self.pallas_ring_slots,
+        )
+        mlsl_assert(
+            self.pallas_interpret in ("", "0", "1"),
+            "MLSL_PALLAS_INTERPRET must be '', '0' or '1' (got %r)",
+            self.pallas_interpret,
+        )
+        mlsl_assert(
             self.watchdog_timeout_s >= 0,
             "MLSL_WATCHDOG_TIMEOUT must be >= 0 (got %r)",
             self.watchdog_timeout_s,
@@ -405,6 +434,12 @@ class Config:
         c.overlap_compiled = _env_bool("MLSL_OVERLAP_COMPILED", c.overlap_compiled)
         c.overlap_stages = _env_int("MLSL_OVERLAP_STAGES", c.overlap_stages)
         c.quant_block_elems = _env_int("MLSL_QUANT_BLOCK_ELEMS", c.quant_block_elems)
+        c.pallas_ring_slots = _env_int("MLSL_PALLAS_RING_SLOTS",
+                                       c.pallas_ring_slots)
+        c.pallas_ring_bidir = _env_bool("MLSL_PALLAS_RING_BIDIR",
+                                        c.pallas_ring_bidir)
+        c.pallas_interpret = os.environ.get("MLSL_PALLAS_INTERPRET",
+                                            c.pallas_interpret).strip()
         c.topk_ratio = _env_float("MLSL_TOPK_RATIO", c.topk_ratio)
         c.watchdog_timeout_s = _env_float("MLSL_WATCHDOG_TIMEOUT", c.watchdog_timeout_s)
         c.comm_retries = _env_int("MLSL_COMM_RETRIES", c.comm_retries)

@@ -343,6 +343,15 @@ class Session:
             if d.compression != CompressionType.NONE:
                 codec_key = (cfg.quant_block_elems, cfg.topk_ratio,
                              id(cfg.custom_codec))
+            # pallas-ring variant identity: a slot-geometry or direction
+            # change compiles a DIFFERENT kernel, and a plan entry recorded
+            # under the old geometry must not skip re-warming it
+            pallas_key = ()
+            if req.algo == "pallas_ring":
+                pallas_key = (
+                    int(getattr(cfg, "pallas_ring_slots", 2)),
+                    bool(getattr(cfg, "pallas_ring_bidir", False)),
+                )
             # the algorithm identity is part of the plan key: a profile (or
             # MLSL_ALGO) switching a request from 'lax' to 'rhd' between
             # sessions compiles a DIFFERENT program, and a stale plan entry
@@ -351,7 +360,7 @@ class Session:
                 "req", d.kind, _group_key(d.group), int(d.data_type), d.count,
                 int(d.compression), d.recv_count,
                 None if d.op is None else int(d.op), d.root,
-                len(req._chunk_slices), codec_key, req.algo,
+                len(req._chunk_slices), codec_key, pallas_key, req.algo,
             )
             if key in _plan_cache:
                 return

@@ -39,6 +39,10 @@ KNOB_RANGES = {
     "large_msg_size_mb": 0,
     "large_msg_chunks": 1,
     "quant_block_elems": 1,
+    # pallas-ring comm slots per direction (ops/ring_kernels.py): profiles
+    # may carry a measured double-buffer depth for this machine's ICI; an
+    # exported MLSL_PALLAS_RING_SLOTS always wins
+    "pallas_ring_slots": 2,
     # compiled-overlap staging depth (comm/overlap.py): profiles may carry
     # the measured number of unit-starts a layer's reduce phases spread
     # over; an exported MLSL_OVERLAP_STAGES always wins

@@ -148,6 +148,14 @@ def run_sweep(
                 args = (buf_for(elems),)
                 measured = {}
                 for algo in algos.candidates(kind, group, ReductionType.SUM):
+                    if algo == "pallas_ring":
+                        # never time the interpreter (a correctness vehicle
+                        # whose simulated DMAs are world gathers — it can
+                        # only lose, at enormous sweep wall-time)
+                        from mlsl_tpu.ops import ring_kernels
+
+                        if ring_kernels.interpret_mode():
+                            continue
                     fn = algos.build(kind, group, np.float32, algo, **kw)
                     measured[algo] = _time_fn(fn, args, iters)
                 best = min(measured, key=measured.get)
@@ -224,6 +232,11 @@ def run_sweep(
 
     if quant:
         knobs.update(_sweep_quant_block(devices, iters))
+        # lowering cells measured at the block the SAME sweep just picked
+        # (the geometry runtime requests will actually run)
+        cells.extend(_sweep_quant_lowering(
+            devices, iters, block=int(knobs.get("quant_block_elems", 256))
+        ))
     knobs.update(_sweep_overlap_stages(devices, iters))
 
     prof = TunedProfile(
@@ -276,6 +289,62 @@ def _sweep_overlap_stages(devices, iters: int) -> dict:
             str(s): round(t * 1e6, 2) for s, t in measured.items()
         },
     }
+
+
+def _sweep_quant_lowering(devices, iters: int, block: int = 256) -> list:
+    """Quantized-wire lowering cells: time the composed quant ring ('lax')
+    against the fused pallas kernel ('pallas_ring') per payload size on the
+    1D ring, so the selection table can route QUANTIZATION requests to the
+    fused kernel per (kind x size x topology) cell where it measures faster.
+    Skipped when the pallas kernel cannot run on this backend (off-TPU
+    without the interpret gate — and never measured under the interpreter,
+    which is a correctness vehicle, not a contender)."""
+    import jax
+
+    from mlsl_tpu.comm.mesh import ProcessGroup, Topology
+    from mlsl_tpu.comm import algos, quant_ring
+    from mlsl_tpu.ops import ring_kernels as rk
+
+    n = len(devices)
+    if n <= 1:
+        return []
+    topo = Topology(n, 1, devices=devices)
+    group = ProcessGroup(topo, ("data",))
+    if not rk.eligible_quant(group, block) or rk.interpret_mode():
+        return []
+    shape = list(algos.group_shape(group))
+    cells = []
+    sizes = _env_sizes() or DEFAULT_SIZES
+    for size_b in sorted(sizes):
+        elems = max(-(-(size_b // 4) // n) * n, n)
+        buf = topo.shard_buffer(
+            np.zeros((*topo.grid_shape, elems), dtype=np.float32)
+        )
+        measured = {}
+        for ring, name in (("lax", "lax"), ("pallas", "pallas_ring")):
+            fn, err_len = quant_ring.build_quantized_collective(
+                "allreduce", group, elems, block, ring=ring
+            )
+            err = topo.shard_buffer(
+                np.zeros((*topo.grid_shape, err_len), dtype=np.float32)
+            )
+            measured[name] = _time_fn(fn, (buf, err), iters)
+        best = min(measured, key=measured.get)
+        payload = elems * 4
+        cells.append({
+            "kind": "allreduce",
+            "shape": shape,
+            "compression": "quantization",
+            "payload_bytes": payload,
+            "max_bytes": payload * 2,
+            "algo": best,
+            "us": {a: round(s * 1e6, 2) for a, s in measured.items()},
+        })
+        log_debug("tune: quant allreduce %dB -> %s (%s)", payload, best,
+                  cells[-1]["us"])
+    if cells:
+        cells[-1]["max_bytes"] = None  # open top band
+    return cells
 
 
 def _sweep_quant_block(devices, iters: int) -> dict:

@@ -22,6 +22,20 @@ import numpy as np  # noqa: E402
 import pytest  # noqa: E402
 
 
+def pytest_collection_modifyitems(config, items):
+    """Auto-skip ``tpu``-marked tests off-chip: the compiled Pallas kernel
+    variants need real hardware; their interpret-mode twins cover parity in
+    tier-1 (tests/test_pallas_ring.py)."""
+    from mlsl_tpu.sysinfo import on_tpu
+
+    if on_tpu():
+        return
+    skip = pytest.mark.skip(reason="tpu marker: requires a real TPU")
+    for item in items:
+        if "tpu" in item.keywords:
+            item.add_marker(skip)
+
+
 @pytest.fixture()
 def env():
     """A fresh initialized Environment; finalized after the test."""
