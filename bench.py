@@ -70,7 +70,7 @@ def main():
     try:
         jax.config.update("jax_compilation_cache_dir", cache_dir)
         jax.config.update("jax_persistent_cache_min_compile_time_secs", 5)
-    except Exception:
+    except Exception:  # mlsl-lint: disable=A205 -- cache arming is optional
         pass
     import jax.numpy as jnp
 

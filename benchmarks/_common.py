@@ -161,7 +161,7 @@ def append_measurement(record: dict) -> None:
         try:
             with open(path) as f:
                 data = json.load(f)
-        except Exception:
+        except Exception:  # mlsl-lint: disable=A205 -- corrupt file = fresh doc
             pass
     caps = data.setdefault("captures", [])
     caps[:] = [c for c in caps if c.get("run_id") != record.get("run_id")]

@@ -20,7 +20,7 @@ import numpy as np
 
 sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), ".."))
 
-from benchmarks._common import device_sync, setup_chip, timed
+from benchmarks._common import device_sync, setup_chip
 
 jax = setup_chip("bn_probe")
 

@@ -28,7 +28,6 @@ def main():
 
     apply_platform_override()
 
-    import jax
     import numpy as np
 
     import mlsl_tpu as mlsl

@@ -77,7 +77,7 @@ def main():
     ap.add_argument("--probe-timeout", type=float, default=90.0)
     args = ap.parse_args()
 
-    deadline = time.time() + args.max_wait_hours * 3600
+    deadline = time.monotonic() + args.max_wait_hours * 3600
     attempt = 0
     while True:
         attempt += 1
@@ -88,7 +88,7 @@ def main():
               f"({time.strftime('%H:%M:%S')})", flush=True)
         if args.once:
             sys.exit(3)
-        if time.time() > deadline:
+        if time.monotonic() > deadline:
             print("capture: gave up waiting for the tunnel", flush=True)
             sys.exit(3)
         time.sleep(args.poll_sleep)
@@ -141,6 +141,7 @@ def main():
         ]
 
     record = {
+        # mlsl-lint: disable=A206 -- a wall-clock run id, not a deadline
         "run_id": f"{int(time.time())}-{os.getpid()}",
         "timestamp": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
         "git_sha": git_sha(),

@@ -17,7 +17,7 @@ import numpy as np
 
 sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), ".."))
 
-from benchmarks._common import device_sync, setup_chip, timed
+from benchmarks._common import device_sync, setup_chip
 
 jax = setup_chip("noise_probe")
 

@@ -21,7 +21,6 @@ Prints one JSON row (capture-row shape, metric=trace_overhead).
 import argparse
 import json
 import os
-import statistics
 import sys
 import time
 

@@ -15,7 +15,6 @@ import sys
 sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
 
 import numpy as np
-import jax
 
 import mlsl_tpu as mlsl
 from mlsl_tpu.types import DataType, GroupType, OpType, ReductionType

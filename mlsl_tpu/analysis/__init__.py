@@ -1,0 +1,52 @@
+"""Static analysis for MLSL: the commit-time collective-plan verifier and
+the project concurrency linter.
+
+Two passes over one structured-diagnostic format (stable ``MLSL-Axxx``
+codes, ``error``/``warn`` severity, ``file:line`` or ``graph:<node>``
+anchors — see ``diagnostics.CODES`` for the full table):
+
+- ``analysis.plan`` walks a committed Session's collective plan (armed by
+  ``MLSL_VERIFY=1`` at ``Session.commit``, or explicitly via
+  ``verify_session``) and checks the statically decidable invariants PRs
+  2-10 established as runtime behavior: issue-order consistency across
+  overlapping groups, in-flight program budgets, quantization geometry,
+  EF snapshot/rewind pairing, compiled-overlap donation hazards, and
+  Pallas-ring semaphore accounting.
+- ``analysis.lint`` runs project-specific AST rules over the source tree
+  (``python -m mlsl_tpu.analysis`` / ``scripts/run_lint.sh``): raw
+  collective embeds, thread-reachable device dispatch, stats-counter
+  discipline, chaos-wrapper symmetry, taxonomy-swallowing excepts, and
+  wall-clock retry math.
+
+The last verdict of each pass is surfaced as the ``analysis`` key of
+``supervisor.status()``.
+"""
+
+from mlsl_tpu.analysis.diagnostics import (  # noqa: F401
+    CODES,
+    Diagnostic,
+    Report,
+    record,
+    reset,
+    status,
+)
+
+
+def verify_session(session, config=None):
+    """Statically verify one committed session (see analysis/plan.py)."""
+    from mlsl_tpu.analysis import plan
+
+    return plan.verify_session(session, config)
+
+
+def verify_overlap_plan(overlap_plan, block=None):
+    from mlsl_tpu.analysis import plan
+
+    return plan.verify_overlap_plan(overlap_plan, block)
+
+
+def lint_tree(root=None):
+    """Run the AST linter over a source tree (see analysis/lint.py)."""
+    from mlsl_tpu.analysis import lint
+
+    return lint.lint_tree(root)

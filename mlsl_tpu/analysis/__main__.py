@@ -1,0 +1,122 @@
+"""CLI for the static-analysis passes: the pre-commit gate.
+
+Usage::
+
+    python -m mlsl_tpu.analysis                 # lint the installed package
+    python -m mlsl_tpu.analysis --lint --root . # lint an arbitrary tree
+    python -m mlsl_tpu.analysis --graph         # build + verify a demo graph
+    python -m mlsl_tpu.analysis --json          # machine-readable findings
+
+Exits nonzero when any error-severity finding survives — wire it as a
+pre-commit hook (scripts/run_lint.sh runs it after ruff).
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+from typing import List, Optional
+
+
+def _demo_graph_report():
+    """Build a small representative committed graph on the current backend
+    (a 3-layer net with a plain, a quantized, and a ZeRO-1 parameter set)
+    and run the plan verifier over it — the ``--graph`` smoke path that
+    exercises every pass a real commit would."""
+    # multi-device CPU simulation when nothing provides devices (the same
+    # trick tests/conftest.py uses); harmless if a backend already exists
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + " --xla_force_host_platform_device_count=8"
+        ).strip()
+
+    from mlsl_tpu.analysis import plan as plan_mod
+    from mlsl_tpu.core.environment import Environment
+    from mlsl_tpu.types import CompressionType, OpType
+
+    env = Environment.get_env().init()
+    try:
+        n = len(env.devices)
+        dist = env.create_distribution(n, 1)
+        s = env.create_session()
+        s.set_global_minibatch_size(max(8, n))
+        prev = None
+        for i, (comp, du) in enumerate([
+            (CompressionType.NONE, False),
+            (CompressionType.QUANTIZATION, False),
+            (CompressionType.NONE, True),
+        ]):
+            r = s.create_operation_reg_info(OpType.CC)
+            r.set_name(f"demo{i}")
+            if i:
+                r.add_input(8, 4)
+            r.add_output(8, 4)
+            r.add_parameter_set(256, 4, distributed_update=du,
+                                compression_type=comp)
+            op = s.get_operation(s.add_operation(r, dist))
+            if prev is not None:
+                prev.set_next(op, 0, 0)
+            prev = op
+        s.commit()
+        from mlsl_tpu.analysis.diagnostics import record
+
+        rep = plan_mod.verify_session(s)
+        record(rep)
+        return rep
+    finally:
+        env.finalize()
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    p = argparse.ArgumentParser(
+        prog="python -m mlsl_tpu.analysis",
+        description="MLSL static analysis: plan verifier + concurrency "
+                    "linter (exit 1 on error-severity findings)",
+    )
+    p.add_argument("--lint", action="store_true",
+                   help="run the AST linter (the default when no pass is "
+                        "selected)")
+    p.add_argument("--graph", action="store_true",
+                   help="build a representative demo graph and run the "
+                        "commit-time plan verifier over it")
+    p.add_argument("--root", default=None,
+                   help="lint root (default: the installed mlsl_tpu package)")
+    p.add_argument("--json", action="store_true",
+                   help="emit findings as JSON")
+    p.add_argument("--codes", action="store_true",
+                   help="print the diagnostic-code table and exit")
+    args = p.parse_args(argv)
+
+    from mlsl_tpu.analysis.diagnostics import CODES, Report, record
+
+    if args.codes:
+        for code, (sev, title) in sorted(CODES.items()):
+            print(f"{code}  {sev:<5}  {title}")
+        return 0
+
+    reports: List[Report] = []
+    if args.lint or not args.graph:
+        from mlsl_tpu.analysis import lint
+
+        rep = lint.lint_tree(args.root)
+        record(rep)
+        reports.append(rep)
+    if args.graph:
+        reports.append(_demo_graph_report())
+
+    rc = 0
+    for rep in reports:
+        if args.json:
+            print(rep.to_json())
+        elif rep.diagnostics:
+            print(rep.format())
+        print(rep.summary(), file=sys.stderr)
+        if rep.errors:
+            rc = 1
+    return rc
+
+
+if __name__ == "__main__":
+    sys.exit(main())

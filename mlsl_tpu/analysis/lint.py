@@ -1,0 +1,391 @@
+"""Project concurrency/idiom linter: AST rules for the invariants PRs 1-10
+accumulated as prose and runtime tests.
+
+Each rule encodes a hazard this codebase has actually hit (see
+docs/DESIGN.md "Static analysis" for the full table):
+
+- **A201** raw ``lax.p*`` collectives outside ``comm/algos/`` and the
+  allowlisted engine modules: collectives must route through the selection
+  table (PR 4) so tuning, breakers, and stats see them. Model/optimizer code
+  that deliberately embeds a raw collective carries an explicit pragma.
+- **A202** device-program dispatch reachable from a ``threading.Thread``
+  target: a background thread launching SPMD programs concurrently with the
+  training loop's dispatches starves the XLA:CPU rendezvous and wedges the
+  mesh (the PR 6 loader redesign; KNOWN_FAILURES.md).
+- **A203** ``core/stats`` counter mutation outside its ``record_*``/
+  ``reset_*`` helpers: the helpers are the process-wide counters' single
+  mutation discipline; scattered writes race and break the stats contract.
+- **A204** chaos wrappers must pair ``__wrapped__`` with ``_mlsl_inner``:
+  the precompile warm bypasses chaos sites through ``_mlsl_inner``
+  (comm/request._unwrap_chaos) — a wrapper missing it burns armed fault
+  budgets inside Commit.
+- **A205** bare ``except:`` swallows the MLSL error taxonomy (the
+  supervisor's classify() never sees the failure; KeyboardInterrupt and
+  MemoryError die silently).
+- **A206** wall-clock ``time.time()`` in retry/backoff/poll math: NTP steps
+  move wall clock backwards; deadlines and backoff must use
+  ``time.monotonic()``.
+
+Pragmas (same-line, or a standalone comment line covering the next
+statement line)::
+
+    x = lax.psum(v, axes)  # mlsl-lint: disable=A201 -- reason
+    # mlsl-lint: disable-file=A201 -- reason   (anywhere: whole file)
+
+stdlib-only on purpose: runs as a pre-commit gate without importing jax.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import re
+from typing import Dict, List, Optional, Set, Tuple
+
+from mlsl_tpu.analysis.diagnostics import Report, WARN, normalize_code
+
+#: jax.lax collective primitives the engine owns (axis_index and friends are
+#: addressing, not collectives — deliberately not listed)
+COLLECTIVE_NAMES = {
+    "psum", "pmean", "pmax", "pmin", "ppermute", "pshuffle",
+    "psum_scatter", "all_gather", "all_to_all",
+}
+
+#: package-relative modules where raw lax collectives ARE the implementation
+#: (the engine itself); everything else needs a pragma per site
+A201_ALLOWED_PREFIXES = ("comm/algos/",)
+A201_ALLOWED_FILES = {
+    "comm/collectives.py",   # the collective builder the engine lowers to
+    "comm/quant_ring.py",    # compressed-ring hop engine
+    "comm/sparse.py",        # top-k wire family
+    "comm/codec.py",         # custom-codec wire family
+    "comm/overlap.py",       # in-graph emission (phases come from algos/)
+    "ops/ring_kernels.py",   # the fused Pallas ring
+}
+
+#: attribute/function names whose call means "a device program is being
+#: dispatched": compiled-program launch and completion-blocking. Host->device
+#: staging (device_put / make_array_from_single_device_arrays) is deliberately
+#: NOT listed — the PR 6 loader contract allows staging on the worker thread,
+#: only SPMD program launch must stay on the consumer thread.
+DISPATCH_MARKERS = {"_dispatch", "_dispatch_items", "block_until_ready"}
+
+#: maximum call-graph depth explored from a Thread target (intra-module)
+A202_DEPTH = 6
+
+_COUNTER_RE = re.compile(r"^[A-Z][A-Z0-9_]*_(COUNTERS|EVENTS)$")
+_MUTATORS = {"update", "clear", "append", "appendleft", "pop", "popleft",
+             "setdefault", "extend", "__setitem__"}
+
+_PRAGMA_RE = re.compile(
+    r"#\s*mlsl-lint:\s*(disable(?:-file)?)\s*=\s*([A-Za-z0-9\-,\s]+?)\s*(?:--.*)?$"
+)
+
+
+def _parse_pragmas(src: str) -> Tuple[Dict[int, Set[str]], Set[str]]:
+    """-> (line -> suppressed codes, file-level suppressed codes). A pragma on
+    a standalone comment line also covers the next non-blank, non-comment
+    line (long call sites keep their pragma readable)."""
+    line_codes: Dict[int, Set[str]] = {}
+    file_codes: Set[str] = set()
+    lines = src.splitlines()
+    for i, line in enumerate(lines, start=1):
+        m = _PRAGMA_RE.search(line)
+        if not m:
+            continue
+        codes = {normalize_code(c) for c in m.group(2).split(",") if c.strip()}
+        if m.group(1) == "disable-file":
+            file_codes |= codes
+            continue
+        line_codes.setdefault(i, set()).update(codes)
+        if line.lstrip().startswith("#"):
+            # standalone comment: cover the next statement line
+            for j in range(i + 1, len(lines) + 1):
+                nxt = lines[j - 1].strip()
+                if nxt and not nxt.startswith("#"):
+                    line_codes.setdefault(j, set()).update(codes)
+                    break
+    return line_codes, file_codes
+
+
+def _attr_root(node: ast.AST) -> Optional[str]:
+    """Leftmost name of an attribute chain ('a' for a.b.c), or None."""
+    while isinstance(node, ast.Attribute):
+        node = node.value
+    return node.id if isinstance(node, ast.Name) else None
+
+
+def _is_lax_attr(node: ast.Attribute) -> bool:
+    """a ``lax.<coll>`` / ``jax.lax.<coll>`` attribute access."""
+    v = node.value
+    if isinstance(v, ast.Name) and v.id == "lax":
+        return True
+    return (isinstance(v, ast.Attribute) and v.attr == "lax"
+            and isinstance(v.value, ast.Name) and v.value.id == "jax")
+
+
+class _FuncInfo:
+    """Per-function facts for the thread-reachability rule (A202)."""
+
+    __slots__ = ("key", "calls", "markers", "node")
+
+    def __init__(self, key, node):
+        self.key = key          # (class name or None, function name)
+        self.node = node
+        self.calls: Set[Tuple[Optional[str], str]] = set()
+        self.markers: List[Tuple[int, str]] = []  # (lineno, marker name)
+
+
+def _collect_functions(tree: ast.Module) -> Dict[Tuple, _FuncInfo]:
+    """Index every function/method with its intra-module call edges and its
+    dispatch-marker call sites."""
+    funcs: Dict[Tuple, _FuncInfo] = {}
+
+    def walk_body(node, cls: Optional[str]):
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                info = _FuncInfo((cls, child.name), child)
+                funcs[info.key] = info
+                _scan_calls(child, cls, info)
+                walk_body(child, cls)  # nested defs attributed to the module
+            elif isinstance(child, ast.ClassDef):
+                walk_body(child, child.name)
+            else:
+                walk_body(child, cls)
+
+    def _scan_calls(fn, cls, info):
+        for n in ast.walk(fn):
+            if not isinstance(n, ast.Call):
+                continue
+            f = n.func
+            if isinstance(f, ast.Attribute):
+                if f.attr in DISPATCH_MARKERS:
+                    info.markers.append((n.lineno, f.attr))
+                if isinstance(f.value, ast.Name) and f.value.id == "self":
+                    info.calls.add((cls, f.attr))
+                info.calls.add((None, f.attr))
+            elif isinstance(f, ast.Name):
+                info.calls.add((None, f.id))
+    walk_body(tree, None)
+    return funcs
+
+
+def _thread_targets(tree: ast.Module) -> List[Tuple[Tuple, int]]:
+    """Every ``threading.Thread(target=X)`` site -> (resolved key, lineno)."""
+    out = []
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        f = node.func
+        name = f.attr if isinstance(f, ast.Attribute) else (
+            f.id if isinstance(f, ast.Name) else None)
+        if name != "Thread":
+            continue
+        for kw in node.keywords:
+            if kw.arg != "target":
+                continue
+            v = kw.value
+            if isinstance(v, ast.Attribute) and \
+                    isinstance(v.value, ast.Name) and v.value.id == "self":
+                out.append(((None, v.attr), node.lineno))  # class-agnostic
+            elif isinstance(v, ast.Name):
+                out.append(((None, v.id), node.lineno))
+    return out
+
+
+def _rule_path(relpath: str) -> str:
+    """The package-relative path rule matching uses: linting with
+    ``--root .`` (or any ancestor) yields paths like
+    ``mlsl_tpu/comm/algos/x.py`` — the allowlists are anchored at the
+    package, so strip everything up to the last ``mlsl_tpu/`` segment."""
+    marker = "mlsl_tpu/"
+    i = relpath.rfind(marker)
+    return relpath[i + len(marker):] if i >= 0 else relpath
+
+
+def lint_source(src: str, relpath: str = "<string>") -> Report:
+    """Lint one file's source. ``relpath`` is package-relative with ``/``
+    separators (it drives the A201/A203 allowlists — normalized through
+    ``_rule_path`` so linting from an ancestor root matches the same
+    rules — and every anchor)."""
+    rep = Report("lint")
+    try:
+        tree = ast.parse(src)
+    except SyntaxError as e:
+        rep.add("MLSL-A200", f"unparseable source: {e.msg}",
+                f"{relpath}:{e.lineno or 0}")
+        return rep
+    line_pragmas, file_pragmas = _parse_pragmas(src)
+
+    def emit(code, message, lineno, severity=None):
+        code = normalize_code(code)
+        if code in file_pragmas or code in line_pragmas.get(lineno, ()):
+            return
+        rep.add(code, message, f"{relpath}:{lineno}", severity=severity)
+
+    # -- A201: raw lax collectives ---------------------------------------
+    rule_path = _rule_path(relpath)
+    allowed = rule_path in A201_ALLOWED_FILES or any(
+        rule_path.startswith(p) for p in A201_ALLOWED_PREFIXES
+    )
+    if not allowed:
+        for node in ast.walk(tree):
+            if (isinstance(node, ast.Attribute)
+                    and node.attr in COLLECTIVE_NAMES
+                    and _is_lax_attr(node)):
+                emit("A201",
+                     f"raw lax.{node.attr} outside the collective engine — "
+                     "route through comm/algos (or pragma the deliberate "
+                     "embed)", node.lineno)
+
+    # -- A202: dispatch reachable from Thread targets --------------------
+    funcs = _collect_functions(tree)
+    by_name: Dict[str, List[_FuncInfo]] = {}
+    for (cls, name), info in funcs.items():
+        by_name.setdefault(name, []).append(info)
+    for key, t_line in _thread_targets(tree):
+        seen: Set[Tuple] = set()
+        frontier = [info for info in by_name.get(key[1], [])]
+        depth = 0
+        while frontier and depth < A202_DEPTH:
+            nxt = []
+            for info in frontier:
+                if info.key in seen:
+                    continue
+                seen.add(info.key)
+                for lineno, marker in info.markers:
+                    emit("A202",
+                         f"{marker}() reachable from the Thread target "
+                         f"'{key[1]}' (line {t_line}): device programs must "
+                         "dispatch on the consumer thread", lineno)
+                for _, cname in info.calls:
+                    nxt.extend(by_name.get(cname, []))
+            frontier = nxt
+            depth += 1
+
+    # -- A203: stats counter mutation outside the helpers ----------------
+    in_stats = rule_path == "core/stats.py"
+
+    def counter_name(node) -> Optional[str]:
+        if isinstance(node, ast.Name) and _COUNTER_RE.match(node.id):
+            return node.id
+        if isinstance(node, ast.Attribute) and _COUNTER_RE.match(node.attr):
+            return node.attr
+        return None
+
+    def allowed_scope(fn_name: Optional[str]) -> bool:
+        if not in_stats:
+            return False
+        # module-level init and the record_/reset_ helpers own the mutations
+        return fn_name is None or fn_name.startswith(("record_", "reset_",
+                                                      "_"))
+
+    def check_node(n, fn_name):
+        tgt = None
+        if isinstance(n, (ast.Assign, ast.AugAssign)):
+            tgts = n.targets if isinstance(n, ast.Assign) else [n.target]
+            for t in tgts:
+                if isinstance(t, ast.Subscript):
+                    tgt = tgt or counter_name(t.value)
+        elif isinstance(n, ast.Call) and isinstance(
+                n.func, ast.Attribute) and n.func.attr in _MUTATORS:
+            tgt = counter_name(n.func.value)
+        if tgt and not allowed_scope(fn_name):
+            emit("A203",
+                 f"{tgt} mutated outside core/stats record_*/reset_* "
+                 "helpers", n.lineno)
+
+    def scan_scope(node, fn_name):
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                scan_scope(child, child.name)
+                continue
+            check_node(child, fn_name)
+            scan_scope(child, fn_name)
+
+    scan_scope(tree, None)
+
+    # -- A204: chaos wrapper _mlsl_inner symmetry ------------------------
+    for info in funcs.values():
+        wrapped: Dict[str, int] = {}
+        inner: Set[str] = set()
+        for n in ast.walk(info.node):
+            if isinstance(n, ast.Assign):
+                for t in n.targets:
+                    if isinstance(t, ast.Attribute) and isinstance(
+                            t.value, ast.Name):
+                        if t.attr == "__wrapped__":
+                            wrapped[t.value.id] = n.lineno
+                        elif t.attr == "_mlsl_inner":
+                            inner.add(t.value.id)
+        for name, lineno in wrapped.items():
+            if name not in inner:
+                emit("A204",
+                     f"wrapper '{name}' sets __wrapped__ without "
+                     "_mlsl_inner: the precompile warm would re-enter the "
+                     "chaos site (comm/request._unwrap_chaos)", lineno)
+
+    # -- A205: bare/swallowing except ------------------------------------
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.ExceptHandler):
+            continue
+        if node.type is None:
+            emit("A205",
+                 "bare 'except:' swallows the MLSL error taxonomy "
+                 "(supervisor.classify never sees the failure)", node.lineno)
+        elif (isinstance(node.type, ast.Name)
+              and node.type.id in ("Exception", "BaseException")
+              and all(isinstance(s, (ast.Pass, ast.Continue))
+                      for s in node.body)):
+            emit("A205",
+                 f"'except {node.type.id}' with an empty body silently "
+                 "swallows classified failures", node.lineno,
+                 severity=WARN)
+
+    # -- A206: wall clock in retry/backoff math --------------------------
+    def is_call_to(n, mod, name):
+        return (isinstance(n, ast.Call) and isinstance(n.func, ast.Attribute)
+                and n.func.attr == name
+                and isinstance(n.func.value, ast.Name)
+                and n.func.value.id == mod)
+
+    for info in funcs.values():
+        body_nodes = list(ast.walk(info.node))
+        if not any(is_call_to(n, "time", "sleep") for n in body_nodes):
+            continue
+        for n in body_nodes:
+            if is_call_to(n, "time", "time"):
+                emit("A206",
+                     f"time.time() in '{info.key[1]}', which sleeps/backs "
+                     "off: wall clock steps backwards under NTP — use "
+                     "time.monotonic()", n.lineno)
+
+    return rep
+
+
+def package_root() -> str:
+    """The installed mlsl_tpu package directory (the default lint root)."""
+    return os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def lint_file(path: str, root: Optional[str] = None) -> Report:
+    root = root or package_root()
+    rel = os.path.relpath(os.path.abspath(path), root).replace(os.sep, "/")
+    with open(path, "r", encoding="utf-8") as f:
+        src = f.read()
+    return lint_source(src, rel)
+
+
+def lint_tree(root: Optional[str] = None) -> Report:
+    """Lint every ``.py`` file under ``root`` (default: the mlsl_tpu package
+    itself — the self-application the clean-tree test pins)."""
+    root = os.path.abspath(root or package_root())
+    rep = Report("lint")
+    for dirpath, dirnames, filenames in os.walk(root):
+        dirnames[:] = [d for d in dirnames
+                       if d not in ("__pycache__", "build", ".git",
+                                    "node_modules", ".ruff_cache")]
+        for fn in sorted(filenames):
+            if fn.endswith(".py"):
+                rep.extend(lint_file(os.path.join(dirpath, fn), root))
+    return rep

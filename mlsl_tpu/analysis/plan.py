@@ -1,0 +1,583 @@
+"""Commit-time collective-plan verifier.
+
+``Session.commit`` is the one point where the whole communication plan is
+visible *before* anything executes: every CommRequest is built, buckets are
+formed, the selection table has resolved each request's algorithm. This pass
+walks that committed state and statically checks the invariants PRs 2-10
+established at runtime (TVM-style graph-level verification; NCCL's
+collective-ordering deadlock model):
+
+- **A101** issue-order consistency across overlapping process groups: under
+  ``MLSL_MSG_PRIORITY`` a deferred large request's dispatch is released by a
+  wall-clock flush window, so its wire order against an immediately
+  dispatched request is rank-dependent on a multi-controller mesh — when the
+  two groups' instance partitions differ and intersect, that inversion is
+  the classic cross-replica deadlock.
+- **A102/A103** worst-case concurrent in-flight collective programs vs the
+  backend budget (the XLA:CPU rendezvous wedge documented in
+  KNOWN_FAILURES.md — flagged before it hangs).
+- **A110-A113** quantization geometry: bucket member slots on quant-block
+  boundaries, coalesced totals on the ring-chunk unit, error-feedback
+  lengths equal to the quant-ring geometry, ZeRO-1 shard boundaries on
+  block boundaries.
+- **A121** the EF snapshot/rewind machinery's static preconditions on every
+  retry/degrade path (degrade geometry covers every chunk program).
+- **A120/A122** compiled-overlap donation hazards (``verify_overlap_plan``):
+  aliased residual carry slots, units that cannot retire inside their stage
+  window (a donated carry read after its emission window).
+- **A130-A132** Pallas-ring static accounting (``verify_hop_trace``):
+  per-hop semaphore signal/wait balance (sems must drain to zero at kernel
+  exit), slot capacity vs the in-flight hop window, and a VMEM slot-buffer
+  budget estimate.
+
+Armed by ``MLSL_VERIFY=1`` at commit (``run_commit_verify``) and by
+``python -m mlsl_tpu.analysis --graph``. Findings land in the shared
+diagnostic format (analysis/diagnostics.py), the ``ANALYSIS`` stats line,
+and trace instants; ``MLSL_VERIFY_SEVERITY`` picks raise-vs-warn.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import FrozenSet, List, Optional, Set
+
+from mlsl_tpu.analysis.diagnostics import Report, record
+from mlsl_tpu.log import MLSLError, log_warning
+from mlsl_tpu.types import CompressionType
+
+#: worst-case concurrent in-flight collective programs the backend tolerates.
+#: XLA:CPU's thread-pool rendezvous wedges past ~dozens of concurrently
+#: dispatched SPMD programs (measured in PR 2's bucket bench; the hang class
+#: in KNOWN_FAILURES.md); real TPUs stream launches and tolerate far more.
+INFLIGHT_BUDGET = {"cpu": 32}
+INFLIGHT_BUDGET_DEFAULT = 512
+
+#: VMEM budget (bytes) for the pallas-ring slot-buffer estimate (A132): a
+#: conservative per-core figure — the kernel's comm slots, travelling
+#: accumulator, and prefetch buffers must fit with headroom for the codec.
+PALLAS_VMEM_BUDGET = 16 * 1024 * 1024
+
+
+# ---------------------------------------------------------------------------
+# Group overlap model
+# ---------------------------------------------------------------------------
+
+
+def _instances(group) -> Set[FrozenSet[int]]:
+    """The group's instance partition: the set of member-world-rank sets."""
+    from mlsl_tpu.comm.collectives import _member_world_table
+
+    tbl = _member_world_table(group)
+    return {frozenset(int(v) for v in row) for row in tbl}
+
+
+def _partitions_conflict(i1, i2) -> bool:
+    if i1 == i2:
+        return False
+    return any(a != b and a & b for a in i1 for b in i2)
+
+
+def groups_conflict(g1, g2, _cache: Optional[dict] = None) -> bool:
+    """True when the two groups' instance partitions differ AND intersect:
+    a rank-dependent dispatch-order inversion between collectives on such
+    groups is the cross-replica deadlock (two instances progress
+    independently while sharing members). Identical partitions are safe —
+    every member sees both collectives in its own (single) order.
+
+    ``_cache`` (id(group) -> partition) amortizes the member-table walk
+    across one verify run: the A101 scan compares O(L^2) request pairs on
+    a graph whose few distinct groups repeat across every layer."""
+    if getattr(g1, "is_self", False) or getattr(g2, "is_self", False):
+        return False
+    if _cache is None:
+        _cache = {}
+    i1 = _cache.get(id(g1))
+    if i1 is None:
+        i1 = _cache[id(g1)] = _instances(g1)
+    i2 = _cache.get(id(g2))
+    if i2 is None:
+        i2 = _cache[id(g2)] = _instances(g2)
+    return _partitions_conflict(i1, i2)
+
+
+# ---------------------------------------------------------------------------
+# The committed-graph walk
+# ---------------------------------------------------------------------------
+
+
+def _chunk_counts(req) -> List[int]:
+    """Element count of each independently dispatched chunk program."""
+    d = req.desc
+    out = []
+    for sl in req._chunk_slices:
+        if sl == slice(None):
+            out.append(d.count)
+        else:
+            out.append(int(sl.stop) - int(sl.start))
+    return out or [d.count]
+
+
+def _programs(req) -> int:
+    return max(1, len(req._chunk_slices))
+
+
+def _backward_entities(session) -> List[tuple]:
+    """The backward dispatch window, in issue order (newest gradient first):
+    one entry per dispatched entity — ``('bucket', bucket, anchor)`` once per
+    coalesced bucket, ``('req', request, anchor)`` for individual sets."""
+    out: List[tuple] = []
+    seen_buckets: Set[int] = set()
+    for op in reversed(session.operations):
+        for ps in reversed(op.parameter_sets):
+            if not ps.need_comm or ps.grad_req is None:
+                continue
+            anchor = f"graph:{op.name}/ps{ps.param_index}"
+            b = ps.bucket
+            if b is not None:
+                if id(b) not in seen_buckets:
+                    seen_buckets.add(id(b))
+                    out.append(("bucket", b, f"graph:{b.req.name}"))
+            else:
+                out.append(("req", ps.grad_req, anchor))
+    return out
+
+
+def _inc_entities(session) -> List[tuple]:
+    out: List[tuple] = []
+    seen: Set[int] = set()
+    for op in session.operations:
+        for ps in op.parameter_sets:
+            if not ps.need_comm or not ps.distributed_update:
+                continue
+            anchor = f"graph:{op.name}/ps{ps.param_index}/inc"
+            b = ps.inc_bucket
+            if b is not None:
+                if id(b) not in seen:
+                    seen.add(id(b))
+                    out.append(("bucket", b, f"graph:{b.req.name}"))
+            elif ps.inc_req is not None:
+                out.append(("req", ps.inc_req, anchor))
+    return out
+
+
+def _entity_programs(kind: str, ent) -> int:
+    """Worst-case concurrent programs one entity can put in flight: a bucket
+    either dispatches its coalesced request OR (early-Wait fallback) every
+    member's individual request — the worst case is the larger."""
+    if kind == "req":
+        return _programs(ent)
+    coalesced = _programs(ent.req)
+    fallback = sum(
+        _programs(getattr(ps, ent.req_attr)) for ps in ent.members
+        if getattr(ps, ent.req_attr) is not None
+    )
+    return max(coalesced, fallback)
+
+
+def _entity_reqs(kind: str, ent) -> List[tuple]:
+    """(request, anchor) pairs an entity can dispatch (bucket: the coalesced
+    request AND the members' fallbacks — both are reachable paths)."""
+    if kind == "req":
+        return [(ent, None)]
+    out = [(ent.req, None)]
+    for ps in ent.members:
+        r = getattr(ps, ent.req_attr)
+        if r is not None:
+            out.append((r, None))
+    return out
+
+
+def _platform(session) -> str:
+    for op in session.operations:
+        if op.distribution is not None:
+            mesh = op.distribution.topology.mesh
+            return mesh.devices.flat[0].platform
+    return "cpu"
+
+
+def verify_session(session, config=None) -> Report:
+    """Statically verify one committed session's collective plan."""
+    rep = Report("plan")
+    cfg = config if config is not None else session.env.config
+    back = _backward_entities(session)
+    inc = _inc_entities(session)
+
+    _check_inflight(rep, session, back, inc)
+    _check_issue_order(rep, cfg, back)
+    for kind, ent, anchor in back + inc:
+        if kind == "bucket":
+            _check_bucket_geometry(rep, ent, cfg, anchor)
+        for req, _ in _entity_reqs(kind, ent):
+            _check_request(rep, req, cfg,
+                           anchor if kind == "req" else f"{anchor}/member")
+    # activation edges dispatch sequentially (start -> wait per edge); their
+    # requests still carry geometry/EF invariants worth pinning
+    for op in session.operations:
+        for act in list(op.inputs) + list(op.outputs):
+            r = getattr(act, "comm_req", None)
+            if r is not None and r.is_setup:
+                _check_request(rep, r, cfg, f"graph:{op.name}/act")
+    return rep
+
+
+def _check_inflight(rep: Report, session, back, inc) -> None:
+    platform = _platform(session)
+    budget = INFLIGHT_BUDGET.get(platform, INFLIGHT_BUDGET_DEFAULT)
+    for window, entities in (("backward", back), ("increment", inc)):
+        n = sum(_entity_programs(k, e) for k, e, _ in entities)
+        if n > budget:
+            rep.add("MLSL-A102",
+                    f"{window} window can put {n} collective programs in "
+                    f"flight concurrently; the {platform} backend budget is "
+                    f"{budget} (the rendezvous wedge class — raise "
+                    "MLSL_GRAD_BUCKET_MB or window the dispatches)",
+                    f"graph:{window}")
+        elif n > budget // 2:
+            rep.add("MLSL-A103",
+                    f"{window} window reaches {n}/{budget} of the {platform} "
+                    "in-flight collective budget", f"graph:{window}")
+
+
+def _check_issue_order(rep: Report, cfg, back) -> None:
+    """A101: deferral-window order inversion on conflicting groups."""
+    if not getattr(cfg, "msg_priority", False):
+        return
+    threshold = getattr(cfg, "msg_priority_threshold", 0)
+    open_deferred: List[tuple] = []
+    cache: dict = {}  # one partition computation per distinct group
+    for kind, ent, anchor in back:
+        for req, _ in _entity_reqs(kind, ent):
+            d = req.desc
+            if d.kind == "barrier":
+                open_deferred.clear()  # a barrier flushes the stack
+                continue
+            if req._payload > threshold:
+                open_deferred.append((req, anchor))
+                continue
+            for dref, danchor in open_deferred:
+                if groups_conflict(dref.desc.group, d.group, cache):
+                    rep.add(
+                        "MLSL-A101",
+                        f"immediate dispatch of '{req.name or req.uid}' can "
+                        f"land before OR after the deferred flush of "
+                        f"'{dref.name or dref.uid}' (flush window "
+                        f"{cfg.msg_priority_flush_ms}ms) while their groups' "
+                        "instance partitions overlap but differ — wire "
+                        "order becomes rank-dependent, the cross-replica "
+                        "deadlock", anchor)
+
+
+def _expected_err_len(req, cfg) -> Optional[List[int]]:
+    """Per-chunk expected error-feedback length for a compressed request, or
+    None when the wire family owns its own layout (top-k, custom codec)."""
+    d = req.desc
+    if d.compression != CompressionType.QUANTIZATION:
+        return None
+    if req.algo not in ("quant_ring", "pallas_ring"):
+        return None
+    block = getattr(cfg, "quant_block_elems", 256)
+    out = []
+    for n in _chunk_counts(req):
+        if req.algo == "pallas_ring":
+            from mlsl_tpu.ops import ring_kernels as rk
+
+            out.append(rk.quant_geometry(d.kind, d.group, n, block)[3])
+        else:
+            from mlsl_tpu.comm.quant_ring import ring_geometry
+
+            out.append(ring_geometry(d.kind, d.group, n, block)[3])
+    return out
+
+
+def _check_request(rep: Report, req, cfg, anchor: str) -> None:
+    """Per-request invariants: EF geometry (A112) and the snapshot/rewind
+    machinery's static preconditions (A121), plus pallas accounting."""
+    d = req.desc
+    compressed = req._quant_fn is not None or req._quant_fns is not None
+    if compressed:
+        # -- A121: every retry/degrade path rewinds from a snapshot whose
+        # geometry covers every chunk program (request._ef_restore /
+        # _take_residuals preconditions)
+        geoms = req._degrade_geoms
+        chunks = _chunk_counts(req)
+        if req._err_layout not in ("ring", "flat"):
+            rep.add("MLSL-A121",
+                    f"compressed request '{req.name or req.uid}' has no "
+                    "_err_layout: the degrade flush cannot invert its "
+                    "residual", anchor)
+        if geoms is None or len(geoms) != len(chunks):
+            rep.add("MLSL-A121",
+                    f"degrade geometry of '{req.name or req.uid}' covers "
+                    f"{0 if geoms is None else len(geoms)} chunk(s) but the "
+                    f"request dispatches {len(chunks)}: a degraded retry "
+                    "would flush the wrong residual slices", anchor)
+        else:
+            for (n, _el), c in zip(geoms, chunks):
+                if int(n) != int(c):
+                    rep.add("MLSL-A121",
+                            f"degrade geometry count {n} != chunk count {c} "
+                            f"on '{req.name or req.uid}'", anchor)
+        # -- A112: EF length vs the ring geometry
+        expected = _expected_err_len(req, cfg)
+        if expected is not None:
+            actual = (list(req._err_lens) if req._err_lens is not None
+                      else [req._err_len])
+            if len(actual) == len(expected):
+                for a, e in zip(actual, expected):
+                    if int(a) != int(e):
+                        rep.add("MLSL-A112",
+                                f"err_len {a} != quant-ring geometry {e} on "
+                                f"'{req.name or req.uid}' (block="
+                                f"{getattr(cfg, 'quant_block_elems', '?')})",
+                                anchor)
+            else:
+                rep.add("MLSL-A112",
+                        f"'{req.name or req.uid}' carries {len(actual)} "
+                        f"residual length(s) for {len(expected)} chunk "
+                        "program(s)", anchor)
+    if req.algo == "pallas_ring":
+        _check_pallas_request(rep, req, cfg, anchor)
+
+
+# ---------------------------------------------------------------------------
+# Bucket geometry (A110/A111/A113 + the request-level A112 above)
+# ---------------------------------------------------------------------------
+
+
+def _check_bucket_geometry(rep: Report, bucket, cfg, anchor: str) -> None:
+    if bucket.compression != CompressionType.QUANTIZATION:
+        return
+    from mlsl_tpu.comm.quant_ring import ring_aligned_rc
+
+    block = getattr(cfg, "quant_block_elems", 256)
+    d = bucket.req.desc
+    group = d.group
+    g = 1 if group.is_self else group.size
+    for i, (ps, off, slot) in enumerate(
+            zip(bucket.members, bucket.offsets, bucket.slots)):
+        if off % block or slot % block:
+            req = getattr(ps, bucket.req_attr, None)
+            rep.add("MLSL-A110",
+                    f"member '{getattr(req, 'name', None) or i}' slot "
+                    f"[{off}, {off + slot}) is not on the {block}-elem quant "
+                    "block grid: a block would straddle members and break "
+                    "per-member scale locality", f"{anchor}/member{i}")
+    if bucket.kind == "reduce_scatter":
+        recv = d.count // g
+        if recv % block:
+            rep.add("MLSL-A113",
+                    f"ZeRO-1 shard length {recv} is not block-aligned "
+                    f"(block={block}): a quant block straddles the shard "
+                    "boundary", anchor)
+        if ring_aligned_rc(group, recv, block) != recv:
+            rep.add("MLSL-A111",
+                    f"per-rank shard {recv} is not ring-chunk aligned "
+                    "(quant_ring.ring_aligned_rc): hops would pad "
+                    "internally and miss the packed-scale kernel path",
+                    anchor)
+    else:
+        rc = -(-d.count // g)
+        if ring_aligned_rc(group, rc, block) != rc or d.count != g * rc:
+            rep.add("MLSL-A111",
+                    f"coalesced total {d.count} (per-rank slice {rc}) is "
+                    "not ring-chunk aligned (quant_ring.ring_aligned_rc)",
+                    anchor)
+
+
+# ---------------------------------------------------------------------------
+# Compiled-overlap plan (A120/A122, + A112 via the shared geometry)
+# ---------------------------------------------------------------------------
+
+
+def verify_overlap_plan(plan, block: Optional[int] = None) -> Report:
+    """Statically verify a comm/overlap.OverlapPlan + its staged schedule:
+    donated-carry aliasing (A120), stage-window retirement (A122), and the
+    residual geometry the donated EF carry must match (A112)."""
+    rep = Report("plan")
+    seen_keys: Set[str] = set()
+    for u in plan.units:
+        anchor = f"graph:overlap/{'+'.join(u.names)}"
+        if u.key is not None:
+            if u.key in seen_keys:
+                rep.add("MLSL-A120",
+                        f"residual carry key '{u.key}' aliased by two "
+                        "units: both would donate and read the same EF "
+                        "slot", anchor)
+            seen_keys.add(u.key)
+            if plan.err_lens.get(u.key) != u.err_len:
+                rep.add("MLSL-A120",
+                        f"plan residual table says {plan.err_lens.get(u.key)}"
+                        f" elems for '{u.key}' but the unit carries "
+                        f"{u.err_len}: the donated carry would be read at "
+                        "the wrong geometry", anchor)
+            if block is not None:
+                from mlsl_tpu.comm.quant_ring import ring_geometry
+
+                exp = ring_geometry("allreduce", plan.group, u.total,
+                                    block)[3]
+                if exp != u.err_len:
+                    rep.add("MLSL-A112",
+                            f"unit err_len {u.err_len} != quant-ring "
+                            f"geometry {exp} (block={block})", anchor)
+        need = -(-u.nphases // plan.stages) if u.nphases else 0
+        if u.nphases and u.per_tick < max(1, need):
+            rep.add("MLSL-A122",
+                    f"unit advances {u.per_tick} phase(s)/tick but needs "
+                    f"{need} to retire inside its {plan.stages}-stage "
+                    "window: its carry stays live past the stage boundary",
+                    anchor)
+    _simulate_schedule(rep, plan)
+    return rep
+
+
+def _simulate_schedule(rep: Report, plan) -> None:
+    """Integer replay of overlap.emit_schedule's tick loop: every unit must
+    retire (all phases emitted exactly once) within the bounded tick budget,
+    or its donated carry outlives the emission window (A120)."""
+    inflight: List[list] = []   # [unit, phase_idx]
+    retired: Set[int] = set()
+    total_ticks = 0
+    budget = len(plan.units) + sum(u.nphases for u in plan.units) + \
+        plan.stages + 4
+
+    def tick():
+        nonlocal total_ticks
+        total_ticks += 1
+        for ent in inflight:
+            u = ent[0]
+            for _ in range(max(0, u.per_tick)):
+                if ent[1] < u.nphases:
+                    ent[1] += 1
+        for ent in [e for e in inflight if e[1] >= e[0].nphases]:
+            inflight.remove(ent)
+            retired.add(ent[0].index)
+
+    for u in plan.units:
+        inflight.append([u, 0])
+        tick()
+    while inflight and total_ticks < budget:
+        tick()
+    for ent in inflight:
+        rep.add("MLSL-A120",
+                f"unit {'+'.join(ent[0].names)} never retires "
+                f"({ent[1]}/{ent[0].nphases} phases after {total_ticks} "
+                "ticks): its donated carry is read after the emission "
+                "window", f"graph:overlap/{'+'.join(ent[0].names)}")
+
+
+# ---------------------------------------------------------------------------
+# Pallas-ring static accounting (A130/A131/A132)
+# ---------------------------------------------------------------------------
+
+
+def verify_hop_trace(events: List[tuple], *, slots: int, ndirs: int,
+                     total_hops: int, anchor: str = "graph:pallas_ring",
+                     report: Optional[Report] = None) -> Report:
+    """Check one kernel build's semaphore accounting. ``events`` is the
+    ordered ``('wait', dir, hop)`` / ``('free', dir, use_hop)`` trace
+    (ops/ring_kernels.static_accounting mirrors the kernel's slot_wait/
+    slot_free emission). Invariants: every wait's matching free (of hop
+    ``h - slots``) precedes it in program order — the peer's symmetric SPMD
+    program emits that signal strictly before this rank can block on it —
+    and every semaphore drains to zero at kernel exit (signals == waits per
+    direction)."""
+    rep = report if report is not None else Report("plan")
+    if slots < 2:
+        rep.add("MLSL-A131",
+                f"{slots} comm slot(s): the ring needs a double buffer — "
+                "hop h's send would overwrite the slot hop h-1 is still "
+                "accumulating from", anchor)
+    freed: List[Set[int]] = [set() for _ in range(ndirs)]
+    waits = [0] * ndirs
+    frees = [0] * ndirs
+    for ev in events:
+        kind, d, hop = ev[0], int(ev[1]), int(ev[2])
+        if kind == "free":
+            frees[d] += 1
+            freed[d].add(hop)
+        elif kind == "wait":
+            waits[d] += 1
+            need = hop - slots
+            if need < 0 or need not in freed[d]:
+                rep.add("MLSL-A130",
+                        f"hop {hop} (dir {d}) waits on slot {hop % slots} "
+                        f"but hop {need}'s free signal is not emitted "
+                        "before it: the capacity semaphore deadlocks",
+                        anchor)
+    for d in range(ndirs):
+        if waits[d] != frees[d]:
+            rep.add("MLSL-A130",
+                    f"dir {d}: {frees[d]} free signal(s) vs {waits[d]} "
+                    "wait(s) — the capacity semaphore does not drain to "
+                    "zero at kernel exit", anchor)
+    return rep
+
+
+def _check_pallas_request(rep: Report, req, cfg, anchor: str) -> None:
+    from mlsl_tpu.ops import ring_kernels as rk
+
+    d = req.desc
+    slots = rk.env_slots(getattr(cfg, "pallas_ring_slots", None))
+    bidir = rk.env_bidir(getattr(cfg, "pallas_ring_bidir", None))
+    quantized = d.compression == CompressionType.QUANTIZATION
+    block = getattr(cfg, "quant_block_elems", 256)
+    for n in _chunk_counts(req):
+        if quantized:
+            g, _, chunk, _ = rk.quant_geometry(d.kind, d.group, n, block)
+        else:
+            g, _, chunk = rk.dense_geometry(d.kind, d.group, n)
+        if g <= 1:
+            continue
+        mode = d.kind
+        events, total_hops, ndirs = rk.static_accounting(
+            mode, g, slots, bidir=bidir
+        )
+        verify_hop_trace(events, slots=slots, ndirs=ndirs,
+                         total_hops=total_hops,
+                         anchor=f"{anchor}/pallas", report=rep)
+        # VMEM estimate: travelling accumulator + local prefetch + send
+        # image (f32-ish working set ~3 chunks) plus (slots+1) wire-sized
+        # slot buffers per direction-split payload
+        if quantized:
+            wire = chunk + 4 * (chunk // max(block, 1))
+        else:
+            wire = chunk * 4
+        est = 3 * 4 * chunk + (slots + 1) * wire
+        if est > PALLAS_VMEM_BUDGET:
+            rep.add("MLSL-A132",
+                    f"estimated VMEM working set {est / 2**20:.1f} MiB "
+                    f"(chunk {chunk} elems x {slots} slots) exceeds the "
+                    f"{PALLAS_VMEM_BUDGET // 2**20} MiB budget: shrink the "
+                    "chunk (MLSL_LARGE_MSG_SIZE_MB) or the slot count",
+                    f"{anchor}/pallas")
+
+
+# ---------------------------------------------------------------------------
+# The commit hook
+# ---------------------------------------------------------------------------
+
+
+def enforce(rep: Report, cfg, what: str, t0: Optional[float] = None) -> Report:
+    """The one severity gate every MLSL_VERIFY entry point shares: record
+    the verdict (stats line, trace instants, supervisor.status 'analysis'
+    key), log each finding, then apply ``MLSL_VERIFY_SEVERITY`` — ``error``
+    (default) raises MLSLError naming every error-severity code; ``warn``
+    logs and continues."""
+    record(rep, time.perf_counter() - t0 if t0 is not None else 0.0)
+    for d in rep.diagnostics:
+        log_warning("MLSL_VERIFY: %s", d.format())
+    if rep.errors and getattr(cfg, "verify_severity", "error") == "error":
+        raise MLSLError(
+            f"MLSL_VERIFY rejected the {what}: "
+            + "; ".join(d.format() for d in rep.errors)
+            + " (set MLSL_VERIFY_SEVERITY=warn to log instead)"
+        )
+    return rep
+
+
+def run_commit_verify(session) -> Report:
+    """Session.commit's MLSL_VERIFY=1 entry point."""
+    cfg = session.env.config
+    t0 = time.perf_counter()
+    return enforce(verify_session(session, cfg), cfg,
+                   "collective plan at commit", t0)

@@ -26,7 +26,7 @@ src/comm_handoff.cpp:491-564). Design:
 from __future__ import annotations
 
 import functools
-from typing import Callable, Optional, Sequence, Tuple
+from typing import Callable, Sequence, Tuple
 
 import numpy as np
 import jax

@@ -662,4 +662,22 @@ def engine_for_trainer(trainer, config) -> Optional[OverlapEngine]:
         log_debug("overlap: TOPK compression rides the host path")
         return None
     plan = build_plan(group, layers, config)
+    if getattr(config, "verify", False):
+        # MLSL_VERIFY=1 covers the compiled-overlap plan too: the donated
+        # carry/EF geometry is fixed here, before the step program traces —
+        # the same commit-time gate contract as Session.commit
+        # (analysis/plan.py A112/A120/A122; severity per
+        # MLSL_VERIFY_SEVERITY, enforced by the shared plan.enforce gate)
+        import time
+
+        from mlsl_tpu.analysis import plan as plan_verifier
+
+        t0 = time.perf_counter()
+        plan_verifier.enforce(
+            plan_verifier.verify_overlap_plan(
+                plan, block=getattr(config, "quant_block_elems", None)
+                if plan.quant_units else None,
+            ),
+            config, "compiled-overlap plan", t0,
+        )
     return OverlapEngine(trainer, plan)

@@ -22,14 +22,11 @@ from __future__ import annotations
 import functools
 from typing import Callable, Tuple
 
-import jax
 import jax.numpy as jnp
 from jax import lax
 
-from jax.sharding import PartitionSpec as P
-
-from mlsl_tpu.comm.mesh import NUM_GRID_AXES, ProcessGroup
-from mlsl_tpu.comm.collectives import _BUF_SPEC, _axis_sizes, sizes_prod
+from mlsl_tpu.comm.mesh import ProcessGroup
+from mlsl_tpu.comm.collectives import _axis_sizes
 from mlsl_tpu.log import mlsl_assert
 from mlsl_tpu.ops import quant_kernels as qk
 

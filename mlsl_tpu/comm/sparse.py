@@ -19,12 +19,11 @@ from __future__ import annotations
 
 from typing import Callable, Optional, Tuple
 
-import jax
 import jax.numpy as jnp
 from jax import lax
 
-from mlsl_tpu.comm.collectives import _BUF_SPEC, _gather_group, smap
-from mlsl_tpu.comm.mesh import NUM_GRID_AXES, ProcessGroup
+from mlsl_tpu.comm.collectives import _gather_group
+from mlsl_tpu.comm.mesh import ProcessGroup
 from mlsl_tpu.log import mlsl_assert
 
 _cache: dict = {}

@@ -230,6 +230,18 @@ class Config:
     # Smaller blocks localize a corruption better but grow the on-device
     # fingerprint vector (total_elems / block int32s).
     sentinel_block: int = 4096          # MLSL_SENTINEL_BLOCK
+    # --- static analysis (mlsl_tpu.analysis; docs/TUNING.md §16) ---
+    # Commit-time collective-plan verifier: MLSL_VERIFY=1 walks the
+    # committed graph at Session.commit and statically checks issue-order
+    # consistency, in-flight budgets, quantization geometry, EF
+    # snapshot/rewind pairing, and Pallas-ring semaphore accounting
+    # (analysis/plan.py; findings use the stable MLSL-Axxx codes).
+    verify: bool = False                # MLSL_VERIFY
+    # What an error-severity finding does at commit: 'error' (default)
+    # raises MLSLError naming every code; 'warn' logs the findings and
+    # commits anyway (both record the verdict in supervisor.status()['analysis']
+    # and the ANALYSIS stats line).
+    verify_severity: str = "error"      # MLSL_VERIFY_SEVERITY
     # Fault-injection spec; parsed by mlsl_tpu.chaos
     # (site:kind[=v][@after][xN][%p], comma-separated). Kept here for
     # discoverability/printing only.
@@ -391,6 +403,11 @@ class Config:
             self.feed_retries >= 0,
             "MLSL_FEED_RETRIES must be >= 0 (got %d)", self.feed_retries,
         )
+        mlsl_assert(
+            self.verify_severity in ("error", "warn"),
+            "MLSL_VERIFY_SEVERITY must be 'error' or 'warn' (got %r)",
+            self.verify_severity,
+        )
 
     @staticmethod
     def from_env() -> "Config":
@@ -462,6 +479,10 @@ class Config:
         c.ckpt_retry_backoff_s = _env_float(
             "MLSL_CKPT_RETRY_BACKOFF_S", c.ckpt_retry_backoff_s
         )
+        c.verify = _env_bool("MLSL_VERIFY", c.verify)
+        c.verify_severity = os.environ.get(
+            "MLSL_VERIFY_SEVERITY", c.verify_severity
+        ).strip().lower() or c.verify_severity
         c.chaos_spec = os.environ.get("MLSL_CHAOS", c.chaos_spec)
         c.trace = _env_bool("MLSL_TRACE", c.trace)
         c.trace_dir = os.environ.get("MLSL_TRACE_DIR", c.trace_dir)

@@ -28,12 +28,11 @@ from mlsl_tpu.comm.mesh import (
     GRID_AXES,
     Topology,
     ProcessGroup,
-    REPLICA_AXIS,
     DATA_AXIS,
     SEQ_AXIS,
     MODEL_AXIS,
 )
-from mlsl_tpu.comm.request import CommDesc, CommRequest, ComputeType
+from mlsl_tpu.comm.request import CommDesc, CommRequest
 from mlsl_tpu.log import mlsl_assert
 from mlsl_tpu.types import (
     DataType, GroupType, ReductionType, dtype_size, jnp_dtype,
@@ -461,8 +460,6 @@ class Distribution:
         )
 
     def barrier(self, group_type) -> None:
-        import jax.numpy as jnp
-
         g = self._group(group_type)
         req = CommRequest(
             CommDesc("barrier", g, 1, DataType.FLOAT), self.env.dispatcher

@@ -19,7 +19,6 @@ import jax
 
 from mlsl_tpu import sysinfo
 from mlsl_tpu.config import Config
-from mlsl_tpu.comm.mesh import Topology
 from mlsl_tpu.comm.request import CommRequest, Dispatcher, RequestStorage
 from mlsl_tpu.log import mlsl_assert, set_log_level
 from mlsl_tpu.types import DataType, QuantParams, jnp_dtype

@@ -303,6 +303,15 @@ class Session:
             from mlsl_tpu.core.bucketing import build_buckets
 
             build_buckets(self, cfg.grad_bucket_mb)
+        if cfg is not None and getattr(cfg, "verify", False):
+            # MLSL_VERIFY=1: statically verify the collective plan NOW —
+            # after buckets formed (their geometry is checked) and before
+            # the precompile warm spends compile time on a plan the
+            # verifier may reject (mlsl_tpu/analysis/plan.py; severity
+            # behavior under MLSL_VERIFY_SEVERITY)
+            from mlsl_tpu.analysis.plan import run_commit_verify
+
+            run_commit_verify(self)
         if cfg is not None and cfg.precompile:
             self.precompile_collectives()
         self.stats.initialize()

@@ -15,6 +15,11 @@ The scaling design (SURVEY.md §2 parallelism table + §5.7):
 Compute is bf16 on the MXU; params and reductions f32.
 """
 
+# mlsl-lint: disable-file=A201 -- the hybrid TP/SP forward embeds its
+# activation reductions in-graph by design (the needReduce -> AllReduce
+# cases above); they fuse with the surrounding matmuls and are not request
+# collectives the engine could route
+
 from __future__ import annotations
 
 import dataclasses

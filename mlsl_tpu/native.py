@@ -148,5 +148,5 @@ class NativeScheduler:
         try:
             if getattr(self, "_h", None) and self._lib is not None:
                 self._lib.mlsl_sched_destroy(self._h)
-        except Exception:
-            pass
+        except Exception:  # mlsl-lint: disable=A205 -- interpreter teardown:
+            pass           # __del__ may run after the lib is unloaded

@@ -273,4 +273,20 @@ def summarize(doc: dict, top: int = 10) -> str:
             counts[key] = counts.get(key, 0) + 1
         for (cat, name), n in sorted(counts.items()):
             lines.append(f"  {cat}:{name} x{n}")
+    # static-analysis findings ride the trace as instants (mlsl_tpu.analysis
+    # record()); the aggregated count above hides WHICH invariant fired, so
+    # list them individually — a rejected plan's codes belong in the same
+    # summary an operator reads for the stall it would have caused
+    findings = [e for e in instants if e["name"] == "analysis.finding"]
+    if findings:
+        lines.append("")
+        lines.append("analysis findings:")
+        for e in findings[:top]:
+            a = e.get("args") or {}
+            lines.append(
+                f"  {a.get('severity', '?'):<5} {a.get('code', '?')} "
+                f"@ {a.get('anchor', '?')}"
+            )
+        if len(findings) > top:
+            lines.append(f"  ... {len(findings) - top} more")
     return "\n".join(lines)

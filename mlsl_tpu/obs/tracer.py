@@ -40,7 +40,7 @@ import collections
 import os
 import threading
 import time
-from typing import Dict, List, Optional, Tuple
+from typing import Dict, List, Optional
 
 ENV_TRACE = "MLSL_TRACE"
 ENV_DIR = "MLSL_TRACE_DIR"

@@ -19,9 +19,7 @@ import functools
 
 import jax
 import jax.numpy as jnp
-from jax import lax
 from jax.experimental import pallas as pl
-from jax.experimental.pallas import tpu as pltpu
 
 ROW_TILE = 32  # int8 min sublane count
 PACK_ROWS = 1024  # rows per grid step on the packed-scale path: the scale

@@ -272,6 +272,49 @@ def describe_plan(g: int, chunk_elems: int, quantized: bool, block: int,
             f"slots={slots}{' bidir' if bidir else ''}{tail}")
 
 
+def static_accounting(mode: str, g: int, slots: int, *, bidir: bool = False):
+    """-> (events, total_hops, ndirs): the ordered capacity-semaphore event
+    trace ONE kernel build emits — ``('wait', dir, hop)`` for slot_wait,
+    ``('free', dir, use_hop)`` for slot_free — mirroring the guards in
+    ``_ring_kernel_factory`` exactly (slot_wait fires for hops >= slots;
+    slot_free only when a later hop reuses the slot, RS slots freed the hop
+    they arrive, AG slots one hop later because the forward re-reads them).
+
+    This is the statically-balanced accounting contract the kernel's
+    docstrings promise ("sems drain to zero"): the plan verifier
+    (mlsl_tpu/analysis/plan.py, MLSL-A130/A131) replays this trace and
+    checks that every wait's matching free precedes it in program order and
+    that signals == waits per direction at kernel exit. Kept HERE, next to
+    the kernel, so the mirror and the emission evolve together — a change
+    to slot_wait/slot_free that forgets this function fails the verifier's
+    healthy-graph sweep."""
+    hops = int(g) - 1
+    total_hops = hops * (2 if mode == "allreduce" else 1)
+    ndirs = 2 if bidir else 1
+    events = []
+
+    def slot_wait(h):
+        if h >= slots:
+            for d in range(ndirs):
+                events.append(("wait", d, h))
+
+    def slot_free(use_h):
+        if use_h + slots <= total_hops - 1:
+            for d in range(ndirs):
+                events.append(("free", d, use_h))
+
+    for t in range(hops):          # phase 1: ring reduce-scatter
+        slot_wait(t)
+        slot_free(t)               # an RS slot is consumed the hop it arrives
+    if mode == "allreduce":        # phase 2: ring all-gather
+        for k in range(hops):
+            h = hops + k
+            slot_wait(h)
+            if k >= 1:
+                slot_free(h - 1)   # an AG slot is re-read by the forward
+    return events, total_hops, ndirs
+
+
 def _ring_tables(group: ProcessGroup):
     """Per-world-rank ring addressing: ``(pos, right, left)`` int32 arrays of
     shape (W,) — this member's group position and its ring neighbors' WORLD

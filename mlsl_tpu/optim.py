@@ -308,8 +308,11 @@ def build_adafactor_inc_fn(
         col_part = jax.ops.segment_sum(
             gsq * fact_mask, col_ids, num_segments=n_col + 1
         )
+        # mlsl-lint: disable=A201 -- factored second-moment statistics are
+        # optimizer-internal in-graph math, not a request collective the
+        # engine could route (they fuse with the segment sums around them)
         row_sums = lax.psum(row_part, grad_axes)
-        col_sums = lax.psum(col_part, grad_axes)
+        col_sums = lax.psum(col_part, grad_axes)  # mlsl-lint: disable=A201
         v_row = beta * local["v_row"] + (1.0 - beta) * row_sums / row_div
         v_col = beta * local["v_col"] + (1.0 - beta) * col_sums / col_div
         if n_rowmean:
@@ -339,7 +342,7 @@ def build_adafactor_inc_fn(
 
         # --- clip_by_block_rms over each REAL leaf -------------------------
         if cfg.clipping_threshold is not None:
-            leaf_sq = lax.psum(
+            leaf_sq = lax.psum(  # mlsl-lint: disable=A201 -- see above
                 jax.ops.segment_sum(u * u, leaf_ids, num_segments=n_leaf + 1),
                 grad_axes,
             )

@@ -14,6 +14,10 @@ with stage_fn(params, x) -> y applied at every stage (all stages share the fn sh
 per-stage weights differ — the usual homogeneous-blocks pipeline).
 """
 
+# mlsl-lint: disable-file=A201 -- stage->stage ppermute IS this module's
+# primitive (the SendRecvList realization): it must stay a raw in-graph
+# collective so jax.grad transposes it into the drain-fill backward
+
 from __future__ import annotations
 
 from typing import Callable

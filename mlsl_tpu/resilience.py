@@ -252,7 +252,10 @@ class FaultTolerantLoop:
         try:
             cls = supervisor.classify(error)
             states = {
-                name: st["state"] for name, st in supervisor.status().items()
+                # breaker-shaped entries only: 'analysis' (verdict-shaped)
+                # has its own ANALYSIS stats line and is not a breaker
+                name: st["state"]
+                for name, st in supervisor.status().items() if "state" in st
             }
             log_error(
                 "recovery ladder exhausted at step %d (%s; %d/%d recoveries "

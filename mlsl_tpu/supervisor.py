@@ -345,6 +345,13 @@ def status() -> Dict[str, dict]:
     from mlsl_tpu import sentinel as _sentinel
 
     out["sentinel"] = _sentinel.status()
+    # static-analysis verdicts (mlsl_tpu.analysis): the last MLSL_VERIFY
+    # plan verdict and lint run, so dashboards see whether the committed
+    # plan passed verification (lazy + dependency-light for the same
+    # reason as the sentinel)
+    from mlsl_tpu.analysis import diagnostics as _analysis
+
+    out["analysis"] = _analysis.status()
     return out
 
 

@@ -22,7 +22,7 @@ import json
 import os
 from typing import List, Optional, Tuple
 
-from mlsl_tpu.log import MLSLError, log_warning
+from mlsl_tpu.log import MLSLError
 
 PROFILE_VERSION = 1
 DEFAULT_PROFILE_FILE = "mlsl_tune_profile.json"

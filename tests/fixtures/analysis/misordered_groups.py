@@ -1,0 +1,51 @@
+"""Known-bad fixture: the deliberately misordered two-group graph.
+
+Two overlapping process-group partitions — the world group and two 4-rank
+color subgroups — with ``MLSL_MSG_PRIORITY`` armed so the big world-group
+gradient defers (payload above the threshold) while the small subgroup
+gradient dispatches immediately. The deferred flush is released by a
+wall-clock window, so on a multi-controller mesh the two collectives' wire
+order is rank-dependent: ranks whose subgroup instance progresses first can
+enter the subgroup collective while their peers sit in the world collective
+— the classic cross-replica deadlock (NCCL's collective-ordering model).
+
+The plan verifier must reject this at commit with MLSL-A101.
+"""
+
+EXPECTED_CODE = "MLSL-A101"
+
+from mlsl_tpu.types import OpType
+
+
+def build(env):
+    """-> the committed session (commit runs with verify disarmed so the
+    test can run the verifier explicitly and pin the code)."""
+    env.config.msg_priority = True
+    env.config.msg_priority_threshold = 4096  # bytes: 1 KiB f32 x 4
+
+    n = len(env.devices)
+    colors = env.create_distribution_with_colors(
+        [p // max(n // 2, 1) for p in range(n)], [0] * n
+    )
+    world = env.create_distribution(n, 1)
+
+    s = env.create_session()
+    s.set_global_minibatch_size(max(8, n))
+
+    # registered first -> issues LAST in the backward walk (reverse order):
+    # the small immediate dispatch lands inside the big request's open
+    # deferral window
+    r0 = s.create_operation_reg_info(OpType.CC)
+    r0.set_name("sub_small")
+    r0.add_output(4, 4)
+    r0.add_parameter_set(256, 1)          # 1 KiB: under the threshold
+    s.add_operation(r0, colors)
+
+    r1 = s.create_operation_reg_info(OpType.CC)
+    r1.set_name("world_big")
+    r1.add_output(4, 4)
+    r1.add_parameter_set(4096, 1)         # 16 KiB: defers
+    s.add_operation(r1, world)
+
+    s.commit()
+    return s

@@ -1,0 +1,45 @@
+"""Known-bad fixture: the block-straddling quantized bucket layout.
+
+Builds a real coalesced quantized gradient bucket (two QUANTIZATION
+ParameterSets under MLSL_GRAD_BUCKET_MB), then shifts the second member's
+slot off the quant-block grid — the layout a packer that skipped
+``quant_kernels.block_align`` would produce. A quant block now straddles
+the member boundary, so one (int8, scale) block mixes two members'
+gradients: per-member scale locality breaks and the coalesced ring's
+numerics silently diverge from the individual rings the parity suite pins
+against (the PR 2 invariant).
+
+The plan verifier must reject this layout with MLSL-A110.
+"""
+
+EXPECTED_CODE = "MLSL-A110"
+
+from mlsl_tpu.types import CompressionType, OpType
+
+
+def build(env):
+    """-> (session, bucket): committed with a healthy layout, then tampered."""
+    env.config.grad_bucket_mb = 1  # coalesce everything below 1 MiB
+
+    n = len(env.devices)
+    dist = env.create_distribution(n, 1)
+    s = env.create_session()
+    s.set_global_minibatch_size(max(8, n))
+    ops = []
+    for i in range(2):
+        r = s.create_operation_reg_info(OpType.CC)
+        r.set_name(f"q{i}")
+        r.add_output(4, 4)
+        r.add_parameter_set(
+            2048, 1, compression_type=CompressionType.QUANTIZATION
+        )
+        ops.append(s.get_operation(s.add_operation(r, dist)))
+    s.commit()
+
+    ps = ops[0].parameter_sets[0]
+    bucket = ps.bucket
+    assert bucket is not None, "fixture precondition: the sets must coalesce"
+    # shift member 1 off the block grid (block never divides 7)
+    bucket.offsets[1] -= 7
+    bucket.slots[0] -= 7
+    return s, bucket

@@ -1,0 +1,35 @@
+"""Known-bad fixture: the unbalanced-semaphore pallas-ring variant.
+
+Starts from the real kernel's statically-balanced hop trace
+(``ops/ring_kernels.static_accounting`` — the exact slot_wait/slot_free
+emission of ``_ring_kernel_factory``) and removes the final ``free``
+signal: the kernel variant a refactor would produce if it forgot that an
+all-gather slot is read TWICE (dequant+copy-out at its own hop, then the
+forward at the next hop) and freed one hop late — the shifted
+``slot_free(h - 1)``. With that signal gone the capacity semaphore no
+longer drains to zero at kernel exit, and the next launch on the same
+core inherits a poisoned count: the wedge arrives one step later, far
+from its cause.
+
+The verifier's accounting replay must reject this trace with MLSL-A130.
+"""
+
+EXPECTED_CODE = "MLSL-A130"
+
+G = 8
+SLOTS = 2
+
+
+def build_trace():
+    """-> (events, kwargs for analysis.plan.verify_hop_trace)."""
+    from mlsl_tpu.ops import ring_kernels as rk
+
+    events, total_hops, ndirs = rk.static_accounting(
+        "allreduce", G, SLOTS
+    )
+    bad = list(events)
+    for i in range(len(bad) - 1, -1, -1):
+        if bad[i][0] == "free":
+            del bad[i]  # the forgotten shifted free of the last reused slot
+            break
+    return bad, dict(slots=SLOTS, ndirs=ndirs, total_hops=total_hops)

@@ -1,7 +1,6 @@
 """API flows not covered elsewhere: graph rebuild, color configuration, env cycles."""
 
 import numpy as np
-import pytest
 
 from mlsl_tpu.types import DataType, GroupType, OpType, ReductionType
 

@@ -7,7 +7,7 @@ import pytest
 import jax
 
 from mlsl_tpu.models.mlp import LAYERS, get_layer, init as mlp_init, loss_fn as mlp_loss
-from mlsl_tpu.types import DataType, GroupType, OpType
+from mlsl_tpu.types import OpType
 
 
 def _make_data(b=32):

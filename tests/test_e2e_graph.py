@@ -10,7 +10,7 @@ import numpy as np
 import pytest
 
 from mlsl_tpu.core.activation import pack_local, unpack_local
-from mlsl_tpu.types import CompressionType, DataType, GroupType, OpType, ReductionType
+from mlsl_tpu.types import CompressionType, OpType
 
 MB = 8          # global minibatch
 FM1, FM2 = 16, 8

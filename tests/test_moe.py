@@ -32,6 +32,7 @@ def test_moe_matches_oracle(env, ep, top_k):
 
     def body(params, x):
         out, aux = moe.moe_ffn(x, params, "model", ep, top_k=top_k)
+        # mlsl-lint: disable=A201 -- in-graph test oracle
         return out, lax.pmean(aux, "model")[None]
 
     fn = jax.jit(

@@ -4,7 +4,6 @@ import numpy as np
 import pytest
 import jax
 import jax.numpy as jnp
-from jax import lax
 from jax.sharding import PartitionSpec as P
 
 from mlsl_tpu.models.train import smap

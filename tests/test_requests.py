@@ -82,10 +82,10 @@ def test_background_progress_without_polls(env):
         dist = env.create_distribution(8, 1)
         buf = dist.make_buffer(lambda p: np.full(4, float(p + 1)), 4)
         req = dist.all_reduce(buf, 4, DataType.FLOAT, ReductionType.SUM, GroupType.DATA)
-        deadline = time.time() + 10
+        deadline = time.monotonic() + 10
         while (
             env.dispatcher.pending_count or env.dispatcher.is_in_flight(req.uid)
-        ) and time.time() < deadline:
+        ) and time.monotonic() < deadline:
             time.sleep(0.005)
         assert env.dispatcher.pending_count == 0, "progress thread never flushed"
         assert req._results, "request was not dispatched autonomously"

@@ -61,7 +61,7 @@ def test_sequence_parallel_grad_matches(env, kind):
         def body(q, k, v):
             out = fn(q, k, v, "seq", 4, causal=True)
             # per-shard partial sum; psum -> replicated scalar
-            return lax.psum(jnp.sum(out**2), "seq")[None]
+            return lax.psum(jnp.sum(out**2), "seq")[None]  # mlsl-lint: disable=A201
 
         per = smap(body, mesh, in_specs=(spec, spec, spec), out_specs=P("seq"))
         return jnp.sum(per(q, k, v)) / 4.0
@@ -140,7 +140,7 @@ def test_zigzag_ring_grad_matches(env):
     def sharded_loss(q, k, v):
         def body(q, k, v):
             out = zigzag_ring_attention(q, k, v, "seq", sp)
-            return lax.psum(jnp.sum(out**2), "seq")[None]
+            return lax.psum(jnp.sum(out**2), "seq")[None]  # mlsl-lint: disable=A201
 
         per = smap(body, mesh, in_specs=(spec, spec, spec), out_specs=P("seq"))
         return jnp.sum(per(q, k, v)) / sp
@@ -187,7 +187,7 @@ def test_zigzag_ring_flash_grad_matches(env):
             def body(q, k, v):
                 out = zigzag_ring_attention(q, k, v, "seq", sp,
                                             use_flash=use_flash)
-                return lax.psum(jnp.sum(out**2), "seq")[None]
+                return lax.psum(jnp.sum(out**2), "seq")[None]  # mlsl-lint: disable=A201
 
             per = smap(body, mesh, in_specs=(spec, spec, spec),
                        out_specs=P("seq"), check=False)

@@ -5,7 +5,7 @@ import numpy as np
 import pytest
 import jax
 
-from mlsl_tpu.types import CompressionType, DataType, GroupType, ReductionType
+from mlsl_tpu.types import CompressionType, DataType, ReductionType
 
 
 def _topk_sparsify(x, k):

@@ -3,7 +3,7 @@
 import numpy as np
 import pytest
 
-from mlsl_tpu.types import DataType, GroupType, OpType, ReductionType
+from mlsl_tpu.types import OpType
 
 
 @pytest.fixture()

@@ -12,12 +12,11 @@ import os
 
 import numpy as np
 import pytest
-import jax
 
 from mlsl_tpu import sysinfo, tuner
 from mlsl_tpu.comm import algos
 from mlsl_tpu.log import MLSLError
-from mlsl_tpu.types import CompressionType, DataType, GroupType, ReductionType
+from mlsl_tpu.types import DataType, GroupType, ReductionType
 
 TINY_SIZES = (4 * 1024, 32 * 1024)
 
