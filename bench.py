@@ -368,6 +368,13 @@ def main():
               f"falling back to the subprocess probe", file=sys.stderr)
         overlap, overlap_backend = _overlap_probe_cpu_mesh()
 
+    # Two-tier hierarchical-vs-flat ratio (comm/algos/hier.py): tracked on
+    # the synthetic 8-dev two-tier CPU mesh with the DCN bandwidth-delay
+    # simulator (benchmarks/hier_bench.py) — a single attached chip has no
+    # second tier, so like the overlap probe this keeps the trajectory in
+    # the record with an explicit backend tag either way.
+    hier_vs_flat, hier_backend = _hier_probe_cpu_mesh()
+
     # Achieved TFLOP/s and MFU for the framework step. FLOPs come from XLA's own
     # cost model on the compiled baseline step (identical math to the framework
     # step); peak from the device kind.
@@ -415,6 +422,10 @@ def main():
         "overlap_fraction_isolation": (
             round(overlap_iso, 4) if overlap_iso is not None else None
         ),
+        "hier_vs_flat": (
+            round(hier_vs_flat, 4) if hier_vs_flat is not None else None
+        ),
+        "hier_backend": hier_backend,
         "batch": batch,
         "pipeline_step_ms": round(pipe_ms, 3) if pipe_ms is not None else None,
         "images_per_s": round(batch / (pipe_ms / 1e3)) if pipe_ms else None,
@@ -575,6 +586,10 @@ def _overlap_probe_cpu_mesh(timeout: float = 600.0, attempts: int = 2):
     # overlap engine would reroute its trainer through the in-graph path
     for k in ("MLSL_OVERLAP_COMPILED", "MLSL_OVERLAP_STAGES"):
         env_vars.pop(k, None)
+    # a chip-armed two-tier split would make the probe's baseline requests
+    # eligible for the hier lowering; the probe wants the flat schedule
+    for k in ("MLSL_MESH_TIERS", "MLSL_HIER_DCN_CODEC"):
+        env_vars.pop(k, None)
     reason = "unknown"
     for attempt in range(attempts):
         try:
@@ -597,6 +612,57 @@ def _overlap_probe_cpu_mesh(timeout: float = 600.0, attempts: int = 2):
             reason = repr(e)[:160]
         print(f"bench: cpu overlap probe attempt {attempt + 1}/{attempts} "
               f"failed ({reason})", file=sys.stderr)
+    return None, f"skipped:{reason}"
+
+
+def _hier_probe_cpu_mesh(timeout: float = 900.0):
+    """-> (hier_vs_flat or None, backend tag — NEVER None). Runs
+    benchmarks/hier_bench.py --smoke on the synthetic 8-dev two-tier CPU
+    mesh (MLSL_MESH_TIERS=2x4, DCN bandwidth-delay simulator armed) and
+    parses its summary ratio. Same explicit-tag contract as the overlap
+    probe: a probe that cannot produce a number records WHY."""
+    import subprocess
+
+    env_vars = dict(
+        os.environ,
+        JAX_PLATFORMS="cpu",
+        MLSL_TPU_PLATFORM="cpu",
+        MLSL_MESH_TIERS="2x4",
+        XLA_FLAGS=(os.environ.get("XLA_FLAGS", "")
+                   + " --xla_force_host_platform_device_count=8").strip(),
+    )
+    for k in ("MLSL_CHAOS", "MLSL_WATCHDOG_TIMEOUT", "MLSL_TRACE",
+              "MLSL_TUNE", "MLSL_TUNE_PROFILE", "MLSL_ALGO",
+              "MLSL_HIER_DCN_CODEC"):
+        env_vars.pop(k, None)
+    here = os.path.dirname(os.path.abspath(__file__))
+    reason = "unknown"
+    try:
+        out = subprocess.run(
+            [sys.executable, os.path.join(here, "benchmarks", "hier_bench.py"),
+             "--smoke"],
+            capture_output=True, text=True, timeout=timeout, env=env_vars,
+            cwd=here,
+        )
+        for line in out.stdout.splitlines():
+            try:
+                row = json.loads(line)
+            except ValueError:
+                continue
+            if row.get("metric") == "hier_vs_flat":
+                v = row.get("value")
+                if v is not None:
+                    return float(v), "cpu-mesh-sim"
+                reason = row.get("reason", "no value")
+        tail = (out.stderr or "").strip().splitlines()
+        if reason == "unknown":
+            reason = (f"no-row rc={out.returncode}"
+                      + (f" {tail[-1][:120]}" if tail else ""))
+    except subprocess.TimeoutExpired:
+        reason = f"timeout {timeout:.0f}s"
+    except Exception as e:
+        reason = repr(e)[:160]
+    print(f"bench: hier probe failed ({reason})", file=sys.stderr)
     return None, f"skipped:{reason}"
 
 

@@ -134,6 +134,12 @@ def main():
             ("pallas_ring",
              [sys.executable, "benchmarks/pallas_ring_bench.py", "--bidir"],
              2400),
+            # two-tier hierarchical curve: on a single slice this runs the
+            # synthetic 2x4 split + DCN simulator (flat-vs-hier ordering);
+            # on a real multislice attachment drop the sim and the env
+            # override to measure the physical DCN (docs/TUNING.md §17)
+            ("hier",
+             [sys.executable, "benchmarks/hier_bench.py"], 1800),
             ("grid_collectives",
              [sys.executable, "benchmarks/grid_collectives.py"], 1200),
             ("transformer",

@@ -42,6 +42,8 @@ CODES: Dict[str, Tuple[str, str]] = {
     "MLSL-A112": (ERROR, "error-feedback length disagrees with the "
                          "quant-ring geometry"),
     "MLSL-A113": (ERROR, "quant block straddles a ZeRO-1 shard boundary"),
+    "MLSL-A114": (ERROR, "hier compressed-tier block straddles the "
+                         "intra-slice shard boundary"),
     "MLSL-A120": (ERROR, "compiled-overlap donation hazard: donated carry "
                          "slot aliased or read after emission"),
     "MLSL-A121": (ERROR, "error-feedback snapshot/rewind machinery is not "

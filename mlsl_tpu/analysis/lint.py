@@ -6,8 +6,11 @@ docs/DESIGN.md "Static analysis" for the full table):
 
 - **A201** raw ``lax.p*`` collectives outside ``comm/algos/`` and the
   allowlisted engine modules: collectives must route through the selection
-  table (PR 4) so tuning, breakers, and stats see them. Model/optimizer code
-  that deliberately embeds a raw collective carries an explicit pragma.
+  table (PR 4) so tuning, breakers, and stats see them. models/moe.py and
+  parallel/pipeline.py route through the engine's inline helpers (their
+  old per-site/file allowances are gone — a new raw call there re-flags);
+  the remaining deliberate embeds (boundary ppermutes, in-graph norm/
+  fingerprint reductions) carry explicit per-site pragmas.
 - **A202** device-program dispatch reachable from a ``threading.Thread``
   target: a background thread launching SPMD programs concurrently with the
   training loop's dispatches starves the XLA:CPU rendezvous and wedges the

@@ -15,11 +15,16 @@ collective-ordering deadlock model):
   the classic cross-replica deadlock.
 - **A102/A103** worst-case concurrent in-flight collective programs vs the
   backend budget (the XLA:CPU rendezvous wedge documented in
-  KNOWN_FAILURES.md — flagged before it hangs).
-- **A110-A113** quantization geometry: bucket member slots on quant-block
+  KNOWN_FAILURES.md — flagged before it hangs). On a two-tier world
+  (comm/mesh.world_tiers) the count is ALSO taken per tier: programs whose
+  groups span tiers contend for the DCN's far smaller concurrent-transfer
+  tolerance, so they are budgeted separately at half the backend figure.
+- **A110-A114** quantization geometry: bucket member slots on quant-block
   boundaries, coalesced totals on the ring-chunk unit, error-feedback
   lengths equal to the quant-ring geometry, ZeRO-1 shard boundaries on
-  block boundaries.
+  block boundaries, and (A114, the two-tier analog of A113) hier-routed
+  compressed requests whose DCN-tier quant blocks would straddle the
+  intra-slice shard boundary.
 - **A121** the EF snapshot/rewind machinery's static preconditions on every
   retry/degrade path (degrade geometry covers every chunk program).
 - **A120/A122** compiled-overlap donation hazards (``verify_overlap_plan``):
@@ -40,6 +45,8 @@ from __future__ import annotations
 
 import time
 from typing import FrozenSet, List, Optional, Set
+
+import numpy as np
 
 from mlsl_tpu.analysis.diagnostics import Report, record
 from mlsl_tpu.log import MLSLError, log_warning
@@ -220,9 +227,50 @@ def verify_session(session, config=None) -> Report:
     return rep
 
 
+def _spans_tiers(group, tier_ids, cache=None) -> bool:
+    """True when one of the group's instances has members in >= 2 tiers: its
+    collectives put traffic on the DCN. ``cache`` memoizes per distinct
+    group within one verify run (the A101 convention — the member table is
+    O(W*G) to build and per-layer requests share a handful of groups)."""
+    if getattr(group, "is_self", False):
+        return False
+    key = id(group)
+    if cache is not None and key in cache:
+        return cache[key]
+    from mlsl_tpu.comm.collectives import _member_world_table
+
+    try:
+        tbl = _member_world_table(group)
+    except Exception:
+        return True  # unknowable layout: worst-case it as DCN-crossing
+    spans = any(
+        len({tier_ids[int(w)] for w in row}) > 1
+        for row in np.atleast_2d(tbl)
+    )
+    if cache is not None:
+        cache[key] = spans
+    return spans
+
+
+def _dcn_budget(budget: int) -> int:
+    """The per-tier budget for DCN-crossing programs: the slow tier's
+    rendezvous/transfer machinery tolerates far fewer concurrent
+    collectives than the ICI — half the backend figure, floored so tiny
+    budgets stay usable."""
+    return max(budget // 2, 4)
+
+
 def _check_inflight(rep: Report, session, back, inc) -> None:
+    from mlsl_tpu.comm.mesh import world_tier_ids
+
     platform = _platform(session)
     budget = INFLIGHT_BUDGET.get(platform, INFLIGHT_BUDGET_DEFAULT)
+    tier_ids = None
+    for op in session.operations:
+        if op.distribution is not None:
+            devs = tuple(op.distribution.topology.mesh.devices.flat)
+            tier_ids = world_tier_ids(devs)
+            break
     for window, entities in (("backward", back), ("increment", inc)):
         n = sum(_entity_programs(k, e) for k, e, _ in entities)
         if n > budget:
@@ -236,6 +284,29 @@ def _check_inflight(rep: Report, session, back, inc) -> None:
             rep.add("MLSL-A103",
                     f"{window} window reaches {n}/{budget} of the {platform} "
                     "in-flight collective budget", f"graph:{window}")
+        if tier_ids is None:
+            continue
+        # two-tier shape: programs whose groups span tiers contend for the
+        # DCN separately — the slow tier wedges at far lower concurrency
+        dcn = _dcn_budget(budget)
+        span_cache: dict = {}  # one member-table walk per distinct group
+        n_dcn = sum(
+            _entity_programs(k, e) for k, e, _ in entities
+            if any(_spans_tiers(r.desc.group, tier_ids, span_cache)
+                   for r, _ in _entity_reqs(k, e))
+        )
+        if n_dcn > dcn:
+            rep.add("MLSL-A102",
+                    f"{window} window can put {n_dcn} DCN-crossing "
+                    f"collective programs in flight concurrently; the "
+                    f"two-tier budget is {dcn} (half the {platform} figure "
+                    "— route through the 'hier' lowering or raise "
+                    "MLSL_GRAD_BUCKET_MB)", f"graph:{window}/dcn")
+        elif n_dcn > dcn // 2:
+            rep.add("MLSL-A103",
+                    f"{window} window reaches {n_dcn}/{dcn} of the "
+                    "DCN-crossing in-flight budget on this two-tier world",
+                    f"graph:{window}/dcn")
 
 
 def _check_issue_order(rep: Report, cfg, back) -> None:
@@ -273,7 +344,7 @@ def _expected_err_len(req, cfg) -> Optional[List[int]]:
     d = req.desc
     if d.compression != CompressionType.QUANTIZATION:
         return None
-    if req.algo not in ("quant_ring", "pallas_ring"):
+    if req.algo not in ("quant_ring", "pallas_ring", "hier"):
         return None
     block = getattr(cfg, "quant_block_elems", 256)
     out = []
@@ -282,6 +353,10 @@ def _expected_err_len(req, cfg) -> Optional[List[int]]:
             from mlsl_tpu.ops import ring_kernels as rk
 
             out.append(rk.quant_geometry(d.kind, d.group, n, block)[3])
+        elif req.algo == "hier":
+            from mlsl_tpu.comm.algos import hier
+
+            out.append(hier.quant_geometry(d.kind, d.group, n, block)[2])
         else:
             from mlsl_tpu.comm.quant_ring import ring_geometry
 
@@ -300,11 +375,17 @@ def _check_request(rep: Report, req, cfg, anchor: str) -> None:
         # _take_residuals preconditions)
         geoms = req._degrade_geoms
         chunks = _chunk_counts(req)
-        if req._err_layout not in ("ring", "flat"):
+        if req._err_layout not in ("ring", "flat", "hier"):
             rep.add("MLSL-A121",
                     f"compressed request '{req.name or req.uid}' has no "
                     "_err_layout: the degrade flush cannot invert its "
                     "residual", anchor)
+        if req._err_layout == "hier" and getattr(
+                req, "_hier_meta", None) is None:
+            rep.add("MLSL-A121",
+                    f"hier-routed request '{req.name or req.uid}' carries "
+                    "no intra-tier position table: the degrade flush "
+                    "cannot re-place its per-shard residual", anchor)
         if geoms is None or len(geoms) != len(chunks):
             rep.add("MLSL-A121",
                     f"degrade geometry of '{req.name or req.uid}' covers "
@@ -335,6 +416,31 @@ def _check_request(rep: Report, req, cfg, anchor: str) -> None:
                         f"'{req.name or req.uid}' carries {len(actual)} "
                         f"residual length(s) for {len(expected)} chunk "
                         "program(s)", anchor)
+    if compressed and req.algo == "hier":
+        # -- A114 (the A113 class on the two-tier shape): the compressed
+        # DCN tier quantizes each member's 1/L shard against the shared
+        # per-block scale — a residual/shard length off the block grid means
+        # a quant block straddles the intra-slice shard boundary, breaking
+        # scale locality AND the flush_residual slice placement
+        block = getattr(cfg, "quant_block_elems", 256)
+        actual = (list(req._err_lens) if req._err_lens is not None
+                  else [req._err_len])
+        from mlsl_tpu.comm.algos import hier
+
+        tiers = hier.tier_structure(d.group)
+        for slen, n in zip(actual, _chunk_counts(req)):
+            if int(slen) % int(block):
+                rep.add("MLSL-A114",
+                        f"hier compressed-tier shard length {slen} is not "
+                        f"on the {block}-elem quant block grid on "
+                        f"'{req.name or req.uid}': a DCN-tier block "
+                        "straddles the intra-slice shard boundary", anchor)
+            elif tiers is not None and int(slen) * tiers[1] < int(n):
+                rep.add("MLSL-A114",
+                        f"hier shard length {slen} x L={tiers[1]} does not "
+                        f"cover chunk count {n} on "
+                        f"'{req.name or req.uid}': the tail of the payload "
+                        "would never cross the DCN", anchor)
     if req.algo == "pallas_ring":
         _check_pallas_request(rep, req, cfg, anchor)
 
@@ -411,10 +517,16 @@ def verify_overlap_plan(plan, block: Optional[int] = None) -> Report:
                         f"{u.err_len}: the donated carry would be read at "
                         "the wrong geometry", anchor)
             if block is not None:
-                from mlsl_tpu.comm.quant_ring import ring_geometry
+                if u.algo == "hier":
+                    from mlsl_tpu.comm.algos import hier
 
-                exp = ring_geometry("allreduce", plan.group, u.total,
-                                    block)[3]
+                    exp = hier.quant_geometry("allreduce", plan.group,
+                                              u.total, block)[2]
+                else:
+                    from mlsl_tpu.comm.quant_ring import ring_geometry
+
+                    exp = ring_geometry("allreduce", plan.group, u.total,
+                                        block)[3]
                 if exp != u.err_len:
                     rep.add("MLSL-A112",
                             f"unit err_len {u.err_len} != quant-ring "

@@ -29,9 +29,17 @@ extends) the algorithm dimension:
                 axis ring groups on TPU (or under the explicit
                 MLSL_PALLAS_INTERPRET gate off-chip); dense f32/bf16/i32
                 here, and the int8-quantized variant of the same kernel
-                selectable for COMPRESSION=QUANTIZATION requests (the one
+                selectable for COMPRESSION=QUANTIZATION requests (a
                 compressed case the table routes — quant_ring's
                 ``ring='pallas'`` wire).
+- ``hier``    — two-tier hierarchical allreduce for pod-scale worlds
+                (algos/hier.py): intra-slice reduce-scatter -> inter-slice
+                allreduce over the 1/L shard -> intra-slice all-gather,
+                with a per-tier codec (f32 on ICI; int8-blockwise/top-k on
+                the DCN hop via quant_ring's ``ring='hier'`` wire — a
+                THC-style shared-scale integer sum that never dequantizes
+                per hop). Tier structure from ``mesh.world_tier_ids``
+                (``MLSL_MESH_TIERS`` override / multislice ``slice_index``).
 
 Selection (``select``) is keyed by (kind, payload bytes, group shape,
 compression) with strict precedence:
@@ -122,6 +130,14 @@ def _eligible_pallas_ring(kind: str, group: ProcessGroup, op) -> bool:
     return ring_kernels.eligible_dense(kind, group, op)
 
 
+def _eligible_hier(kind: str, group: ProcessGroup, op) -> bool:
+    # single-live-axis groups with a uniform two-tier split (MLSL_MESH_TIERS
+    # or multislice topology), SUM only — lazily imported like pallas_ring
+    from mlsl_tpu.comm.algos import hier
+
+    return hier.eligible(kind, group, op)
+
+
 #: name -> eligibility predicate; builders are resolved lazily (the bodies
 #: import jax)
 _ELIGIBLE = {
@@ -129,6 +145,7 @@ _ELIGIBLE = {
     "rhd": _eligible_rhd,
     "ring2d": _eligible_ring2d,
     "pallas_ring": _eligible_pallas_ring,
+    "hier": _eligible_hier,
 }
 
 ALGORITHMS = tuple(_ELIGIBLE)
@@ -203,10 +220,13 @@ def select(
     if compression != CompressionType.NONE:
         # Compressed collectives have their own wire formats (quant ring /
         # sparse top-k); the engine's dense algorithms do not apply — with
-        # ONE exception: the fused pallas ring has an int8-quantized variant
-        # (quant_ring's ring='pallas' wire), so a forced or tuned
-        # 'pallas_ring' is honored for QUANTIZATION when the kernel can
-        # serve the group. Everything else keeps the composed ring.
+        # TWO exceptions the table routes: the fused pallas ring has an
+        # int8-quantized variant (quant_ring's ring='pallas' wire), and the
+        # two-tier 'hier' lowering carries the compressed wire on its DCN
+        # hop only (quant_ring's ring='hier' wire — intra-slice phases stay
+        # f32). A forced or tuned choice of either is honored for
+        # QUANTIZATION when the group qualifies; everything else keeps the
+        # composed flat ring.
         if (
             compression == CompressionType.QUANTIZATION
             and getattr(config, "custom_codec", None) is None
@@ -214,10 +234,12 @@ def select(
             name = _requested(kind, group, payload_bytes, compression, config)
             if name == "pallas_ring" and _quant_pallas_eligible(group, config):
                 return _breaker_gate(name, kind)
-            if name == "pallas_ring":
+            if name == "hier" and _quant_hier_eligible(kind, group, config):
+                return _breaker_gate(name, kind)
+            if name in ("pallas_ring", "hier"):
                 log_debug(
-                    "pallas_ring not eligible for quantized %s on group %s; "
-                    "keeping the composed quant ring", kind,
+                    "%s not eligible for quantized %s on group %s; "
+                    "keeping the composed quant ring", name, kind,
                     group_shape(group),
                 )
         return DEFAULT
@@ -253,6 +275,15 @@ def _quant_pallas_eligible(group: ProcessGroup, config) -> bool:
 
     block = int(getattr(config, "quant_block_elems", 256))
     return ring_kernels.eligible_quant(group, block)
+
+
+def _quant_hier_eligible(kind: str, group: ProcessGroup, config) -> bool:
+    from mlsl_tpu.comm.algos import hier
+
+    if kind != "allreduce":
+        return False
+    block = int(getattr(config, "quant_block_elems", 256))
+    return hier.eligible_quant(group, block)
 
 
 def _breaker_gate(name: str, kind: str) -> str:
@@ -357,6 +388,10 @@ def inline_plan(kind: str, group: ProcessGroup, algo: str, count: int, *,
             slots=getattr(config, "pallas_ring_slots", None),
             bidir=getattr(config, "pallas_ring_bidir", None),
         )
+    if algo == "hier":
+        from mlsl_tpu.comm.algos import hier
+
+        return hier.steps(kind, group, count, op=rop, recv_count=recv_count)
     from mlsl_tpu.comm.algos import ring2d
 
     return ring2d.steps(kind, group, count, op=rop, recv_count=recv_count)
@@ -387,8 +422,76 @@ def build(kind: str, group: ProcessGroup, dtype, algo: str, **kw) -> Callable:
         from mlsl_tpu.comm.algos import rhd as impl
     elif algo == "pallas_ring":
         from mlsl_tpu.comm.algos import pallas_ring as impl
+    elif algo == "hier":
+        from mlsl_tpu.comm.algos import hier as impl
     else:
         from mlsl_tpu.comm.algos import ring2d as impl
     fn = collectives._chaos_dispatch(impl.build(kind, group, **kw), kind)
     collectives._cache[key] = fn
     return fn
+
+
+# ---------------------------------------------------------------------------
+# Engine-owned in-graph collectives for SPMD model/parallel code
+# ---------------------------------------------------------------------------
+#
+# Model and parallelism modules (models/moe.py, parallel/pipeline.py) used to
+# embed raw ``lax.p*`` calls inside their shard_map bodies, each carrying an
+# A201 lint pragma. These helpers move the raw call INTO the engine: the one
+# call site future alternative lowerings (a DCN-staged hierarchical alltoall,
+# a tiered gather) slot in behind, and the place the selection table applies
+# when the caller can hand over a ProcessGroup. A body-local collective with
+# only an axis name lowers to the lax baseline.
+
+
+def inline_allreduce(x, axis, *, group: ProcessGroup = None, config=None,
+                     op=None):
+    """In-graph allreduce for shard_map interiors. With ``group`` (and
+    config) the selection table picks the lowering — on a two-tier world
+    that is the hierarchical decomposition — executed to completion through
+    ``inline_plan``; with only ``axis`` the lax baseline applies."""
+    from jax import lax as _lax
+
+    from mlsl_tpu.types import ReductionType
+
+    rop = ReductionType(op) if op is not None else ReductionType.SUM
+    if group is not None and not group.is_self and int(group.size) > 1:
+        count = int(np.prod(x.shape))
+        algo = select("allreduce", group, count * 4, CompressionType.NONE,
+                      config, op=rop)
+        if algo != DEFAULT and inline_eligible(algo, "allreduce", group, rop):
+            from mlsl_tpu.comm import collectives
+
+            sizes = collectives._axis_sizes(group.topology.mesh)
+            prep, phases, finish = inline_plan(
+                "allreduce", group, algo, count, op=rop, config=config,
+            )
+            carry = prep(x.reshape(-1),
+                         collectives._group_rank(group.axes, sizes))
+            for phase in phases:
+                carry = phase(carry)
+            return finish(carry).reshape(x.shape)
+        axis = group.axes
+    if rop == ReductionType.SUM:
+        return _lax.psum(x, axis)
+    if rop == ReductionType.MIN:
+        return _lax.pmin(x, axis)
+    return _lax.pmax(x, axis)
+
+
+def inline_alltoall(x, axis, *, split_axis=0, concat_axis=0, tiled=False):
+    """In-graph alltoall (the MoE expert dispatch/combine exchange). One
+    lowering today — the lax baseline — but the engine owns the call site,
+    so stats/lint see every dispatch path and a tiered decomposition slots
+    in here when the DCN alltoall lands."""
+    from jax import lax as _lax
+
+    return _lax.all_to_all(x, axis, split_axis=split_axis,
+                           concat_axis=concat_axis, tiled=tiled)
+
+
+def inline_allgather(x, axis, *, gather_axis=0, tiled=True):
+    """In-graph all-gather (the MoE output reassembly)."""
+    from jax import lax as _lax
+
+    return _lax.all_gather(x, axis, axis=gather_axis, tiled=tiled)

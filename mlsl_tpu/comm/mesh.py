@@ -69,6 +69,90 @@ def dcn_aware_devices(
     return tuple(ordered)
 
 
+def parse_mesh_tiers(spec: str) -> Optional[Tuple[int, int]]:
+    """Parse ``MLSL_MESH_TIERS='TxL'`` -> (T slices, L devices per slice), or
+    None for empty. Raises MLSLError on anything that is not two positive
+    ints joined by 'x' — a malformed tier spec must fail at init, not deep
+    inside the first hierarchical collective that consults it.
+    Config.validate() deliberately duplicates this grammar inline: it must
+    stay importable without jax, which this module imports."""
+    spec = (spec or "").strip().lower()
+    if not spec:
+        return None
+    parts = spec.split("x")
+    mlsl_assert(
+        len(parts) == 2 and all(p.strip().isdigit() for p in parts),
+        "MLSL_MESH_TIERS must be 'TxL' (slices x devices-per-slice), got %r",
+        spec,
+    )
+    t, l = int(parts[0]), int(parts[1])
+    mlsl_assert(t >= 1 and l >= 1,
+                "MLSL_MESH_TIERS slices/locals must be >= 1 (got %dx%d)", t, l)
+    return t, l
+
+
+def world_tier_ids(devices=None) -> Optional[Tuple[int, ...]]:
+    """Per-world-rank tier (slice) ids, or None when the world is one tier.
+
+    Resolution order:
+    - ``MLSL_MESH_TIERS=TxL``: a synthetic contiguous split (rank // L) —
+      how the 8-dev CPU proof mesh and tier-1 exercise a two-tier world.
+      T*L must cover the world exactly.
+    - real hardware: ``device.slice_index`` (TPU multislice). Ranks sharing
+      a slice share an ICI domain; distinct slices are bridged by the DCN.
+    - neither: None — a single flat/ICI world, no tier structure.
+    """
+    import os
+
+    devices = tuple(jax.devices() if devices is None else devices)
+    n = len(devices)
+    spec = parse_mesh_tiers(os.environ.get("MLSL_MESH_TIERS", ""))
+    if spec is not None:
+        t, l = spec
+        # The synthetic split describes the FULL world: every device maps
+        # to its world-position tier (world rank // L) by IDENTITY — the
+        # same way device.slice_index survives sub-world or permuted
+        # Topologies on real multislice. No positional fast path: a
+        # permuted full-size tuple must see its true (interleaved) tier
+        # ids, and a spec that does not cover the world is a genuine
+        # misconfiguration that must fail at arming time, not silently
+        # flatten or silently tier a same-length sub-world.
+        world = {d: i for i, d in enumerate(jax.devices())}
+        mlsl_assert(
+            t * l == len(world),
+            "MLSL_MESH_TIERS=%dx%d does not cover the %d-device world",
+            t, l, len(world),
+        )
+        if not all(d in world for d in devices):
+            return None
+        raw = [world[d] // l for d in devices]
+        order = {s: i for i, s in enumerate(sorted(set(raw)))}
+        return tuple(order[s] for s in raw)
+    slices = [getattr(d, "slice_index", None) for d in devices]
+    if any(s is None for s in slices) or len(set(slices)) <= 1:
+        return None
+    order = {s: i for i, s in enumerate(sorted(set(slices)))}
+    return tuple(order[s] for s in slices)
+
+
+def world_tiers(devices=None) -> Optional[Tuple[int, int]]:
+    """(T, L) for the world when it splits into T equal contiguous tiers of
+    L devices (the shape the hierarchical lowerings and the topology
+    fingerprint key on), else None — unequal or interleaved slice layouts
+    have no uniform two-tier shape and ride the flat lowerings."""
+    ids = world_tier_ids(devices)
+    if ids is None:
+        return None
+    t = len(set(ids))
+    n = len(ids)
+    if n % t:
+        return None
+    l = n // t
+    if tuple(ids) != tuple(i // l for i in range(n)):
+        return None
+    return t, l
+
+
 class Topology:
     """The device world arranged as a (replica, data, seq, model) mesh.
 

@@ -85,7 +85,28 @@ class OverlapUnit:
         self.key: Optional[str] = None  # residual-state key (quant units)
         self.err_len = 0
         self.per_tick = 1  # phases advanced per scheduler tick (set by plan)
+        self._quant_staged = False
         if compression == CompressionType.QUANTIZATION:
+            if algo == "hier":
+                # the table routed this unit's compressed wire through the
+                # two-tier decomposition: staged phases (the ICI
+                # reduce-scatter emits early, the compressed DCN hop is its
+                # own phase — the natural stage boundary — the ICI
+                # all-gather last), error feedback threaded through the
+                # carry exactly like the flat inline body
+                from mlsl_tpu.comm.algos import hier
+
+                (self._qprep, self._phases,
+                 self._qfinish, self.err_len) = hier.quant_steps(
+                    group, self.total, block,
+                    codec=getattr(config, "hier_dcn_codec", None),
+                    topk_ratio=float(getattr(config, "topk_ratio", 0.01)),
+                )
+                self._quant_staged = True
+                self.key = f"q{index}/{self.names[0]}"
+                self.nphases = len(self._phases)
+                self.algo = "hier"
+                return
             from mlsl_tpu.comm import quant_ring
 
             self._body, self.err_len = quant_ring.inline_body(
@@ -113,18 +134,23 @@ class OverlapUnit:
             if len(self.names) > 1
             else flat[self.names[0]]
         )
+        if self._quant_staged:
+            return self._qprep(x, mypos, err)
         if self.compression == CompressionType.QUANTIZATION:
             return (x, err)
         return self._prep(x, mypos)
 
     def advance(self, carry, i: int):
-        if self.compression == CompressionType.QUANTIZATION:
+        if self.compression == CompressionType.QUANTIZATION \
+                and not self._quant_staged:
             return self._body(*carry)
         return self._phases[i](carry)
 
     def finish(self, carry) -> Tuple[Dict[str, jax.Array], Optional[jax.Array]]:
         """-> ({member name -> reduced flat slice}, new residual or None)."""
-        if self.compression == CompressionType.QUANTIZATION:
+        if self._quant_staged:
+            out, new_err = self._qfinish(carry)
+        elif self.compression == CompressionType.QUANTIZATION:
             out, new_err = carry
         else:
             out, new_err = self._finish(carry), None
@@ -191,7 +217,18 @@ def _unit_algo(group: ProcessGroup, payload: int,
     falls back to the baseline with a debug log, mirroring algos.select's
     own fallback contract."""
     if compression != CompressionType.NONE:
-        return algos.DEFAULT  # compressed units carry their own wire family
+        # compressed units carry their own wire family — except the
+        # two-tier 'hier' route, whose codec lives on the DCN hop only: a
+        # forced or tuned 'hier' stages the quantized unit hierarchically
+        if compression == CompressionType.QUANTIZATION and config is not None:
+            name = forced or algos.select(
+                "allreduce", group, payload, compression, config,
+                op=ReductionType.SUM,
+            )
+            if name == "hier" and algos._quant_hier_eligible(
+                    "allreduce", group, config):
+                return "hier"
+        return algos.DEFAULT
     name = forced or algos.select(
         "allreduce", group, payload, compression, config, op=ReductionType.SUM
     )

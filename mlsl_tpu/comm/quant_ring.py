@@ -243,7 +243,8 @@ def inline_body(
 
 def build_quantized_collective(
     kind: str, group: ProcessGroup, count: int, block: int,
-    ring: str = "lax", slots=None, bidir=None,
+    ring: str = "lax", slots=None, bidir=None, dcn_codec=None,
+    topk_ratio: float = 0.01,
 ) -> Tuple[Callable, int]:
     """-> (compiled fn (buf, err) -> (result, new_err), error-feedback length).
 
@@ -259,11 +260,39 @@ def build_quantized_collective(
     RDMA), selected by the algos table as ``'pallas_ring'``. Both share the
     entry error-feedback math and the slice-at-chunk-start layout, so the
     residual contract (and the supervisor's logical_residual degrade flush)
-    is identical.
+    is identical. ``'hier'`` is the two-tier hierarchical wire
+    (comm/algos/hier.py, selected as ``'hier'``): the codec applies ONLY on
+    the inter-slice DCN hop (``dcn_codec``: int8-blockwise shared-scale
+    integer sum / top-k / f32) and the residual covers each member's own
+    1/L shard — a different layout (CommRequest._err_layout == 'hier'),
+    inverted on degrade by hier.flush_residual instead of
+    logical_residual, but the same snapshot/rewind and breaker machinery.
     """
     from mlsl_tpu.comm.collectives import _group_key
 
     mesh = group.topology.mesh
+    if ring == "hier":
+        from mlsl_tpu.comm.algos import hier
+
+        codec = hier.dcn_codec(dcn_codec)
+        tiers = hier.tier_structure(group)
+        mlsl_assert(tiers is not None,
+                    "hier quantized wire needs a tiered group "
+                    "(MLSL_MESH_TIERS or multislice topology)")
+        key = (kind, ring, _group_key(group), count, block, codec,
+               tiers, topk_ratio if codec == "topk" else None)
+        _, _, err_len, _ = hier.quant_geometry(kind, group, count, block)
+        fn = _cache.get(key)
+        if fn is None:
+            body, _ = hier.quant_body(kind, group, count, block,
+                                      codec=codec, topk_ratio=topk_ratio)
+            from mlsl_tpu.comm.collectives import build_stateful_collective
+
+            fn = _chaos_roundtrip(
+                build_stateful_collective(body, mesh), algo="hier"
+            )
+            _cache[key] = fn
+        return fn, err_len
     if ring == "pallas":
         from mlsl_tpu.ops import ring_kernels as rk
 

@@ -215,16 +215,27 @@ class CommRequest:
                 # hop-engine selection through the PR 4 table: a forced or
                 # tuned 'pallas_ring' routes the SAME compressed wire family
                 # through the fused kernel (identical entry error feedback,
-                # identical residual layout — quant_ring ring='pallas')
+                # identical residual layout — quant_ring ring='pallas');
+                # 'hier' routes it through the two-tier decomposition (the
+                # codec applies only on the DCN hop; per-shard residual
+                # layout — quant_ring ring='hier')
                 ring = "lax"
                 ring_kw = {}
-                if algos.select(d.kind, d.group, self._payload,
-                                d.compression, cfg, op=d.op) == "pallas_ring":
+                sel = algos.select(d.kind, d.group, self._payload,
+                                   d.compression, cfg, op=d.op)
+                if sel == "pallas_ring":
                     ring = "pallas"
                     self.algo = "pallas_ring"
                     ring_kw = dict(
                         slots=int(getattr(cfg, "pallas_ring_slots", 2)),
                         bidir=bool(getattr(cfg, "pallas_ring_bidir", False)),
+                    )
+                elif sel == "hier":
+                    ring = "hier"
+                    self.algo = "hier"
+                    ring_kw = dict(
+                        dcn_codec=getattr(cfg, "hier_dcn_codec", None),
+                        topk_ratio=float(getattr(cfg, "topk_ratio", 0.01)),
                     )
 
                 def build(n):
@@ -266,7 +277,20 @@ class CommRequest:
             # residual flushed (_dispatch_degraded)
             self._breaker = supervisor.breaker("quant")
             self._degrade_subsys = "quant"
-            self._err_layout = "ring"  # quant_ring AND custom_codec layout
+            if self.algo == "hier":
+                # per-shard residual layout: each member owns its own 1/L
+                # slice's error; the degrade flush re-places it at that
+                # slice's logical offset (hier.flush_residual) via the
+                # static intra-tier position table captured here
+                from mlsl_tpu.comm.algos import hier
+
+                self._err_layout = "hier"
+                self._hier_meta = (
+                    hier.tier_structure(d.group)[1],
+                    hier.intra_positions(d.group),
+                )
+            else:
+                self._err_layout = "ring"  # quant_ring AND custom_codec
             self.is_setup = True
             return
         if d.kind == "barrier":
@@ -645,16 +669,25 @@ class CommRequest:
             rs = d.kind == "reduce_scatter"
             slices = list(self._chunk_slices)
             geoms = list(self._degrade_geoms)
-            flat = self._err_layout == "flat"
-            full = slices == [slice(None)]
+            layout = self._err_layout
+            if layout == "hier":
+                from mlsl_tpu.comm.algos import hier as hier_mod
+
+                hier_L, l_np = self._hier_meta
+                l_idx = jnp.asarray(l_np)
 
             def flush(b, *errs):
                 x = b.astype(jnp.float32)
                 for sl, (n, el), e in zip(slices, geoms, errs):
-                    res = e if flat else logical_residual(
-                        e, g, el // g, n // g if rs else -(-n // g), n
-                    )
-                    x = x + res if full else x.at[..., sl].add(res)
+                    if layout == "flat":
+                        res = e
+                    elif layout == "hier":
+                        res = hier_mod.flush_residual(e, l_idx, hier_L, el, n)
+                    else:
+                        res = logical_residual(
+                            e, g, el // g, n // g if rs else -(-n // g), n
+                        )
+                    x = x + res if sl == slice(None) else x.at[..., sl].add(res)
                 return x
 
             self._degrade_fns = (jax.jit(flush), plain)

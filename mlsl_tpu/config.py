@@ -44,6 +44,7 @@ _ENV_FIELDS = {
     "MLSL_GRAD_BUCKET_MB": "grad_bucket_mb",
     "MLSL_NUM_SERVERS": "num_servers",
     "MLSL_QUANT_BLOCK_ELEMS": "quant_block_elems",
+    "MLSL_HIER_DCN_CODEC": "hier_dcn_codec",
     "MLSL_PALLAS_RING_SLOTS": "pallas_ring_slots",
     "MLSL_OVERLAP_STAGES": "overlap_stages",
     "MLSL_FEED_DEPTH": "feed_depth",
@@ -114,6 +115,21 @@ class Config:
     # Loaded tuner.TunedProfile (or None): consulted by comm/algos.select
     # for every engine collective. Set by Environment.init, never from env.
     tuned_profile: object = None
+
+    # --- hierarchical (two-tier) collectives (comm/algos/hier.py;
+    # docs/TUNING.md §17) ---
+    # Synthetic tier override 'TxL' (T DCN slices x L devices/slice): how
+    # the CPU proof mesh and tier-1 exercise a two-tier world. On real TPU
+    # multislice the tier map comes from device.slice_index and this stays
+    # ''. Recorded for discoverability like pallas_interpret: the mesh/hier
+    # modules read the SAME env var per build, so a monkeypatched env is
+    # honored without a Config handle. Validated at init.
+    mesh_tiers: str = ""            # MLSL_MESH_TIERS
+    # DCN-tier codec for the 'hier' compressed wire: 'int8' (blockwise
+    # shared-scale integer sum — the THC shape, default), 'topk', or 'f32'
+    # (no compression on the slow hop). The ICI tier is always f32.
+    # Tunable via a tuner profile (tuner.KNOB_CHOICES); exported env wins.
+    hier_dcn_codec: str = "int8"    # MLSL_HIER_DCN_CODEC
 
     # --- pallas ring kernels (ops/ring_kernels.py; docs/TUNING.md §15) ---
     # Comm slots per ring direction for the 'pallas_ring' lowering: how many
@@ -322,6 +338,24 @@ class Config:
             "MLSL_PALLAS_RING_SLOTS must be >= 2 (the ring needs a double "
             "buffer; got %d)", self.pallas_ring_slots,
         )
+        # MLSL_MESH_TIERS grammar, checked locally (comm.mesh's
+        # parse_mesh_tiers applies the same rules but imports jax; validate()
+        # must stay importable without it). World-coverage is checked where
+        # the world is known (mesh.world_tier_ids).
+        spec = (self.mesh_tiers or "").strip().lower()
+        if spec:
+            parts = spec.split("x")
+            mlsl_assert(
+                len(parts) == 2
+                and all(p.strip().isdigit() and int(p) >= 1 for p in parts),
+                "MLSL_MESH_TIERS must be 'TxL' with positive ints (got %r)",
+                self.mesh_tiers,
+            )
+        mlsl_assert(
+            self.hier_dcn_codec in ("int8", "f32", "topk"),
+            "MLSL_HIER_DCN_CODEC must be 'int8', 'f32' or 'topk' (got %r)",
+            self.hier_dcn_codec,
+        )
         mlsl_assert(
             self.pallas_interpret in ("", "0", "1"),
             "MLSL_PALLAS_INTERPRET must be '', '0' or '1' (got %r)",
@@ -451,6 +485,11 @@ class Config:
         c.overlap_compiled = _env_bool("MLSL_OVERLAP_COMPILED", c.overlap_compiled)
         c.overlap_stages = _env_int("MLSL_OVERLAP_STAGES", c.overlap_stages)
         c.quant_block_elems = _env_int("MLSL_QUANT_BLOCK_ELEMS", c.quant_block_elems)
+        c.mesh_tiers = os.environ.get("MLSL_MESH_TIERS", c.mesh_tiers).strip()
+        c.hier_dcn_codec = (
+            os.environ.get("MLSL_HIER_DCN_CODEC", "").strip().lower()
+            or c.hier_dcn_codec
+        )
         c.pallas_ring_slots = _env_int("MLSL_PALLAS_RING_SLOTS",
                                        c.pallas_ring_slots)
         c.pallas_ring_bidir = _env_bool("MLSL_PALLAS_RING_BIDIR",

@@ -361,6 +361,16 @@ class Session:
                     int(getattr(cfg, "pallas_ring_slots", 2)),
                     bool(getattr(cfg, "pallas_ring_bidir", False)),
                 )
+            elif req.algo == "hier":
+                # two-tier variant identity: a DCN-codec or tier-shape
+                # change compiles a DIFFERENT program (comm/algos/hier.py),
+                # and a stale plan entry must not skip re-warming it
+                import os
+
+                pallas_key = (
+                    str(getattr(cfg, "hier_dcn_codec", "int8")),
+                    os.environ.get("MLSL_MESH_TIERS", ""),
+                )
             # the algorithm identity is part of the plan key: a profile (or
             # MLSL_ALGO) switching a request from 'lax' to 'rhd' between
             # sessions compiles a DIFFERENT program, and a stale plan entry

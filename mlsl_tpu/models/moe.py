@@ -21,6 +21,7 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
+from mlsl_tpu.comm import algos
 from mlsl_tpu.log import mlsl_assert
 
 
@@ -139,15 +140,17 @@ def moe_ffn(
     # a bf16 dispatch alltoall moves half the bytes for identical inputs (the
     # return path stays f32 — combine consumes it in f32).
     buf = buf.reshape(ep, el, capacity, d).astype(compute_dtype)
-    # mlsl-lint: disable=A201 -- expert dispatch/combine alltoalls are the
-    # MoE layer's own in-graph routing (fused with the capacity gather),
-    # not request collectives (ROADMAP #2 is where they join the engine)
-    recv = lax.all_to_all(buf, axis, split_axis=0, concat_axis=0)  # (ep, El, C, D)
+    # expert dispatch/combine exchanges route through the collective engine
+    # (comm/algos inline helpers): the engine owns the call site, so the
+    # lint gate, stats attribution, and future tiered alltoall lowerings
+    # all apply here without touching the routing math
+    recv = algos.inline_alltoall(buf, axis, split_axis=0, concat_axis=0)
     y = _expert_ffn(recv, params["w1"], params["w2"], compute_dtype)  # (ep, El, C, D)
-    back = lax.all_to_all(y, axis, split_axis=0, concat_axis=0)  # mlsl-lint: disable=A201
+    back = algos.inline_alltoall(y, axis, split_axis=0, concat_axis=0)
     y_full = back.reshape(n_experts, capacity, d)
     out_slice = jnp.einsum("tec,ecd->td", combine, y_full)         # (Tl, D)
-    out = lax.all_gather(out_slice, axis, axis=0, tiled=True)      # (T, D)  # mlsl-lint: disable=A201
+    out = algos.inline_allgather(out_slice, axis, gather_axis=0,
+                                 tiled=True)                       # (T, D)
     return out, aux
 
 

@@ -14,10 +14,6 @@ with stage_fn(params, x) -> y applied at every stage (all stages share the fn sh
 per-stage weights differ — the usual homogeneous-blocks pipeline).
 """
 
-# mlsl-lint: disable-file=A201 -- stage->stage ppermute IS this module's
-# primitive (the SendRecvList realization): it must stay a raw in-graph
-# collective so jax.grad transposes it into the drain-fill backward
-
 from __future__ import annotations
 
 from typing import Callable
@@ -27,7 +23,16 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
+from mlsl_tpu.comm import algos
 from mlsl_tpu.parallel.sequence import _pvary
+
+# The stage->stage boundary ppermutes below stay RAW in-graph collectives
+# (per-site pragmas): they are this module's primitive — the SendRecvList
+# realization — and must remain lax.ppermute so jax.grad transposes them
+# into the drain-fill backward. Everything reduction-shaped (the microbatch
+# loss sums and the data-parallel gradient reduction) routes through the
+# collective engine instead (comm/algos inline helpers / overlap engine),
+# so the selection table, breakers, and stats see it.
 
 
 def gpipe_forward(
@@ -93,7 +98,7 @@ def gpipe_forward(
             axis=0,
         )
         # boundary transfer: stage s -> s+1 (the SendRecvList ring)
-        recv_next = lax.ppermute(y, axis, perm)
+        recv_next = lax.ppermute(y, axis, perm)  # mlsl-lint: disable=A201 -- boundary primitive
         return recv_next, banked
 
     _, outs = lax.fori_loop(0, ticks, tick, (recv, outs))
@@ -258,14 +263,14 @@ def one_f1b_step(
             rel % 2 == 0, f_branch, b_branch,
             (recv_f, recv_b, x_buf, grads, loss_acc),
         )
-        recv_f = lax.ppermute(send_f, axis, fwd_perm)
-        recv_b = lax.ppermute(send_b, axis, bwd_perm)
+        recv_f = lax.ppermute(send_f, axis, fwd_perm)  # mlsl-lint: disable=A201 -- boundary primitive
+        recv_b = lax.ppermute(send_b, axis, bwd_perm)  # mlsl-lint: disable=A201 -- boundary primitive
         return recv_f, recv_b, x_buf, grads, loss_acc
 
     _, _, _, grads, loss_acc = lax.fori_loop(
         0, ticks, tick, (recv_f, recv_b, x_buf, grads0, jnp.float32(0.0))
     )
-    return lax.psum(loss_acc, axis), grads
+    return algos.inline_allreduce(loss_acc, axis), grads
 
 
 def interleaved_schedule(n_stages: int, v_chunks: int, m_count: int) -> dict:
@@ -578,8 +583,8 @@ def interleaved_1f1b_step(
             kind == 2, b_branch, f_branch,
             (fwd_in, bwd_in, x_saved, grads, loss_acc),
         )
-        recv_f = lax.ppermute(send_f, axis, fwd_perm)
-        recv_b = lax.ppermute(send_b, axis, bwd_perm)
+        recv_f = lax.ppermute(send_f, axis, fwd_perm)  # mlsl-lint: disable=A201 -- boundary primitive
+        recv_b = lax.ppermute(send_b, axis, bwd_perm)  # mlsl-lint: disable=A201 -- boundary primitive
         fwd_in = jnp.where(
             tb["fstore_valid"][t, me] == 1,
             lax.dynamic_update_index_in_dim(
@@ -600,7 +605,7 @@ def interleaved_1f1b_step(
         0, sched["ticks"], tick,
         (fwd_in, bwd_in, x_saved, grads0, jnp.float32(0.0)),
     )
-    return lax.psum(loss_acc, axis), grads
+    return algos.inline_allreduce(loss_acc, axis), grads
 
 
 def pipeline_loss(
@@ -619,4 +624,43 @@ def pipeline_loss(
     me = lax.axis_index(axis)
     per_micro = jax.vmap(loss_head)(outs, y_micro)          # (M,)
     local = jnp.where(me == n_stages - 1, jnp.sum(per_micro), 0.0)
-    return lax.psum(local, axis)
+    return algos.inline_allreduce(local, axis)
+
+
+def reduce_microbatch_grads(
+    group,
+    counts,
+    *,
+    config=None,
+    compression=None,
+    algo=None,
+    stages=None,
+    block=None,
+):
+    """Data-parallel reduction of pipeline stage gradients THROUGH the
+    collective engine: -> (fn, plan) from comm/overlap.build_multi_reduce.
+
+    After a 1F1B step each stage holds its microbatch-accumulated stage
+    grads; replicating the pipeline across a data axis leaves one reduction
+    to run — this builds it as the engine's staged multi-tensor program, so
+    the selection table applies per tensor (on a two-tier world that is the
+    hierarchical 'hier' lowering, with the compressed DCN hop when
+    ``compression=QUANTIZATION``), the emission is staged newest-first, and
+    error-feedback residuals ride the returned-state convention. ``fn``
+    takes the flattened per-stage grad tensors as standard distributed
+    buffers (reversed start order = backward emission order), exactly
+    build_multi_reduce's contract."""
+    from mlsl_tpu.comm import overlap
+    from mlsl_tpu.types import CompressionType
+
+    kw = {}
+    if stages is not None:
+        kw["stages"] = stages
+    if block is not None:
+        kw["block"] = block
+    return overlap.build_multi_reduce(
+        group, list(counts),
+        compression=(compression if compression is not None
+                     else CompressionType.NONE),
+        algo=algo, config=config, **kw,
+    )

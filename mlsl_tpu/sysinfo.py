@@ -75,11 +75,19 @@ def topology_fingerprint() -> dict:
     (different machine / different slice shape) and must be re-measured, the
     same contract as the reference's AutoConfig re-probing per launch."""
     si = probe()
+    from mlsl_tpu.comm.mesh import world_tiers
+
+    tiers = world_tiers()
     return {
         "platform": si.platform,
         "device_kind": si.device_kind,
         "num_devices": si.num_devices,
         "num_hosts": si.num_hosts,
+        # two-tier shape (T slices x L devices/slice) or None for a flat
+        # world: a profile tuned on a two-tier mesh — where 'hier' cells
+        # and the DCN codec knob were measured — must not transfer to a
+        # flat one, and vice versa (comm/algos/hier.py)
+        "tiers": list(tiers) if tiers is not None else None,
     }
 
 

@@ -28,6 +28,7 @@ from __future__ import annotations
 from mlsl_tpu.log import log_info, log_warning
 from mlsl_tpu.tuner.profile import (  # noqa: F401  (public API)
     DEFAULT_PROFILE_FILE,
+    KNOB_CHOICES,
     KNOB_RANGES,
     TunedProfile,
     default_profile_path,
@@ -36,9 +37,9 @@ from mlsl_tpu.tuner.profile import (  # noqa: F401  (public API)
 from mlsl_tpu.tuner.sweep import run_sweep  # noqa: F401
 
 #: Config fields a profile's knob table may set (anything else in ``knobs``
-#: is measurement metadata, ignored on apply); ranges enforced at load
-#: (profile.KNOB_RANGES)
-TUNABLE_KNOBS = tuple(KNOB_RANGES)
+#: is measurement metadata, ignored on apply); numeric ranges / string
+#: choices enforced at load (profile.KNOB_RANGES / KNOB_CHOICES)
+TUNABLE_KNOBS = tuple(KNOB_RANGES) + tuple(KNOB_CHOICES)
 
 
 def apply_knobs(config, profile: TunedProfile) -> None:

@@ -58,6 +58,15 @@ KNOB_RANGES = {
     "sentinel_every": 0,
 }
 
+#: string-valued knobs -> allowed values: same load-time validation contract
+#: as KNOB_RANGES, for knobs that pick a variant rather than a magnitude
+KNOB_CHOICES = {
+    # DCN-tier codec for the 'hier' lowering (comm/algos/hier.py): profiles
+    # tuned on a two-tier mesh may carry the codec that measured best on
+    # its DCN; an exported MLSL_HIER_DCN_CODEC always wins
+    "hier_dcn_codec": ("int8", "f32", "topk"),
+}
+
 
 def default_profile_path() -> str:
     """Where an unnamed profile lands: ``MLSL_STATS_DIR`` (default CWD), the
@@ -184,6 +193,13 @@ def load_profile(path: str) -> TunedProfile:
             raise MLSLError(
                 f"MLSL_TUNE_PROFILE file {path} has invalid knob "
                 f"{name}={v!r} (expected a number >= {lo})"
+            )
+    for name, allowed in KNOB_CHOICES.items():
+        v = knobs.get(name)
+        if v is not None and v not in allowed:
+            raise MLSLError(
+                f"MLSL_TUNE_PROFILE file {path} has invalid knob "
+                f"{name}={v!r} (expected one of {', '.join(allowed)})"
             )
     return TunedProfile(
         fingerprint=doc["fingerprint"],

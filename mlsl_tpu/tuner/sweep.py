@@ -293,16 +293,21 @@ def _sweep_overlap_stages(devices, iters: int) -> dict:
 
 def _sweep_quant_lowering(devices, iters: int, block: int = 256) -> list:
     """Quantized-wire lowering cells: time the composed quant ring ('lax')
-    against the fused pallas kernel ('pallas_ring') per payload size on the
-    1D ring, so the selection table can route QUANTIZATION requests to the
-    fused kernel per (kind x size x topology) cell where it measures faster.
-    Skipped when the pallas kernel cannot run on this backend (off-TPU
-    without the interpret gate — and never measured under the interpreter,
-    which is a correctness vehicle, not a contender)."""
+    against the fused pallas kernel ('pallas_ring') and the two-tier
+    hierarchical wire ('hier') per payload size on the 1D ring, so the
+    selection table can route QUANTIZATION requests to the lowering that
+    measures faster per (kind x size x topology) cell. The pallas contender
+    joins only where the kernel can run on this backend (on-TPU: never
+    measured under the interpreter, a correctness vehicle, not a
+    contender); the hier contender joins only on a tiered world
+    (MLSL_MESH_TIERS / multislice). Note the CPU-mesh hier timing carries
+    no DCN model — on a real pod the DCN link decides, which is what the
+    hier cell measures there."""
     import jax
 
     from mlsl_tpu.comm.mesh import ProcessGroup, Topology
     from mlsl_tpu.comm import algos, quant_ring
+    from mlsl_tpu.comm.algos import hier
     from mlsl_tpu.ops import ring_kernels as rk
 
     n = len(devices)
@@ -310,7 +315,12 @@ def _sweep_quant_lowering(devices, iters: int, block: int = 256) -> list:
         return []
     topo = Topology(n, 1, devices=devices)
     group = ProcessGroup(topo, ("data",))
-    if not rk.eligible_quant(group, block) or rk.interpret_mode():
+    rings = [("lax", "lax")]
+    if rk.eligible_quant(group, block) and not rk.interpret_mode():
+        rings.append(("pallas", "pallas_ring"))
+    if hier.eligible_quant(group, block):
+        rings.append(("hier", "hier"))
+    if len(rings) == 1:
         return []
     shape = list(algos.group_shape(group))
     cells = []
@@ -321,7 +331,7 @@ def _sweep_quant_lowering(devices, iters: int, block: int = 256) -> list:
             np.zeros((*topo.grid_shape, elems), dtype=np.float32)
         )
         measured = {}
-        for ring, name in (("lax", "lax"), ("pallas", "pallas_ring")):
+        for ring, name in rings:
             fn, err_len = quant_ring.build_quantized_collective(
                 "allreduce", group, elems, block, ring=ring
             )

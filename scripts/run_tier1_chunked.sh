@@ -1,0 +1,69 @@
+#!/usr/bin/env bash
+# Per-file-chunk tier-1 runner: the documented fallback when a wall
+# `pytest tests/` run wedges with ZERO failures on the pre-existing XLA:CPU
+# rendezvous idle hang (KNOWN_FAILURES.md "idle hang in hybrid collective
+# tests"; its commit-time gate is analysis A102). PRs 9 and 10 both
+# re-invented this loop by hand — this is the one copy.
+#
+# Each test file runs in its OWN pytest process with its own timeout, so a
+# wedged process loses one file's budget instead of the whole wall run, and
+# the per-file results still sum to the tier-1 verdict (same flags as the
+# ROADMAP tier-1 line: -m 'not slow', no cacheprovider/xdist/randomly).
+#
+# Usage: scripts/run_tier1_chunked.sh [per-file-timeout-seconds]
+#   MLSL_T1_RETRY_HUNG=1  re-run a timed-out file once before recording it
+#                         (the hang is a coin-flip; a clean retry means the
+#                         file is green, not wedged)
+set -u
+cd "$(dirname "$0")/.."
+
+PER_FILE_TIMEOUT="${1:-300}"
+RETRY_HUNG="${MLSL_T1_RETRY_HUNG:-1}"
+LOGDIR="${MLSL_T1_LOGDIR:-/tmp/mlsl_tier1_chunks}"
+mkdir -p "$LOGDIR"
+
+failed_files=()
+hung_files=()
+total_passed=0
+
+run_file() {
+    local f="$1" log="$2"
+    timeout -k 10 "$PER_FILE_TIMEOUT" \
+        env JAX_PLATFORMS=cpu python -m pytest "$f" -q -m 'not slow' \
+        --continue-on-collection-errors -p no:cacheprovider -p no:xdist \
+        -p no:randomly >"$log" 2>&1
+}
+
+for f in tests/test_*.py; do
+    log="$LOGDIR/$(basename "$f" .py).log"
+    run_file "$f" "$log"
+    rc=$?
+    if [ "$rc" -eq 124 ] && [ "$RETRY_HUNG" = "1" ]; then
+        echo "RETRY (timeout) $f" >&2
+        run_file "$f" "$log"
+        rc=$?
+    fi
+    passed=$(grep -aEo '[0-9]+ passed' "$log" | tail -1 | grep -aEo '[0-9]+' || echo 0)
+    total_passed=$((total_passed + passed))
+    if [ "$rc" -eq 124 ]; then
+        hung_files+=("$f")
+        echo "HUNG   $f (>${PER_FILE_TIMEOUT}s; log: $log)"
+    elif [ "$rc" -ne 0 ] && [ "$rc" -ne 5 ]; then
+        # rc 5 = no tests collected under the marker filter: not a failure
+        failed_files+=("$f")
+        echo "FAIL   $f (rc=$rc; log: $log)"
+    else
+        echo "OK     $f ($passed passed)"
+    fi
+done
+
+echo "----"
+echo "DOTS_PASSED=$total_passed"
+if [ "${#failed_files[@]}" -gt 0 ]; then
+    echo "FAILED FILES: ${failed_files[*]}"
+fi
+if [ "${#hung_files[@]}" -gt 0 ]; then
+    echo "HUNG FILES (rendezvous-hang suspects; see KNOWN_FAILURES.md):" \
+         "${hung_files[*]}"
+fi
+[ "${#failed_files[@]}" -eq 0 ] && [ "${#hung_files[@]}" -eq 0 ]

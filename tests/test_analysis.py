@@ -231,6 +231,9 @@ SWEEP = [
     ("pallas_interpret", {"MLSL_PALLAS_INTERPRET": "1",
                           "MLSL_ALGO": "pallas_ring"},
      {"compression": CompressionType.QUANTIZATION}),
+    ("hier_dense", {"MLSL_MESH_TIERS": "2x4", "MLSL_ALGO": "hier"}, {}),
+    ("hier_quant", {"MLSL_MESH_TIERS": "2x4", "MLSL_ALGO": "hier"},
+     {"compression": CompressionType.QUANTIZATION}),
 ]
 
 
