@@ -20,6 +20,7 @@ from mlsl_tpu.types import (
 )
 from mlsl_tpu.log import (
     MLSLCorruptionError,
+    MLSLDeviceLossError,
     MLSLError,
     MLSLIntegrityError,
     MLSLTimeoutError,
@@ -53,5 +54,6 @@ __all__ = [
     "MLSLError",
     "MLSLTimeoutError",
     "MLSLCorruptionError",
+    "MLSLDeviceLossError",
     "MLSLIntegrityError",
 ]

@@ -56,6 +56,10 @@ CODES: Dict[str, Tuple[str, str]] = {
                          "in-flight hop window"),
     "MLSL-A132": (WARN,  "pallas ring VMEM slot-buffer budget estimate "
                          "exceeded"),
+    "MLSL-A140": (ERROR, "elastic reshard plan does not cover every ZeRO-1 "
+                         "shard element exactly once (gap or overlap)"),
+    "MLSL-A141": (ERROR, "elastic reshard target geometry disagrees with "
+                         "the survivor world (padded/shard mismatch)"),
     # -- AST linter (A2xx): project concurrency/idiom rules -----------------
     "MLSL-A200": (ERROR, "unparseable source file (syntax error: no rule "
                          "can run)"),

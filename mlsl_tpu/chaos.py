@@ -33,7 +33,10 @@ fires at most N times (default 1; ``x*`` = unlimited); ``%p`` makes each
 eligible hit fire with probability *p* (e.g.
 ``collective.dispatch:errorx*%0.05`` — a 5% flaky dispatch; ``%p`` is the
 trailing suffix, after ``xN``), so randomized
-soak runs need no hand-scheduled ``@after`` budgets. The fire decisions
+soak runs need no hand-scheduled ``@after`` budgets. At the ``device.lost``
+site an ``error`` plan raises :class:`MLSLDeviceLossError` by default
+(``device.lost:error[@after][xN][%p]`` — the elastic-mesh fault; docs
+DESIGN.md "Elastic mesh"). The fire decisions
 come from a module RNG seeded by ``MLSL_CHAOS_SEED`` (or :func:`seed`), so
 a probabilistic soak replays exactly.
 
@@ -49,9 +52,15 @@ import os
 import random
 import threading
 import time
-from typing import Dict, List, Optional
+from typing import Dict, List, Optional, Tuple
 
-from mlsl_tpu.log import MLSLCorruptionError, MLSLError, log_info, log_warning
+from mlsl_tpu.log import (
+    MLSLCorruptionError,
+    MLSLDeviceLossError,
+    MLSLError,
+    log_info,
+    log_warning,
+)
 
 
 class ChaosError(RuntimeError):
@@ -83,6 +92,16 @@ SITES: Dict[str, str] = {
     "train.grads": "local gradients before the quality gate and gradient "
                    "comm (models/train.py); silent=nan/inf poisons an "
                    "element the gate's nonfinite screen must catch",
+    # Elastic-mesh fault (comm/collectives.py dispatch + mlsl_tpu/elastic.py
+    # admission): an 'error' plan raises MLSLDeviceLossError (the default
+    # exception at THIS site) — routed to the elastic reshard rung when a
+    # coordinator is armed, to checkpoint restart otherwise. A 'silent' plan
+    # is consulted by ElasticCoordinator.grow: it corrupts the REJOINING
+    # replica's copy of the params so the sentinel admission audit has
+    # something to reject (the re-admission quarry).
+    "device.lost": "device/slice loss at collective dispatch "
+                   "(comm/collectives.py) and at elastic re-admission "
+                   "(elastic.py grow; silent corrupts the rejoining copy)",
 }
 
 KINDS = ("error", "delay", "hang", "bitrot", "silent")
@@ -92,6 +111,7 @@ _EXC_NAMES = {
     "runtimeerror": RuntimeError,
     "mlslerror": MLSLError,
     "corruptionerror": MLSLCorruptionError,
+    "devicelosserror": MLSLDeviceLossError,
     "oserror": OSError,
     "ioerror": OSError,
     "valueerror": ValueError,
@@ -154,7 +174,7 @@ _plans: Dict[str, List[Plan]] = {}  # site -> armed plans (empty dict = idle)
 def plan(
     site: str,
     kind: str = "error",
-    exc: type = ChaosError,
+    exc: Optional[type] = None,
     seconds: float = 0.1,
     after: int = 0,
     times: Optional[int] = 1,
@@ -172,6 +192,12 @@ def plan(
         raise ValueError(f"unknown chaos kind {kind!r}; known: {KINDS}")
     if not 0.0 < prob <= 1.0:
         raise ValueError(f"chaos probability must be in (0, 1] (got {prob!r})")
+    if exc is None:
+        # per-site semantic default (None = caller named nothing, so an
+        # EXPLICIT exc=ChaosError still wins for cross-class tests): a lost
+        # device IS a device-loss error — grammar
+        # `device.lost:error[@after][xN][%p]` carries no exception name
+        exc = MLSLDeviceLossError if site == "device.lost" else ChaosError
     p = Plan(site=site, kind=kind, exc=exc, seconds=seconds, after=after,
              times=times, prob=prob, mag=mag)
     with _lock:
@@ -224,7 +250,8 @@ def active() -> bool:
     return bool(_plans)
 
 
-def inject(site: str, **ctx) -> Optional[Plan]:
+def inject(site: str, kinds: Optional[Tuple[str, ...]] = None,
+           **ctx) -> Optional[Plan]:
     """Pass ``site``. No-op (one dict check) unless a plan is armed there.
 
     ``error`` raises the plan's exception, ``delay`` sleeps, ``hang`` sleeps
@@ -233,6 +260,12 @@ def inject(site: str, **ctx) -> Optional[Plan]:
     Plan is returned and the call site applies the effect (checkpoint.py
     corrupts the committed files; models/train.py corrupts live state via
     sentinel.corrupt_silent). ``ctx`` is free-form, logged for diagnosis.
+
+    ``kinds`` restricts which plan kinds this pass may fire (and therefore
+    consume): a site with two consumers — collective dispatch fires
+    ``device.lost`` error-shaped loss, elastic grow applies the ``silent``
+    rejoiner corruption — must not burn the other consumer's ``times``
+    budget. A plan whose kind is filtered out stays armed, untouched.
     """
     if not _plans:
         return None
@@ -241,6 +274,8 @@ def inject(site: str, **ctx) -> Optional[Plan]:
         return None
     fired: Optional[Plan] = None
     for p in list(site_plans):
+        if kinds is not None and p.kind not in kinds:
+            continue
         with _lock:
             go = p._should_fire()
         if not go:

@@ -79,6 +79,12 @@ class CheckpointManager:
         # manifest at flush (the "verified-good" half of the integrity
         # sentinel: restore_trainer prefers steps that carry one)
         self._fingerprints: dict = {}
+        # step -> active world size at save time (elastic mesh): a
+        # checkpoint saved on a shrunk world carries ZeRO-1 shard shapes a
+        # different world cannot restore — the manifest records the size so
+        # restore_trainer can NAME the mismatch instead of surfacing an
+        # opaque shape error
+        self._worlds: dict = {}
         self._mgr = ocp.CheckpointManager(
             self.directory,
             options=ocp.CheckpointManagerOptions(
@@ -99,13 +105,16 @@ class CheckpointManager:
     # -- save/restore ------------------------------------------------------
 
     def save(self, step: int, state: Any, wait: bool = False,
-             fingerprint: Optional[str] = None) -> None:
+             fingerprint: Optional[str] = None,
+             world: Optional[int] = None) -> None:
         """Dispatch an async save of ``state`` (any pytree of arrays).
 
         ``fingerprint`` is a PASSING sentinel audit digest of this state
         (mlsl_tpu.sentinel); it is recorded in the step's manifest, marking
         the step *verified* — ``restore_trainer`` prefers verified steps and
         FaultTolerantLoop's post-restore re-audit compares against it.
+        ``world`` is the active world size at save time (elastic mesh),
+        recorded in the manifest for restore-time mismatch diagnosis.
 
         Transient IO errors (OSError) at dispatch retry with exponential
         backoff; anything else propagates (recoverable by FaultTolerantLoop).
@@ -113,6 +122,8 @@ class CheckpointManager:
         self.check_errors()
         if fingerprint is not None:
             self._fingerprints[step] = fingerprint
+        if world is not None:
+            self._worlds[step] = int(world)
         tr = obs._tracer
         t0 = tr.now() if tr is not None else 0
         delay = self.retry_backoff_s
@@ -256,6 +267,9 @@ class CheckpointManager:
                 continue  # still in flight
             manifest = {"step": step, "written_at": time.time(),
                         "files": self._checksum_tree(d)}
+            w = self._worlds.pop(step, None)
+            if w is not None:
+                manifest["world"] = w
             fp = self._fingerprints.pop(step, None)
             if fp is not None:
                 # verified-good marker: the state in this step passed the
@@ -317,6 +331,20 @@ class CheckpointManager:
             return None
         return (manifest.get("sentinel") or {}).get("fingerprint")
 
+    def recorded_world(self, step: int) -> Optional[int]:
+        """The active world size this step's manifest records, or None (no
+        manifest, or a pre-elastic save)."""
+        w = self._worlds.get(step)
+        if w is not None:
+            return w
+        try:
+            with open(self._manifest_path(step)) as f:
+                manifest = json.load(f)
+        except (OSError, ValueError):
+            return None
+        w = manifest.get("world")
+        return int(w) if w is not None else None
+
     def verify(self, step: int) -> Optional[bool]:
         """True: manifest present and every file matches. False: corrupt
         (mismatch, missing file, or unreadable manifest). None: no manifest
@@ -363,13 +391,19 @@ def _apply_state(trainer, state) -> int:
     return int(state["step"])
 
 
+def _trainer_world(trainer) -> Optional[int]:
+    mesh = getattr(trainer, "mesh", None)
+    return int(mesh.devices.size) if mesh is not None else None
+
+
 def save_trainer(mgr: CheckpointManager, trainer, step: int, wait: bool = False,
                  fingerprint: Optional[str] = None) -> None:
     """Persist a DataParallelTrainer/HybridTrainer's parameters (and optimizer
     state, when the trainer carries one). ``fingerprint`` marks the step
-    sentinel-verified (see CheckpointManager.save)."""
+    sentinel-verified (see CheckpointManager.save); the active world size
+    rides in the manifest so a cross-world restore names its mismatch."""
     mgr.save(step, _trainer_state(trainer, step), wait=wait,
-             fingerprint=fingerprint)
+             fingerprint=fingerprint, world=_trainer_world(trainer))
 
 
 def restore_trainer(mgr: CheckpointManager, trainer, step: Optional[int] = None) -> Optional[int]:
@@ -403,6 +437,7 @@ def restore_trainer(mgr: CheckpointManager, trainer, step: Optional[int] = None)
             "unverified step %d (no passing audit fingerprint recorded)",
             verified[0], unverified[0],
         )
+    world_now = _trainer_world(trainer)
     for s in verified + unverified:
         verdict = mgr.verify(s)
         if verdict is False:
@@ -410,6 +445,18 @@ def restore_trainer(mgr: CheckpointManager, trainer, step: Optional[int] = None)
                 "checkpoint step %d fails checksum verification; falling back", s
             )
             continue
+        w = mgr.recorded_world(s)
+        if w is not None and world_now is not None and w != world_now:
+            # elastic mesh: the step was saved at a different world size.
+            # Replicated-only state restores anyway (and a same-shape ZeRO-1
+            # layout would too), so still TRY — but name the mismatch first,
+            # because the opaque alternative is an orbax shape error
+            log_warning(
+                "checkpoint step %d was saved at world size %d but the "
+                "active world is %d (elastic reshard between save and "
+                "restore); ZeRO-1 shard shapes may not restore", s, w,
+                world_now,
+            )
         try:
             state = mgr.restore(s, template=template)
         except Exception as e:

@@ -649,6 +649,14 @@ class _ChaosDispatch:
 
         if chaos._plans:
             chaos.inject("collective.dispatch", kind=self._kind)
+            # elastic-mesh fault: an armed device.lost plan raises
+            # MLSLDeviceLossError here — the dispatch is where a vanished
+            # peer actually surfaces (the collective cannot complete), and
+            # the supervisor routes it to the reshard rung, never a breaker.
+            # 'silent' plans are elastic grow's (the rejoiner corruption);
+            # firing them here would burn their budget before grow polls
+            chaos.inject("device.lost", kinds=("error", "delay", "hang"),
+                         kind=self._kind)
         return self._fn(*bufs)
 
     @property

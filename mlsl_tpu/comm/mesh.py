@@ -153,6 +153,35 @@ def world_tiers(devices=None) -> Optional[Tuple[int, int]]:
     return t, l
 
 
+def survivor_devices(lost, devices=None) -> Tuple[jax.Device, ...]:
+    """The post-loss world: ``devices`` (default the full jax world) minus
+    ``lost``, in original world-rank order — the survivor set an elastic
+    reshard (mlsl_tpu.elastic) re-derives its Topology over.
+
+    Tier-aware: on a tiered world (TPU multislice ``slice_index`` or the
+    synthetic ``MLSL_MESH_TIERS`` split) losing ANY member of a tier drops
+    the WHOLE tier — the slice's ICI domain is broken and a partial slice
+    can neither ride the tiered lowerings nor keep the uniform two-tier
+    shape the hier engine/fingerprint key on. Flat worlds shed exactly the
+    lost devices. Raises MLSLError when nothing would survive."""
+    from mlsl_tpu.log import MLSLError
+
+    devices = tuple(jax.devices() if devices is None else devices)
+    lost_set = set(lost)
+    ids = world_tier_ids(devices)
+    if ids is not None:
+        dead_tiers = {t for d, t in zip(devices, ids) if d in lost_set}
+        out = tuple(d for d, t in zip(devices, ids) if t not in dead_tiers)
+    else:
+        out = tuple(d for d in devices if d not in lost_set)
+    if not out:
+        raise MLSLError(
+            f"device loss of {len(lost_set)} device(s) leaves no survivors "
+            f"in the {len(devices)}-device world (tiered={ids is not None})"
+        )
+    return out
+
+
 class Topology:
     """The device world arranged as a (replica, data, seq, model) mesh.
 

@@ -601,6 +601,12 @@ class CommRequest:
                         attempt += 1
                         time.sleep(delay)
                         continue
+                if cls is supervisor.ErrorClass.DEVICE_LOSS:
+                    # capacity left the world: a breaker fallback would
+                    # re-dispatch on the same (now partial) mesh and mask
+                    # the loss — escalate straight to the elastic/restart
+                    # rungs, without counting the subsystem as unhealthy
+                    raise
                 if (
                     not degraded
                     and br is not None

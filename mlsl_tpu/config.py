@@ -220,6 +220,28 @@ class Config:
     # (like the checkpoint retry knobs: recorded here for discoverability —
     # override via the FaultTolerantLoop ctor, not by mutating this field).
     restart_budget: int = 20            # MLSL_RESTART_BUDGET
+    # --- elastic mesh (mlsl_tpu.elastic; docs/TUNING.md §18) ---
+    # Arm the elastic coordinator: a DEVICE_LOSS fault (preemption, the
+    # chaos device.lost site) is answered by re-deriving the mesh among
+    # survivors and re-sharding ZeRO-1 optimizer state live — no checkpoint
+    # restore — instead of the restart rung. Off, every loss restarts
+    # (pre-elastic behavior, bit-for-bit unchanged).
+    elastic: bool = False               # MLSL_ELASTIC
+    # Capacity budget: total devices the run may shed across its lifetime
+    # before a further loss escalates to the restart rung (the elastic
+    # analog of MLSL_RESTART_BUDGET — bounded capacity churn, not bounded
+    # restarts). 0 = auto: half the world, resolved at coordinator
+    # construction where the world size is known.
+    capacity_budget: int = 0            # MLSL_CAPACITY_BUDGET
+    # Simulated/announced capacity return: steps after a shrink at which the
+    # lost devices rejoin (through the admission audit). 0 = only on an
+    # explicit ElasticCoordinator.announce_return() (production: the
+    # replacement host announcing itself).
+    elastic_grow_after: int = 0         # MLSL_ELASTIC_GROW_AFTER
+    # Admission-audit retries: a rejoining replica whose fingerprint audit
+    # fails is re-synced from a survivor copy and re-audited up to this many
+    # times before the grow is abandoned.
+    elastic_admit_retries: int = 1      # MLSL_ELASTIC_ADMIT_RETRIES
     # --- integrity sentinel (mlsl_tpu.sentinel; docs/TUNING.md §13) ---
     # Step quality gate response: '' = gate off; 'warn' logs and continues,
     # 'skip_step' discards the poisoned update (EF residuals and data order
@@ -390,6 +412,21 @@ class Config:
             "MLSL_RESTART_BUDGET must be >= 0 (got %d)", self.restart_budget,
         )
         mlsl_assert(
+            self.capacity_budget >= 0,
+            "MLSL_CAPACITY_BUDGET must be >= 0 (0 = half the world; got %d)",
+            self.capacity_budget,
+        )
+        mlsl_assert(
+            self.elastic_grow_after >= 0,
+            "MLSL_ELASTIC_GROW_AFTER must be >= 0 (0 = manual announce; "
+            "got %d)", self.elastic_grow_after,
+        )
+        mlsl_assert(
+            self.elastic_admit_retries >= 0,
+            "MLSL_ELASTIC_ADMIT_RETRIES must be >= 0 (got %d)",
+            self.elastic_admit_retries,
+        )
+        mlsl_assert(
             self.sentinel_gate in ("", "warn", "skip_step", "rollback"),
             "MLSL_SENTINEL_GATE must be one of '', 'warn', 'skip_step', "
             "'rollback' (got %r)", self.sentinel_gate,
@@ -508,6 +545,14 @@ class Config:
             "MLSL_BREAKER_COOLDOWN_S", c.breaker_cooldown_s
         )
         c.restart_budget = _env_int("MLSL_RESTART_BUDGET", c.restart_budget)
+        c.elastic = _env_bool("MLSL_ELASTIC", c.elastic)
+        c.capacity_budget = _env_int("MLSL_CAPACITY_BUDGET", c.capacity_budget)
+        c.elastic_grow_after = _env_int(
+            "MLSL_ELASTIC_GROW_AFTER", c.elastic_grow_after
+        )
+        c.elastic_admit_retries = _env_int(
+            "MLSL_ELASTIC_ADMIT_RETRIES", c.elastic_admit_retries
+        )
         c.sentinel_gate = os.environ.get("MLSL_SENTINEL_GATE", c.sentinel_gate)
         c.sentinel_every = _env_int("MLSL_SENTINEL_EVERY", c.sentinel_every)
         c.sentinel_spike = _env_float("MLSL_SENTINEL_SPIKE", c.sentinel_spike)

@@ -93,7 +93,18 @@ class Environment:
         from mlsl_tpu import supervisor
 
         supervisor.configure(self.config)
-        self.devices = tuple(devices) if devices is not None else tuple(jax.devices())
+        if devices is not None:
+            self.devices = tuple(devices)
+        else:
+            # elastic-mesh registry (mlsl_tpu.elastic): after a shrink, a
+            # recovery/factory rebuild with no explicit device list must
+            # adopt the survivor world, not silently re-inflate to the full
+            # one — the registry outlives Environment teardown by design
+            from mlsl_tpu import elastic as elastic_mod
+
+            self.devices = (
+                elastic_mod.active_devices() or tuple(jax.devices())
+            )
         # the persistent XLA cache must be armed BEFORE the tuner sweep: the
         # sweep compiles every eligible algorithm x size x shape program, and
         # on real chips those compiles are the tens-of-seconds cost the cache

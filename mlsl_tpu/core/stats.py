@@ -222,6 +222,48 @@ def reset_sentinel_counters() -> None:
         SENTINEL_COUNTERS[k] = 0
 
 
+# Elastic-mesh accounting (mlsl_tpu.elastic): device losses routed to the
+# reshard rung, shrink/grow cycles, and the re-admission audit verdicts —
+# process-wide like the degrade counters (the coordinator outlives every
+# Environment rebuild it performs). Cold events (a reshard is rarer than a
+# breaker trip) append an immediate ELASTIC line to mlsl_stats.log, the same
+# contract as DEGRADE transitions; Statistics.print_ renders the totals.
+ELASTIC_COUNTERS: Dict[str, int] = {
+    "device_losses": 0,     # DEVICE_LOSS faults reaching the coordinator
+    "shrinks": 0,           # successful shrink reshard cycles
+    "grows": 0,             # successful grow (re-admission) cycles
+    "grow_abandons": 0,     # grows abandoned on persistent divergence
+    "admits": 0,            # replicas admitted on a passing fingerprint audit
+    "admit_rejects": 0,     # admission audits that found divergence
+    "resyncs": 0,           # rejected copies re-broadcast from survivors
+    "reshard_buffers": 0,   # ZeRO-1 state buffers moved by reshard plans
+    "restart_fallbacks": 0,  # losses escalated to checkpoint restart
+}
+
+
+def record_elastic(event: str, detail: str = "", n: int = 1) -> None:
+    """One elastic-mesh event (see ELASTIC_COUNTERS keys). Events that mark
+    a topology change or an admission verdict get an immediate ELASTIC line
+    in mlsl_stats.log; per-buffer accounting only bumps the counter."""
+    ELASTIC_COUNTERS[event] += n
+    # every event is cold (topology change / admission verdict) except the
+    # per-buffer accounting — state the exception so a new counter cannot
+    # silently fall out of the immediate-line contract
+    if event != "reshard_buffers":
+        try:
+            with open(stats_path(), "a") as f:
+                f.write(
+                    f"{'ELASTIC':<16} {event.upper():<16} {detail}\n"
+                )
+        except OSError:
+            pass
+
+
+def reset_elastic_counters() -> None:
+    for k in ELASTIC_COUNTERS:
+        ELASTIC_COUNTERS[k] = 0
+
+
 # Buffer-checker accounting (mlsl_tpu.checker): how many buffers CHKP
 # inspected, how many violated the contract, and how many device syncs the
 # batched CHKP_VALUES finiteness path actually paid (the point of batching:
@@ -824,6 +866,22 @@ class Statistics:
                 f"verified_saves {sc['verified_saves']} "
                 f"reaudits {sc['reaudits']}"
             )
+        ec = ELASTIC_COUNTERS
+        if any(ec.values()):
+            # the elastic story: how many device losses the run absorbed by
+            # rescaling instead of restarting, and whether every returning
+            # replica passed its admission audit — one grep ('ELASTIC')
+            # answers "did capacity churn cost this run a restart"
+            lines.append(
+                f"{'ELASTIC':<16} {'MESH':<8} "
+                f"losses {ec['device_losses']} "
+                f"shrinks {ec['shrinks']} grows {ec['grows']} "
+                f"abandons {ec['grow_abandons']} "
+                f"admits {ec['admits']} rejects {ec['admit_rejects']} "
+                f"resyncs {ec['resyncs']} "
+                f"reshard_buffers {ec['reshard_buffers']} "
+                f"restart_fallbacks {ec['restart_fallbacks']}"
+            )
         kc = CHKP_COUNTERS
         if any(kc.values()):
             lines.append(
@@ -847,6 +905,9 @@ class Statistics:
                 # its own ANALYSIS line above, so the ladder summary skips it
                 if "state" in st
                 and (st["state"] == "tripped" if name == "sentinel"
+                     # elastic's healthy vocabulary is 'full', which never
+                     # equals CLOSED — list it only when actually shrunk
+                     else st["state"] == "shrunk" if name == "elastic"
                      else st.get("trips") or st["state"] != supervisor.CLOSED)
             )
             fb = " ".join(

@@ -1,0 +1,742 @@
+"""Elastic mesh: survive preemption and device loss by rescaling, not restarting.
+
+Every prior rung of the resilience ladder (retry, breaker degrade,
+checkpoint restart — mlsl_tpu.supervisor / resilience) answers a fault by
+re-running the SAME plan on the SAME world. A preempted host breaks that
+premise: the capacity is *gone*, and checkpoint-restart into the original
+world size stalls the whole job until identical capacity returns. This
+module turns the ladder's last rung from a restart budget into a *capacity
+budget* (ROADMAP #4): on a ``DEVICE_LOSS`` fault the coordinator
+
+1. **shrinks** — re-derives the mesh among survivors
+   (``comm/mesh.survivor_devices``: flat worlds shed exactly the lost
+   devices; tiered worlds drop the whole affected slice, whose ICI domain
+   is broken), re-initializes the Environment over the survivor set, and
+   carries the training state across LIVE: params/replicated optimizer
+   state re-broadcast, ZeRO-1 owned-shard optimizer state re-sharded via
+   the engine's all-gather drain collective (``optim.gather_owned_full``)
+   and re-partitioned onto the survivor world's ownership chunks
+   (``optim.place_owned_vector``) — **no checkpoint restore**. The reshard
+   plan is statically verified first (``analysis/plan.verify_reshard``,
+   MLSL-A140/A141: every shard element moved exactly once) — a covering bug
+   here would silently corrupt the state it exists to carry, so the check
+   is unconditional, not gated by ``MLSL_VERIFY``.
+2. **continues** at the very step the loss interrupted: the failed step
+   never applied its update, so replaying it on the survivor mesh keeps the
+   loss trajectory continuous (no replay window, no recovery counted).
+3. **grows** when capacity returns (``announce_return()`` or the
+   ``MLSL_ELASTIC_GROW_AFTER`` timer): the full world is re-derived, state
+   is re-sharded back, and the returning replica is **admitted only after a
+   sentinel fingerprint audit** — the PR 7 cross-replica bit-fingerprint
+   (``sentinel.Sentinel.audit_now``) is exactly the admission check. A
+   failing audit re-syncs the rejoiner from a survivor copy and re-audits
+   (``MLSL_ELASTIC_ADMIT_RETRIES``); persistent divergence abandons the
+   grow.
+
+Grace-window contract: the shrink drain collective runs on the
+*pre-reshard* mesh — survivors plus the departing rank — which is exactly
+the TPU-pod preemption model (SIGTERM arrives, the host is reachable for a
+drain window; the PR 1 ``PreemptionGuard`` detects it). A truly instant
+loss whose shard is unreachable surfaces as a failed drain and falls back
+to the restart rung, where verified checkpoints still win (docs/DESIGN.md
+"Elastic mesh": when restart still wins).
+
+Scope: ``DataParallelTrainer`` state layouts (replicated params/optax state
++ per-layer ZeRO-1 owned-shard state). Trainers without those attributes
+fail the harvest loudly and take the restart rung.
+
+Knobs (docs/TUNING.md §18, validated in Config.validate): ``MLSL_ELASTIC``,
+``MLSL_CAPACITY_BUDGET``, ``MLSL_ELASTIC_GROW_AFTER``,
+``MLSL_ELASTIC_ADMIT_RETRIES``.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Optional, Sequence, Tuple
+
+import numpy as np
+import jax
+
+from mlsl_tpu.log import (
+    MLSLDeviceLossError,
+    MLSLError,
+    log_info,
+    log_warning,
+    mlsl_assert,
+)
+
+# -- process-wide active world -------------------------------------------------
+#
+# Like the chaos registry and the breakers, the active world survives
+# Environment teardown/rebuild cycles BY DESIGN: FaultTolerantLoop's
+# make_trainer factories call ``Environment.init()`` with no device list,
+# and init consults this registry so a post-shrink rebuild lands on the
+# survivor world instead of silently re-adopting the full one.
+
+_active: Optional[Tuple] = None
+
+#: last reshard/admission verdict, for supervisor.status()['elastic'] and
+#: post-mortems (which world-size transition, which verdict, at which step)
+_last_reshard: Optional[dict] = None
+
+
+def active_devices() -> Optional[Tuple]:
+    """The survivor world a rebuilt Environment must adopt, or None (full
+    world). Consulted by ``Environment.init`` when no explicit device list
+    is passed."""
+    return _active
+
+
+def _set_active(devices: Optional[Sequence]) -> None:
+    global _active
+    _active = tuple(devices) if devices is not None else None
+
+
+def reset() -> None:
+    """Clear the active-world registry, verdict record, and capacity-budget
+    snapshot (tests) — a stale budget from a dead coordinator would
+    otherwise leak into ``status()``."""
+    global _last_reshard
+    _set_active(None)
+    _last_reshard = None
+    _budget_info[0] = None
+    _budget_info[1] = 0
+
+
+def armed(config=None) -> bool:
+    """Is the elastic coordinator armed (MLSL_ELASTIC / Config.elastic)?"""
+    if config is not None:
+        return bool(getattr(config, "elastic", False))
+    from mlsl_tpu.config import _env_bool
+
+    return _env_bool("MLSL_ELASTIC", False)
+
+
+def status() -> dict:
+    """Elastic-mesh summary for ``supervisor.status()`` dashboards: active
+    vs full world size, capacity budget remaining, the event counters, and
+    the last reshard verdict. ``state`` mirrors the breaker vocabulary:
+    'full' (no capacity shed), 'shrunk' (running on survivors)."""
+    from mlsl_tpu.core import stats as stats_mod
+
+    try:
+        world = len(jax.devices())
+    except Exception:  # pragma: no cover - backend init failure
+        world = None
+    active = len(_active) if _active is not None else world
+    out = {
+        "state": "shrunk" if _active is not None else "full",
+        "world_size": world,
+        "active_size": active,
+        **{k: v for k, v in stats_mod.ELASTIC_COUNTERS.items()},
+    }
+    out["capacity_budget"] = _budget_info[0]
+    out["budget_remaining"] = (
+        max(0, _budget_info[0] - _budget_info[1])
+        if _budget_info[0] is not None else None
+    )
+    if _last_reshard is not None:
+        out["last_reshard"] = dict(_last_reshard)
+    return out
+
+
+#: (budget, shed_total) of the live coordinator — module-level so status()
+#: reports it after the loop (and its coordinator handle) are gone
+_budget_info: list = [None, 0]
+
+
+class ElasticCoordinator:
+    """Drives shrink -> continue -> grow -> continue for a
+    FaultTolerantLoop (which routes DEVICE_LOSS faults here and polls
+    :meth:`maybe_grow` between steps).
+
+    Factory contract: ``make_trainer`` must size its Distribution from the
+    ACTIVE world (``env.get_process_count()`` after ``Environment.init()``),
+    not a constant — the whole point of a reshard is that the world size
+    changed underneath it.
+    """
+
+    def __init__(self, capacity_budget: Optional[int] = None,
+                 grow_after: Optional[int] = None,
+                 admit_retries: Optional[int] = None):
+        # knobs through Config's parser/defaults (the restart-budget
+        # pattern: one definition, the init-time MLSLError contract). An
+        # exported env var wins; otherwise the LIVE config — a programmatic
+        # Config(capacity_budget=3) must bind exactly like the env knob —
+        # and the class default when no Environment is up
+        from mlsl_tpu.config import Config, _env_int
+        from mlsl_tpu.core.environment import Environment
+
+        cfg = (Environment._instance.config
+               if Environment.is_initialized() else None)
+        if cfg is None:
+            cfg = Config
+        try:
+            if capacity_budget is None:
+                capacity_budget = _env_int(
+                    "MLSL_CAPACITY_BUDGET", cfg.capacity_budget
+                )
+            if grow_after is None:
+                grow_after = _env_int(
+                    "MLSL_ELASTIC_GROW_AFTER", cfg.elastic_grow_after
+                )
+            if admit_retries is None:
+                admit_retries = _env_int(
+                    "MLSL_ELASTIC_ADMIT_RETRIES", cfg.elastic_admit_retries
+                )
+        except ValueError as e:
+            raise MLSLError(f"invalid MLSL_ELASTIC_*/MLSL_CAPACITY_BUDGET "
+                            f"value: {e}") from e
+        mlsl_assert(
+            capacity_budget >= 0 and grow_after >= 0 and admit_retries >= 0,
+            "elastic knobs must be >= 0 (budget=%d, grow_after=%d, "
+            "admit_retries=%d)", capacity_budget, grow_after, admit_retries,
+        )
+        self.world: Tuple = tuple(jax.devices())
+        # 0 = auto: half the world — losing a majority leaves too little
+        # compute for the shrunk job to be worth keeping alive vs restarting
+        # on fresh capacity
+        self.capacity_budget = capacity_budget or max(1, len(self.world) // 2)
+        self.grow_after = grow_after
+        self.admit_retries = admit_retries
+        self.shed_total = 0
+        self._return_due: Optional[int] = None
+        self._pending_return = False
+        _budget_info[0] = self.capacity_budget
+        _budget_info[1] = 0
+
+    # -- capacity-return signalling ---------------------------------------
+
+    def announce_return(self) -> None:
+        """Capacity is back (production: the replacement host announced
+        itself). The next :meth:`maybe_grow` poll performs the grow."""
+        self._pending_return = True
+
+    # -- shrink ------------------------------------------------------------
+
+    def shrink(self, trainer, make_trainer, error=None, step: int = 0):
+        """Answer one DEVICE_LOSS fault: drain state off the pre-loss mesh,
+        rebuild over survivors, carry the state live. Returns the survivor
+        trainer; raises (MLSLError) when the capacity budget refuses the
+        loss or the drain/rebuild fails — the caller escalates to the
+        restart rung."""
+        from mlsl_tpu.comm import mesh as mesh_mod
+        from mlsl_tpu.core import stats as stats_mod
+        from mlsl_tpu.core.environment import Environment
+        from mlsl_tpu.obs import tracer as obs
+
+        active = _active if _active is not None else self.world
+        lost = tuple(getattr(error, "devices", ()) or ())
+        if not lost:
+            # loss observed but not attributed (a failed collective knows a
+            # peer vanished, not which): default shed policy — the highest-
+            # ranked active device; survivor_devices expands it to the whole
+            # tier on a tiered world
+            lost = (active[-1],)
+        survivors = mesh_mod.survivor_devices(lost, active)
+        shed = len(active) - len(survivors)
+        detail = (f"step={step} world {len(active)}->{len(survivors)} "
+                  f"shed={shed} budget={self.shed_total + shed}"
+                  f"/{self.capacity_budget}")
+        stats_mod.record_elastic("device_losses", detail)
+        if shed == 0:
+            # a loss attributing only devices already outside the active
+            # world (a stale preemption notice re-surfacing) would make this
+            # a no-op reshard — and the loop's reshard branch spends neither
+            # budget nor retry attempts, so honoring it spins forever
+            stats_mod.record_elastic("restart_fallbacks", detail)
+            raise MLSLError(
+                f"device loss at step {step} names no active device — "
+                "nothing to shed; escalating to the restart rung instead "
+                "of spinning no-op reshards"
+            )
+        if self.shed_total + shed > self.capacity_budget:
+            stats_mod.record_elastic("restart_fallbacks", detail)
+            raise MLSLError(
+                f"capacity budget exhausted: shedding {shed} more device(s) "
+                f"would exceed {self.capacity_budget} "
+                f"(already shed {self.shed_total}) — escalating to the "
+                "restart rung"
+            )
+        tr = obs._tracer
+        t0 = tr.now() if tr is not None else 0
+        log_warning(
+            "elastic shrink at step %d: %d device(s) lost (%s), "
+            "re-deriving the mesh over %d survivors",
+            step, shed, type(error).__name__ if error else "announced",
+            len(survivors),
+        )
+        try:
+            harvest = self._harvest(trainer)
+        except Exception:
+            # a failed drain IS an escalation to the restart rung — count
+            # it, or the ELASTIC totals line answers "did capacity churn
+            # cost a restart" wrongly
+            stats_mod.record_elastic("restart_fallbacks", detail)
+            raise
+        prev_active = _active
+        _set_active(survivors)
+        try:
+            try:
+                Environment.get_env().finalize()
+            except Exception as e:
+                log_warning("environment teardown during shrink failed "
+                            "(continuing with rebuild): %s: %s",
+                            type(e).__name__, e)
+            new_trainer = make_trainer()
+            self._check_factory_world(new_trainer, len(survivors))
+            self._write_state(new_trainer, harvest, step=step, kind="shrink")
+        except Exception:
+            # unwind the registry so a restart-rung recovery rebuilds the
+            # PRE-shrink world, where the checkpoint shapes still match —
+            # and count the escalation (same contract as the drain path)
+            _set_active(prev_active)
+            stats_mod.record_elastic("restart_fallbacks", detail)
+            raise
+        self.shed_total += shed
+        _budget_info[1] = self.shed_total
+        if self.grow_after > 0:
+            self._return_due = step + self.grow_after
+        global _last_reshard
+        _last_reshard = {
+            "kind": "shrink", "step": step, "verdict": "pass",
+            "d_old": harvest["d_old"], "d_new": new_trainer.data_size,
+        }
+        stats_mod.record_elastic("shrinks", detail)
+        if tr is not None:
+            tr.complete("elastic.shrink", "elastic", t0, step=step,
+                        world_before=len(active), world_after=len(survivors),
+                        shed=shed, budget_remaining=(
+                            self.capacity_budget - self.shed_total))
+        log_info("elastic shrink complete: continuing at step %d on %d "
+                 "devices (capacity budget %d/%d spent)",
+                 step, len(survivors), self.shed_total, self.capacity_budget)
+        return new_trainer
+
+    # -- grow --------------------------------------------------------------
+
+    def maybe_grow(self, trainer, make_trainer, step: int):
+        """Between-steps poll: grow back to the full world when shrunk and
+        capacity has returned (announce_return or the grow_after timer)."""
+        if _active is None:
+            return trainer
+        due = self._pending_return or (
+            self._return_due is not None and step >= self._return_due
+        )
+        if not due:
+            return trainer
+        return self.grow(trainer, make_trainer, step)
+
+    def grow(self, trainer, make_trainer, step: int):
+        """Re-admit returned capacity: rebuild the full world, re-shard the
+        state back, and admit the rejoining replica only after its
+        fingerprint audit passes."""
+        from mlsl_tpu import chaos
+        from mlsl_tpu.core import stats as stats_mod
+        from mlsl_tpu.core.environment import Environment
+        from mlsl_tpu.obs import tracer as obs
+
+        mlsl_assert(_active is not None, "grow() without a preceding shrink")
+        active = _active
+        returning = tuple(d for d in self.world if d not in set(active))
+        # consult the chaos site BEFORE any teardown: an 'error' plan here
+        # models capacity lost again during re-admission (nothing is torn
+        # down yet, the shrunk trainer stays live); a 'silent' plan corrupts
+        # the rejoining copy below — the admission audit's quarry
+        silent_plan = None
+        if chaos._plans:
+            p = chaos.inject("device.lost", phase="admit", step=step)
+            if p is not None and p.kind == "silent":
+                silent_plan = p
+        tr = obs._tracer
+        t0 = tr.now() if tr is not None else 0
+        log_info("elastic grow at step %d: re-admitting %d device(s) "
+                 "(world %d -> %d)", step, len(returning), len(active),
+                 len(self.world))
+        harvest = self._harvest(trainer)
+        _set_active(None)
+        try:
+            try:
+                Environment.get_env().finalize()
+            except Exception as e:
+                log_warning("environment teardown during grow failed "
+                            "(continuing with rebuild): %s: %s",
+                            type(e).__name__, e)
+            new_trainer = make_trainer()
+            self._check_factory_world(new_trainer, len(self.world))
+            self._write_state(new_trainer, harvest, step=step, kind="grow")
+            if silent_plan is not None:
+                from mlsl_tpu import sentinel as sentinel_mod
+
+                new_trainer.params = sentinel_mod.corrupt_replica(
+                    new_trainer.params, returning, silent_plan
+                )
+            try:
+                self._admit(new_trainer, harvest, step)
+            except MLSLError as admission_err:
+                return self._abandon_grow(
+                    make_trainer, harvest, active, step, t0, tr,
+                    admission_err,
+                )
+        except Exception:
+            # structural failure (teardown/factory/state-carry): stay
+            # shrunk and DISARM the return flags — a still-armed flag would
+            # make the next between-steps poll re-attempt the identical
+            # grow, and every failure then burns a checkpoint-restart
+            # recovery (the spiral the abandon contract forbids)
+            _set_active(active)
+            self._pending_return = False
+            self._return_due = None
+            raise
+        self._return_due = None
+        self._pending_return = False
+        global _last_reshard
+        _last_reshard = {
+            "kind": "grow", "step": step, "verdict": "pass",
+            "d_old": harvest["d_old"], "d_new": new_trainer.data_size,
+        }
+        detail = (f"step={step} world {len(active)}->{len(self.world)} "
+                  f"readmitted={len(returning)}")
+        stats_mod.record_elastic("grows", detail)
+        if tr is not None:
+            tr.complete("elastic.grow", "elastic", t0, step=step,
+                        world_before=len(active),
+                        world_after=len(self.world),
+                        readmitted=len(returning))
+        log_info("elastic grow complete: step %d continues on the full "
+                 "%d-device world", step, len(self.world))
+        return new_trainer
+
+    def _abandon_grow(self, make_trainer, harvest, active, step: int,
+                      t0, tr, err):
+        """Persistent admission divergence: ABANDON the grow (the DESIGN.md
+        contract — stay shrunk, zero restores). The full world is torn back
+        down, the survivor world rebuilt from the harvest, and the return
+        flags disarm: retrying a persistently divergent replica every poll
+        would burn a checkpoint-restart recovery per step, so only a fresh
+        ``announce_return()`` re-attempts."""
+        from mlsl_tpu.core import stats as stats_mod
+        from mlsl_tpu.core.environment import Environment
+
+        global _last_reshard
+        log_warning(
+            "elastic grow ABANDONED at step %d (%s) — staying on the "
+            "%d-device survivor world; announce_return() re-attempts",
+            step, err, len(active),
+        )
+        try:
+            Environment.get_env().finalize()
+        except Exception as e:
+            log_warning("full-world teardown during grow abandon failed "
+                        "(continuing with rebuild): %s: %s",
+                        type(e).__name__, e)
+        _set_active(active)
+        shrunk = make_trainer()
+        self._check_factory_world(shrunk, len(active))
+        self._write_state(shrunk, harvest, step=step, kind="abandon")
+        self._pending_return = False
+        self._return_due = None
+        _last_reshard = {
+            "kind": "grow", "step": step, "verdict": "abandoned",
+            "d_old": harvest["d_old"], "d_new": shrunk.data_size,
+        }
+        stats_mod.record_elastic(
+            "grow_abandons", f"step={step} world stays {len(active)}"
+        )
+        if tr is not None:
+            tr.complete("elastic.grow", "elastic", t0, step=step,
+                        world_before=len(active), world_after=len(active),
+                        verdict="abandoned")
+        return shrunk
+
+    # -- admission audit ----------------------------------------------------
+
+    def _admit(self, trainer, harvest, step: int) -> None:
+        """The sentinel fingerprint audit as the admission check: the grown
+        trainer's replicated state must fingerprint identically on EVERY
+        device — the rejoining copies included — before the replica is
+        admitted. A mismatch re-syncs the state from the survivors' copy
+        (the harvest) and re-audits; persistent divergence raises."""
+        from mlsl_tpu import sentinel as sentinel_mod
+        from mlsl_tpu.core import stats as stats_mod
+        from mlsl_tpu.obs import tracer as obs
+
+        sent = getattr(trainer, "sentinel", None)
+        if sent is None:
+            # audit machinery only; none of the gate/cadence knobs arm
+            sent = sentinel_mod.Sentinel(trainer.mesh)
+        tr = obs._tracer
+        for attempt in range(self.admit_retries + 1):
+            t0 = tr.now() if tr is not None else 0
+            res = sent.audit_now(trainer, step)
+            if tr is not None:
+                tr.complete("elastic.admit", "elastic", t0, step=step,
+                            attempt=attempt, equal=res.equal,
+                            digest=res.digest[:16])
+            if res.equal:
+                stats_mod.record_elastic(
+                    "admits",
+                    f"step={step} attempt={attempt} "
+                    f"digest={res.digest[:16]}",
+                )
+                return
+            stats_mod.record_elastic(
+                "admit_rejects",
+                f"step={step} attempt={attempt} digest={res.digest[:16]}",
+            )
+            log_warning(
+                "elastic admission audit REJECTED the rejoining replica at "
+                "step %d (attempt %d): fingerprints diverge (digest %s)",
+                step, attempt, res.digest[:16],
+            )
+            if attempt < self.admit_retries:
+                stats_mod.record_elastic("resyncs", f"step={step}")
+                self._resync(trainer, harvest)
+        raise MLSLError(
+            f"elastic admission failed at step {step}: the rejoining "
+            f"replica's fingerprint still diverges after "
+            f"{self.admit_retries} resync attempt(s)"
+        )
+
+    def _resync(self, trainer, harvest) -> None:
+        """Re-broadcast the survivors' verified state over the whole grown
+        mesh (the harvest is the survivor copy by construction), replacing
+        whatever the rejected replica held."""
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        sharding = NamedSharding(trainer.mesh, P())
+        trainer.params = jax.device_put(harvest["params"], sharding)
+        if harvest.get("opt_state") is not None:
+            trainer._opt_state = jax.device_put(
+                harvest["opt_state"], sharding
+            )
+        # ZeRO-1 owned shards are per-rank-unique (never on the rejoiner's
+        # replicated axis); they were freshly placed by _write_state and do
+        # not participate in the replica comparison, so no re-broadcast
+
+    # -- state harvest / carry ---------------------------------------------
+
+    def _harvest(self, trainer) -> dict:
+        """Read the training state off the CURRENT (pre-reshard) mesh: a
+        host copy of the replicated trees, and the full flat vector of every
+        ZeRO-1 owned-shard leaf via the all-gather drain collective on the
+        pre-reshard mesh (the grace-window read)."""
+        from mlsl_tpu import optim
+
+        for attr in ("params", "layers", "layer_counts", "padded_counts",
+                     "data_size", "dist"):
+            if not hasattr(trainer, attr):
+                raise MLSLError(
+                    f"elastic reshard supports DataParallelTrainer-shaped "
+                    f"state; {type(trainer).__name__} lacks {attr!r} — "
+                    "falling back to the restart rung"
+                )
+        out = {
+            "params": jax.device_get(trainer.params),
+            "opt_state": None,
+            "du": None,
+            "d_old": trainer.data_size,
+            "layer_counts": dict(trainer.layer_counts),
+            "padded_counts": dict(trainer.padded_counts),
+        }
+        if getattr(trainer, "_opt_state", None) is not None:
+            out["opt_state"] = jax.device_get(trainer._opt_state)
+        du = getattr(trainer, "_du_opt_state", None)
+        if du:
+            # quiesce the dispatcher first: the loss interrupted a step, and
+            # gathering concurrently with its abandoned in-flight programs
+            # is the XLA:CPU rendezvous hazard (KNOWN_FAILURES.md / A102)
+            try:
+                trainer.env.dispatcher.shutdown()
+            except Exception as e:
+                log_warning("dispatcher quiesce before reshard drain "
+                            "failed: %s: %s", type(e).__name__, e)
+            topo = trainer.dist.topology
+            gathered = {}
+            for name in sorted(du):
+                gathered[name] = jax.tree.map(
+                    lambda leaf: optim.gather_owned_full(topo, leaf), du[name]
+                )
+            out["du"] = gathered
+        return out
+
+    def _write_state(self, trainer, harvest, step: int, kind: str) -> None:
+        """Place the harvested state onto the rebuilt trainer's mesh:
+        replicated trees re-broadcast, ZeRO-1 state re-partitioned under a
+        verified reshard plan."""
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        sharding = NamedSharding(trainer.mesh, P())
+        trainer.params = jax.device_put(harvest["params"], sharding)
+        if harvest["opt_state"] is not None:
+            mlsl_assert(
+                getattr(trainer, "_opt_state", None) is not None,
+                "reshard factory mismatch: the %s trainer carries no "
+                "replicated optimizer state to receive the harvest", kind,
+            )
+            trainer._opt_state = jax.device_put(
+                harvest["opt_state"], sharding
+            )
+        if harvest["du"]:
+            self._reshard_du(trainer, harvest, step, kind)
+
+    def _reshard_du(self, trainer, harvest, step: int, kind: str) -> None:
+        """Re-partition the gathered ZeRO-1 state onto the new world's
+        ownership chunks, under an A140/A141-verified plan. Leaves whose
+        per-rank payload spans the owned shard reshard; leaves replicated by
+        construction (scalar counts, adafactor's factored vectors) carry one
+        copy; anything else is unreshardable and raises."""
+        from mlsl_tpu import optim
+        from mlsl_tpu.analysis import diagnostics
+        from mlsl_tpu.analysis import plan as plan_mod
+        from mlsl_tpu.core import stats as stats_mod
+
+        d_old = harvest["d_old"]
+        d_new = trainer.data_size
+        plan = build_reshard_plan(
+            harvest["layer_counts"], harvest["padded_counts"],
+            trainer.padded_counts, d_old, d_new,
+        )
+        t0 = time.perf_counter()
+        rep = plan_mod.verify_reshard(plan)
+        diagnostics.record(rep, time.perf_counter() - t0)
+        if rep.errors:
+            # unconditional (not MLSL_VERIFY_SEVERITY-gated): executing an
+            # uncovered plan silently corrupts optimizer state
+            raise MLSLError(
+                f"elastic {kind} reshard plan rejected: "
+                + "; ".join(d.format() for d in rep.errors)
+            )
+        topo = trainer.dist.topology
+        moved = 0
+        for name in sorted(harvest["du"]):
+            mlsl_assert(
+                name in trainer._du_opt_state,
+                "reshard factory mismatch: layer %r has harvested ZeRO-1 "
+                "state but the rebuilt trainer does not register it", name,
+            )
+            count = harvest["layer_counts"][name]
+            padded_old = harvest["padded_counts"][name]
+            padded_new = trainer.padded_counts[name]
+            old_leaves, old_def = jax.tree.flatten(harvest["du"][name])
+            new_leaves, new_def = jax.tree.flatten(trainer._du_opt_state[name])
+            mlsl_assert(
+                old_def == new_def,
+                "reshard factory mismatch: layer %r optimizer state trees "
+                "differ between worlds (%s vs %s)", name, old_def, new_def,
+            )
+            roles = _du_leaf_roles(trainer, harvest["du"][name])
+            if roles is not None and len(roles) != len(old_leaves):
+                roles = None
+            placed = []
+            for i, (old_vec, new_leaf) in enumerate(
+                    zip(old_leaves, new_leaves)):
+                full = np.asarray(old_vec).reshape(-1)
+                k_old = full.shape[0] // d_old
+                k_new = int(np.prod(
+                    new_leaf.shape[len(topo.grid_shape):]
+                ))
+                owned_fit = (k_old * d_old == padded_old
+                             and k_new * d_new == padded_new)
+                repl_fit = k_old == k_new
+                if owned_fit and repl_fit and roles is not None:
+                    # shapes alone cannot tell a replicated scalar from a
+                    # k==1 owned shard (a layer with count <= world ranks on
+                    # both sides); the state STRUCTURE can — see
+                    # _du_leaf_roles
+                    owned_fit, repl_fit = roles[i], not roles[i]
+                if owned_fit:
+                    placed.append(optim.place_owned_vector(
+                        topo, full, count, padded_new, d_new
+                    ))
+                elif repl_fit:
+                    # replicated-by-construction leaf (scalar step count,
+                    # adafactor v_row/v_col): every old rank held the same
+                    # value — carry rank 0's copy to every new rank
+                    rep0 = full[:k_old]
+                    grid = topo.grid_shape
+                    placed.append(topo.shard_buffer(np.ascontiguousarray(
+                        np.broadcast_to(rep0, grid + rep0.shape)
+                    )))
+                else:
+                    raise MLSLError(
+                        f"unreshardable optimizer leaf in layer {name!r}: "
+                        f"per-rank payload {k_old} is neither the owned "
+                        f"shard ({padded_old // d_old}) nor "
+                        f"world-invariant ({k_new} expected) — falling "
+                        "back to the restart rung"
+                    )
+                moved += 1
+            trainer._du_opt_state[name] = jax.tree.unflatten(new_def, placed)
+        stats_mod.record_elastic("reshard_buffers", n=moved)
+
+    # -- shared checks ------------------------------------------------------
+
+    @staticmethod
+    def _check_factory_world(trainer, expected: int) -> None:
+        size = int(trainer.dist.topology.world_size)
+        mlsl_assert(
+            size == expected,
+            "make_trainer built a %d-device Distribution but the active "
+            "world is %d: elastic factories must size from "
+            "env.get_process_count(), not a constant", size, expected,
+        )
+
+
+def _du_leaf_roles(trainer, state) -> Optional[list]:
+    """Per flattened leaf of one layer's ZeRO-1 state: True when the leaf's
+    size scales with the owned-shard count (elementwise moments — reshard),
+    False when it is world-invariant (scalar step counts, adafactor's
+    factored v_row/v_col — carry one copy). Classified by STRUCTURE, never
+    by shape arithmetic: a (1,)-payload scalar is indistinguishable by
+    shape from a k==1 owned shard when a layer holds fewer elements than
+    the world has ranks, and misrouting the scalar through the owned path
+    mixes rank copies with zero padding.
+
+    The adafactor dict schema (``optim.init_adafactor_state``) is
+    classified by key; a generic optax state is probed by initializing the
+    transform at two different counts and seeing which leaf sizes move.
+    Returns None when neither applies (the caller falls back to shape
+    arithmetic, which resolves every unambiguous layer)."""
+    if isinstance(state, dict) and {"count", "v_row", "v_col"} <= set(state):
+        # jax flattens dicts in sorted-key order; 'v'/'m' ride the owned
+        # shard, the rest are replicated by construction
+        return [k in ("v", "m") for k in sorted(state)]
+    init = getattr(getattr(trainer, "optimizer", None), "init", None)
+    if init is None:
+        return None
+    try:
+        a = jax.tree.leaves(init(np.zeros((2,), np.float32)))
+        b = jax.tree.leaves(init(np.zeros((3,), np.float32)))
+    except Exception:
+        return None
+    if len(a) != len(b):
+        return None
+    return [np.size(x) != np.size(y) for x, y in zip(a, b)]
+
+
+def build_reshard_plan(layer_counts: dict, padded_old: dict,
+                       padded_new: dict, d_old: int, d_new: int) -> dict:
+    """The statically verifiable description of one ZeRO-1 reshard: per
+    layer, the old ownership-chunk intervals that tile the real elements
+    ``[0, count)`` (sources) and the new ownership chunks (targets).
+    ``analysis/plan.verify_reshard`` proves coverage before execution."""
+    layers = []
+    for name in sorted(layer_counts):
+        count = int(layer_counts[name])
+        po, pn = int(padded_old[name]), int(padded_new[name])
+        k_old, k_new = po // max(d_old, 1), pn // max(d_new, 1)
+        sources = []
+        for r in range(d_old):
+            lo, hi = r * k_old, min((r + 1) * k_old, count)
+            if hi > lo:
+                sources.append((r, lo, hi))
+        targets = [(r, r * k_new, (r + 1) * k_new) for r in range(d_new)]
+        layers.append({
+            "name": name, "count": count,
+            "padded_old": po, "k_old": k_old,
+            "padded_new": pn, "k_new": k_new,
+            "sources": sources, "targets": targets,
+        })
+    return {"d_old": int(d_old), "d_new": int(d_new), "layers": layers}

@@ -45,6 +45,25 @@ class MLSLCorruptionError(MLSLError):
     it to the always-correct path rather than retrying in place."""
 
 
+class MLSLDeviceLossError(MLSLError):
+    """A device (or whole slice) left the world: preemption notice, ICI
+    neighbor loss, or an injected ``device.lost`` chaos fault. Classified
+    DEVICE_LOSS by the recovery supervisor — it must never be retried in
+    place or absorbed by a circuit breaker (the capacity is *gone*; a
+    fallback dispatch on the same mesh only masks the loss). The elastic
+    coordinator (mlsl_tpu.elastic) answers it by re-deriving the mesh among
+    survivors and re-sharding optimizer state live; without one,
+    FaultTolerantLoop falls back to checkpoint restart.
+
+    ``devices``: the lost jax.Device set when the detector knows it (a
+    preemption notice names its host); empty when only the loss itself is
+    observed — the coordinator then applies its default shed policy."""
+
+    def __init__(self, msg: str, devices=()):
+        super().__init__(msg)
+        self.devices = tuple(devices)
+
+
 class MLSLIntegrityError(MLSLCorruptionError):
     """TRAINING-STATE integrity failure, raised by the integrity sentinel
     (mlsl_tpu.sentinel): a step-quality gate escalated to rollback, a

@@ -40,6 +40,65 @@ from mlsl_tpu.comm.mesh import DATA_AXIS, NUM_GRID_AXES, SEQ_AXIS
 from mlsl_tpu.log import mlsl_assert
 
 
+# -- elastic reshard primitives (mlsl_tpu.elastic) ----------------------------
+#
+# Live shrink/grow re-shards ZeRO-1 optimizer state ACROSS world sizes with
+# no checkpoint restore: the drain collective below all-gathers each rank's
+# owned shard into the full flat vector (one engine-routed all_gather over
+# the gradient group, run on the pre-reshard mesh during the drain window),
+# and place_owned_vector re-partitions it over the survivor topology's
+# ownership chunks. The coordinator (elastic.py) pairs them under a reshard
+# plan the static verifier proves covers every shard element exactly once
+# (analysis/plan.py verify_reshard, MLSL-A140/A141) before execution.
+
+
+def gather_owned_full(topo, buf, grad_axes=(DATA_AXIS, SEQ_AXIS)) -> np.ndarray:
+    """All-gather a ZeRO-1 owned-shard distributed buffer (grid + (k,)) into
+    the full flat ``(d * k,)`` host vector — the elastic drain collective.
+
+    The gather runs ON the buffer's (pre-reshard) mesh: group-rank order of
+    the tiled all_gather matches the ownership chunk order (grad-group rank
+    r owns contiguous chunk r, reference src/mlsl_impl.cpp:403-435), so the
+    concatenation IS the padded flat layout. The result is replicated; one
+    addressable shard (a survivor's copy) is read back."""
+    from mlsl_tpu.comm import algos
+
+    mesh = topo.mesh
+
+    def body(g):
+        flat = g.reshape(g.shape[NUM_GRID_AXES:])
+        return algos.inline_allgather(flat, grad_axes, tiled=True)
+
+    sm = smap(body, mesh, in_specs=_BUF_SPEC, out_specs=P(), check=False)
+    out = jax.jit(sm)(buf)
+    return np.asarray(out.addressable_shards[0].data)
+
+
+def place_owned_vector(new_topo, vec: np.ndarray, count: int,
+                       padded_new: int, d_new: int):
+    """Re-partition a full flat state vector onto a (possibly different-size)
+    topology's ZeRO-1 ownership chunks: truncate the old padding to
+    ``count``, re-pad to the survivor world's ``padded_new``, and shard the
+    ``d_new`` equal chunks over the data axis — the write half of an elastic
+    reshard."""
+    mlsl_assert(
+        padded_new % d_new == 0 and padded_new >= count,
+        "reshard target geometry invalid: padded %d vs d=%d, count=%d",
+        padded_new, d_new, count,
+    )
+    k_new = padded_new // d_new
+    flat = np.asarray(vec).reshape(-1)[:count]
+    flat = np.pad(flat, (0, padded_new - count))
+    grid = new_topo.grid_shape
+    mlsl_assert(
+        grid == (1, d_new, 1, 1),
+        "elastic ZeRO-1 reshard supports a pure data-parallel grid "
+        "(replica=seq=model=1); got %s", grid,
+    )
+    chunks = flat.reshape(1, d_new, 1, 1, k_new)
+    return new_topo.shard_buffer(np.ascontiguousarray(chunks))
+
+
 @dataclasses.dataclass(frozen=True)
 class ShardedAdafactor:
     """Adafactor config usable on every trainer path.

@@ -105,6 +105,16 @@ class FaultTolerantLoop:
         (main thread only): on signal the loop finishes the current step,
         drains in-flight saves, writes a final checkpoint, and returns early
         with ``self.preempted`` set.
+    elastic: an :class:`mlsl_tpu.elastic.ElasticCoordinator` (None constructs
+        one when ``MLSL_ELASTIC`` arms it). With a coordinator, DEVICE_LOSS
+        faults (preemption, the chaos ``device.lost`` site) take the reshard
+        rung — shrink to the survivor mesh, re-shard ZeRO-1 state live, and
+        CONTINUE at the interrupted step with no checkpoint restore and no
+        recovery counted — and returned capacity is re-admitted between
+        steps through the sentinel fingerprint admission audit
+        (``maybe_grow``). A failed/refused reshard (capacity budget, drain
+        failure) falls back to this loop's restart rung. Elastic factories
+        must size their Distribution from ``env.get_process_count()``.
     """
 
     def __init__(
@@ -116,6 +126,7 @@ class FaultTolerantLoop:
         max_total_recoveries: Optional[int] = None,
         fault_hook: Optional[Callable] = None,
         handle_preemption: bool = True,
+        elastic: Optional[object] = None,
     ):
         self.make_trainer = make_trainer
         self.ckpt = CheckpointManager(ckpt_dir)
@@ -145,6 +156,25 @@ class FaultTolerantLoop:
         self.handle_preemption = handle_preemption
         self.preempted = False
         self.recoveries = 0
+        self.elastic = elastic
+        self._arm_elastic_if_configured()
+
+    def _arm_elastic_if_configured(self) -> None:
+        """Auto-arm the coordinator from the live config (which Config.load
+        folds MLSL_ELASTIC into) or, with no initialized Environment, from
+        the env var alone. Called at __init__ AND again at run() after the
+        factory built the trainer: the documented pattern constructs the
+        loop BEFORE any Environment exists, so a programmatic
+        Config(elastic=True) is only visible post-init."""
+        if self.elastic is not None:
+            return
+        from mlsl_tpu import elastic as elastic_mod
+        from mlsl_tpu.core.environment import Environment
+
+        cfg = (Environment._instance.config
+               if Environment.is_initialized() else None)
+        if elastic_mod.armed(cfg):  # cfg None -> MLSL_ELASTIC fallback
+            self.elastic = elastic_mod.ElasticCoordinator()
 
     def _recover(self, trainer, error) -> tuple:
         """Tear down, rebuild, restore. -> (trainer, resume_step)."""
@@ -251,17 +281,21 @@ class FaultTolerantLoop:
         must surface, not an abort-path artifact."""
         try:
             cls = supervisor.classify(error)
+            status = supervisor.status()
             states = {
                 # breaker-shaped entries only: 'analysis' (verdict-shaped)
-                # has its own ANALYSIS stats line and is not a breaker
+                # and 'elastic' (mesh-shaped, 'full'/'shrunk') have their
+                # own ANALYSIS/ELASTIC stats lines and are not breakers
                 name: st["state"]
-                for name, st in supervisor.status().items() if "state" in st
+                for name, st in status.items()
+                if "state" in st and name != "elastic"
             }
             log_error(
                 "recovery ladder exhausted at step %d (%s; %d/%d recoveries "
-                "spent): %s: %s [class=%s] breakers=%s",
+                "spent): %s: %s [class=%s] breakers=%s elastic=%s",
                 step, why, self.recoveries, self.max_total_recoveries,
                 type(error).__name__, error, cls.value, states,
+                status.get("elastic", {}).get("state", "?"),
             )
             if obs._tracer is not None:
                 from mlsl_tpu.obs import export as obs_export
@@ -282,6 +316,7 @@ class FaultTolerantLoop:
         Returns early (with ``self.preempted`` set and a final checkpoint on
         disk) when a handled preemption signal arrives mid-run."""
         trainer = self.make_trainer()
+        self._arm_elastic_if_configured()  # the factory just ran env init
         self._warn_if_sentinel_unwired(trainer)
         restored = restore_trainer(self.ckpt, trainer)
         step = restored + 1 if restored is not None else 0
@@ -297,6 +332,14 @@ class FaultTolerantLoop:
         with guard if guard is not None else _NULL_GUARD:
             while step < steps:
                 try:
+                    if self.elastic is not None:
+                        # between-steps growth poll: returned capacity is
+                        # re-admitted (through the fingerprint admission
+                        # audit) before the step runs; failures route
+                        # through the standard ladder below
+                        trainer = self.elastic.maybe_grow(
+                            trainer, self.make_trainer, step
+                        )
                     if self.fault_hook is not None:
                         self.fault_hook(
                             step, attempts if step == failed_step else 0
@@ -323,6 +366,30 @@ class FaultTolerantLoop:
                                      fingerprint=fp)
                         last_saved = step
                 except RECOVERABLE as e:
+                    if (
+                        self.elastic is not None
+                        and supervisor.classify(e)
+                        is supervisor.ErrorClass.DEVICE_LOSS
+                    ):
+                        # the reshard rung: shrink to the survivor mesh and
+                        # CONTINUE at this very step — the failed step never
+                        # applied its update, so the loss trajectory stays
+                        # continuous with zero checkpoint restores and no
+                        # recovery spent. A refused/failed shrink (capacity
+                        # budget, drain failure) falls through to restart.
+                        try:
+                            trainer = self.elastic.shrink(
+                                trainer, self.make_trainer, error=e,
+                                step=step,
+                            )
+                        except Exception as ee:
+                            log_warning(
+                                "elastic reshard failed (%s: %s); device "
+                                "loss falls back to the restart rung",
+                                type(ee).__name__, ee,
+                            )
+                        else:
+                            continue
                     if step == failed_step:
                         attempts += 1
                     else:

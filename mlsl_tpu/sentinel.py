@@ -520,6 +520,41 @@ def corrupt_silent(tree, plan):
     return jax.tree.unflatten(treedef, leaves)
 
 
+def corrupt_replica(tree, devices, plan):
+    """Targeted variant of :func:`corrupt_silent` for the elastic admission
+    proof: corrupt one element of one float leaf's copy ON A SPECIFIC device
+    set — the REJOINING replica's copy after an elastic grow — so the
+    admission audit (the cross-replica fingerprint) has exactly the
+    divergence it must reject. Leaves whose sharding places no addressable
+    shard on ``devices`` are skipped; returns the tree unchanged when no
+    leaf is corruptible there."""
+    targets = set(devices)
+    leaves, treedef = jax.tree.flatten(tree)
+    rng = chaos._rng
+    cand = []
+    for i, l in enumerate(leaves):
+        if not (isinstance(l, jax.Array)
+                and jnp.issubdtype(l.dtype, jnp.floating)):
+            continue
+        hit = [si for si, s in enumerate(l.addressable_shards)
+               if s.device in targets]
+        if hit:
+            cand.append((i, hit))
+    if not cand:
+        return tree
+    li, hit = cand[rng.randrange(len(cand))]
+    leaf = leaves[li]
+    shards = leaf.addressable_shards
+    si = hit[rng.randrange(len(hit))]
+    datas = [np.array(s.data) for s in shards]
+    datas[si] = _corrupt_host(datas[si], plan, rng)
+    leaves[li] = jax.make_array_from_single_device_arrays(
+        leaf.shape, leaf.sharding,
+        [jax.device_put(d, s.device) for d, s in zip(datas, shards)],
+    )
+    return jax.tree.unflatten(treedef, leaves)
+
+
 def _corrupt_host(arr: np.ndarray, plan, rng) -> np.ndarray:
     flat = arr.reshape(-1)
     if flat.size == 0:

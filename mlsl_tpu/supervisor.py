@@ -51,6 +51,7 @@ from typing import Deque, Dict, Optional
 
 from mlsl_tpu.log import (
     MLSLCorruptionError,
+    MLSLDeviceLossError,
     MLSLError,
     MLSLTimeoutError,
     log_warning,
@@ -68,6 +69,14 @@ class ErrorClass(enum.Enum):
     #: dispatch/compile/device failure: breaker-countable, and recoverable by
     #: checkpoint restart when no breaker owns the site (rung 3 then 4)
     PERSISTENT = "persistent"
+    #: capacity left the world (preemption, ICI neighbor loss, the chaos
+    #: ``device.lost`` site): never retried in place, never breaker-absorbed
+    #: — the device is *gone*, so a fallback dispatch on the same mesh only
+    #: masks the loss. Routed to the elastic reshard rung
+    #: (mlsl_tpu.elastic: re-derive the mesh among survivors, re-shard
+    #: ZeRO-1 state live); checkpoint restart is the fallback when no
+    #: coordinator is armed or the capacity budget is exhausted.
+    DEVICE_LOSS = "device_loss"
     #: caller bugs and resource exhaustion: surface immediately — retrying a
     #: ValueError or degrading around a MemoryError only hides the real fault
     FATAL = "fatal"
@@ -81,6 +90,7 @@ class ErrorClass(enum.Enum):
 # request escalates straight past the retry rung.
 _TAXONOMY = (
     (MLSLCorruptionError, ErrorClass.CORRUPTION),
+    (MLSLDeviceLossError, ErrorClass.DEVICE_LOSS),
     (MLSLTimeoutError, ErrorClass.PERSISTENT),
     (MLSLError, ErrorClass.PERSISTENT),
     (TimeoutError, ErrorClass.TRANSIENT),
@@ -352,6 +362,13 @@ def status() -> Dict[str, dict]:
     from mlsl_tpu.analysis import diagnostics as _analysis
 
     out["analysis"] = _analysis.status()
+    # elastic-mesh state (mlsl_tpu.elastic): active vs full world size,
+    # capacity budget remaining, and the last reshard verdict — the
+    # "capacity budget" half of the ladder's last rung (lazy for the same
+    # reason as the sentinel: elastic sits above the comm stack)
+    from mlsl_tpu import elastic as _elastic
+
+    out["elastic"] = _elastic.status()
     return out
 
 

@@ -46,6 +46,13 @@ def on_tpu() -> bool:
         return False
 
 
+def _num_hosts(devices) -> int:
+    """DISTINCT hosts, not max(process_index)+1: a survivor subset may
+    exclude every device of a low-indexed host, and a profile measured on
+    a genuine N-host spread must not transfer to it."""
+    return len({d.process_index for d in devices})
+
+
 @functools.lru_cache(maxsize=1)
 def probe() -> SysInfo:
     devices = jax.devices()
@@ -57,32 +64,39 @@ def probe() -> SysInfo:
             mem = int(stats.get("bytes_limit", 0))
     except Exception:
         mem = 0
-    num_hosts = max(d.process_index for d in devices) + 1
     return SysInfo(
         platform=d0.platform,
         device_kind=getattr(d0, "device_kind", d0.platform),
         num_devices=len(devices),
-        num_hosts=num_hosts,
+        num_hosts=_num_hosts(devices),
         memory_per_device=mem,
     )
 
 
-def topology_fingerprint() -> dict:
+def topology_fingerprint(devices=None) -> dict:
     """The identity a tuner profile (mlsl_tpu.tuner) is keyed by: measured
     algorithm selections transfer exactly to the hardware they were measured
     on — same platform, same chip generation, same world size and host
     spread. A profile whose fingerprint disagrees with the probe is stale
     (different machine / different slice shape) and must be re-measured, the
-    same contract as the reference's AutoConfig re-probing per launch."""
+    same contract as the reference's AutoConfig re-probing per launch.
+
+    ``devices``: the ACTIVE world (default the full jax world). An elastic
+    reshard (mlsl_tpu.elastic) re-initializes the Environment over a
+    survivor subset, and a profile measured at the full world size must go
+    stale there — world size and tier shape are computed from the active
+    set, not the physical machine."""
     si = probe()
     from mlsl_tpu.comm.mesh import world_tiers
 
-    tiers = world_tiers()
+    devices = tuple(jax.devices() if devices is None else devices)
+    num_hosts = _num_hosts(devices)
+    tiers = world_tiers(devices)
     return {
         "platform": si.platform,
         "device_kind": si.device_kind,
-        "num_devices": si.num_devices,
-        "num_hosts": si.num_hosts,
+        "num_devices": len(devices),
+        "num_hosts": num_hosts,
         # two-tier shape (T slices x L devices/slice) or None for a flat
         # world: a profile tuned on a two-tier mesh — where 'hier' cells
         # and the DCN codec knob were measured — must not transfer to a

@@ -84,12 +84,19 @@ def init_profile(config, devices=None) -> None:
         config.tuned_profile = profile
     elif config.tune_profile:
         profile = load_profile(config.tune_profile)  # MLSLError on bad file
-        fp = sysinfo.topology_fingerprint()
+        # fingerprint the ACTIVE world, not the physical machine: every
+        # re-init re-checks here — including FaultTolerantLoop recovery
+        # rebuilds and elastic reshard re-inits over a survivor subset,
+        # where a profile measured at the old world size is stale and must
+        # be rejected with a warning, never silently honored (the
+        # world-size-change regression, tests/test_elastic.py)
+        fp = sysinfo.topology_fingerprint(devices)
         if not profile.matches(fp):
             log_warning(
                 "tuner: profile %s was measured on a different topology "
                 "(profile %r vs probed %r); rejecting it — rerun MLSL_TUNE=1 "
-                "on this machine", config.tune_profile, profile.fingerprint, fp,
+                "on this machine/world", config.tune_profile,
+                profile.fingerprint, fp,
             )
             return
         config.tuned_profile = profile

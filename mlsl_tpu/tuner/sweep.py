@@ -240,7 +240,10 @@ def run_sweep(
     knobs.update(_sweep_overlap_stages(devices, iters))
 
     prof = TunedProfile(
-        fingerprint=sysinfo.topology_fingerprint(),
+        # keyed to the world the sweep MEASURED (the active device set):
+        # an elastic shrink re-sweeps over survivors, and its profile must
+        # not transfer back to the full world, nor vice versa
+        fingerprint=sysinfo.topology_fingerprint(devices),
         cells=cells,
         knobs=knobs,
         created=time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),

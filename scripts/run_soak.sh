@@ -7,6 +7,11 @@
 # fault-free run, and every retry / breaker trip / degraded dispatch /
 # recovery attributable in mlsl_stats.log and the exported Perfetto trace.
 # The fast bounded variant (test_soak_fast_bounded) runs inside tier-1.
+# Also runs the silent-corruption soak (ISSUE 9) and the elastic soak
+# (ISSUE 14: seeded device.lost -> shrink -> grow with zero checkpoint
+# restores, loss-trajectory continuity vs an uninterrupted twin, and the
+# admission audit + every shrink/grow/admit attributable in mlsl_stats.log
+# and the Perfetto trace); their fast variants run inside tier-1 too.
 set -euo pipefail
 cd "$(dirname "$0")/.."
 exec env JAX_PLATFORMS=cpu python -m pytest tests/test_soak.py -q -m soak \

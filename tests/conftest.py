@@ -86,6 +86,13 @@ def _reset_supervisor():
 
     checker._pending.clear()
     sentinel._last_audit = None
+    # the elastic active-world registry is process-wide by design (a shrunk
+    # world must survive Environment rebuilds); tests that shrink must not
+    # leave later tests running on a survivor subset
+    from mlsl_tpu import elastic
+
+    elastic.reset()
+    stats.reset_elastic_counters()
 
 
 @pytest.fixture(autouse=True)

@@ -151,6 +151,12 @@ SITE_CONFIGS = {
     # nothing), so its matrix row needs the optax config
     "train.opt_state": ("adam", 3),
     "train.grads": ("plain", 3),
+    # the elastic-mesh fault (ISSUE 14): with NO coordinator armed (this
+    # harness), an MLSLDeviceLossError at dispatch takes the restart rung
+    # like any recoverable fault and replays bit-exact; the reshard rung it
+    # takes when MLSL_ELASTIC=1 is pinned by tests/test_elastic.py and the
+    # elastic soak in tests/test_soak.py
+    "device.lost": ("plain", 3),
 }
 
 

@@ -1,0 +1,709 @@
+"""Elastic mesh (mlsl_tpu.elastic): survive device loss by rescaling.
+
+Covers the full vertical slice: survivor-set topology construction (flat +
+tiered), the DEVICE_LOSS taxonomy routing, the A140/A141 reshard-plan
+verifier (green + tampered), live ZeRO-1 state movement pinned EXACTLY
+against a host re-slice oracle, the sentinel-audit admission contract
+(a corrupted rejoiner is rejected, re-synced, then admitted), the capacity
+budget escalating to the restart rung, and the world-size-change tuned-
+profile staleness regression (a profile measured at the old world must be
+rejected with a warning on the post-reshard re-init, never silently
+honored)."""
+
+import json
+import os
+
+import numpy as np
+import pytest
+import jax
+import jax.numpy as jnp
+
+from mlsl_tpu import chaos, elastic, supervisor
+from mlsl_tpu.core import stats
+from mlsl_tpu.core.environment import Environment
+from mlsl_tpu.log import MLSLDeviceLossError, MLSLError
+
+pytestmark = pytest.mark.chaos
+
+
+@pytest.fixture(autouse=True)
+def _clear(monkeypatch):
+    chaos.clear()
+    elastic.reset()
+    yield
+    chaos.clear()
+    elastic.reset()
+
+
+# -- survivor-set topology construction (comm/mesh.py) ------------------------
+
+
+def test_survivor_devices_flat():
+    from mlsl_tpu.comm.mesh import survivor_devices
+
+    devs = jax.devices()
+    surv = survivor_devices([devs[2], devs[5]])
+    assert surv == tuple(d for i, d in enumerate(devs) if i not in (2, 5))
+
+
+def test_survivor_devices_tiered_drops_whole_slice(monkeypatch):
+    # 2x4 synthetic tiers: losing one member of tier 1 drops ALL of tier 1
+    from mlsl_tpu.comm.mesh import survivor_devices
+
+    monkeypatch.setenv("MLSL_MESH_TIERS", "2x4")
+    devs = jax.devices()
+    surv = survivor_devices([devs[5]])
+    assert surv == tuple(devs[:4])
+
+
+def test_survivor_devices_nothing_left_raises(monkeypatch):
+    from mlsl_tpu.comm.mesh import survivor_devices
+
+    with pytest.raises(MLSLError, match="no survivors"):
+        survivor_devices(jax.devices())
+
+
+# -- taxonomy + chaos grammar -------------------------------------------------
+
+
+def test_device_loss_class_and_recoverability():
+    from mlsl_tpu.resilience import RECOVERABLE
+
+    e = MLSLDeviceLossError("host preempted", devices=jax.devices()[-1:])
+    assert supervisor.classify(e) is supervisor.ErrorClass.DEVICE_LOSS
+    assert isinstance(e, RECOVERABLE)
+    assert len(e.devices) == 1
+
+
+def test_device_lost_site_default_exception():
+    p = chaos.plan("device.lost", "error")
+    assert p.exc is MLSLDeviceLossError
+    with pytest.raises(MLSLDeviceLossError):
+        chaos.inject("device.lost")
+    # explicit exception names still win (cross-class testing) — including
+    # ChaosError itself, which used to be indistinguishable from "no exc
+    # named" and silently rewritten to the site default (regression)
+    chaos.clear()
+    p = chaos.plan("device.lost", "error", exc=OSError)
+    assert p.exc is OSError
+    chaos.clear()
+    p = chaos.plan("device.lost", "error", exc=chaos.ChaosError)
+    assert p.exc is chaos.ChaosError
+
+
+def test_device_lost_env_grammar():
+    plans = chaos.refresh_from_env("device.lost:error@2x3%0.5")
+    assert plans[0].site == "device.lost"
+    assert plans[0].exc is MLSLDeviceLossError
+    assert plans[0].after == 2 and plans[0].times == 3
+    assert plans[0].prob == 0.5
+
+
+# -- the A140/A141 reshard-plan verifier --------------------------------------
+
+
+def _plan_8_to_6():
+    return elastic.build_reshard_plan(
+        {"l1": 100, "l2": 7}, {"l1": 104, "l2": 8}, {"l1": 102, "l2": 12},
+        d_old=8, d_new=6,
+    )
+
+
+def test_reshard_plan_green():
+    from mlsl_tpu.analysis import plan as plan_mod
+
+    rep = plan_mod.verify_reshard(_plan_8_to_6())
+    assert rep.errors == [] and rep.warnings == []
+
+
+def test_reshard_plan_gap_is_a140():
+    from mlsl_tpu.analysis import plan as plan_mod
+
+    p = _plan_8_to_6()
+    del p["layers"][0]["sources"][3]  # drop one rank's interval -> gap
+    rep = plan_mod.verify_reshard(p)
+    assert "MLSL-A140" in rep.codes()
+
+
+def test_reshard_plan_overlap_is_a140():
+    from mlsl_tpu.analysis import plan as plan_mod
+
+    p = _plan_8_to_6()
+    r, lo, hi = p["layers"][0]["sources"][2]
+    p["layers"][0]["sources"][2] = (r, lo - 2, hi)  # overlap previous chunk
+    rep = plan_mod.verify_reshard(p)
+    assert "MLSL-A140" in rep.codes()
+
+
+def test_reshard_plan_bad_target_geometry_is_a141():
+    from mlsl_tpu.analysis import plan as plan_mod
+
+    p = _plan_8_to_6()
+    p["layers"][0]["padded_new"] = 90  # < count: survivors cannot hold it
+    rep = plan_mod.verify_reshard(p)
+    assert "MLSL-A141" in rep.codes()
+
+
+def test_reshard_plan_zero_k_old_reports_not_crashes():
+    """A malformed plan with k_old == 0 and a non-empty source interval must
+    come back as A140/A141 findings — the verifier exists to diagnose bad
+    plans, so it cannot die on a ZeroDivisionError instead (regression)."""
+    from mlsl_tpu.analysis import plan as plan_mod
+
+    p = {"d_old": 8, "d_new": 6, "layers": [{
+        "name": "l", "count": 5, "padded_old": 0, "padded_new": 6,
+        "k_old": 0, "k_new": 1,
+        "sources": [(0, 0, 5)],
+        "targets": [(r, r, r + 1) for r in range(6)],
+    }]}
+    rep = plan_mod.verify_reshard(p)
+    assert "MLSL-A140" in rep.codes() and "MLSL-A141" in rep.codes()
+
+
+# -- config validation --------------------------------------------------------
+
+
+def test_elastic_knob_validation(monkeypatch):
+    monkeypatch.setenv("MLSL_CAPACITY_BUDGET", "-1")
+    e = Environment.get_env()
+    with pytest.raises(MLSLError, match="MLSL_CAPACITY_BUDGET"):
+        e.init()
+    monkeypatch.setenv("MLSL_CAPACITY_BUDGET", "2")
+    monkeypatch.setenv("MLSL_ELASTIC_GROW_AFTER", "-3")
+    with pytest.raises(MLSLError, match="MLSL_ELASTIC_GROW_AFTER"):
+        Environment.get_env().init()
+    monkeypatch.setenv("MLSL_ELASTIC_GROW_AFTER", "0")
+    monkeypatch.setenv("MLSL_ELASTIC_ADMIT_RETRIES", "-1")
+    with pytest.raises(MLSLError, match="MLSL_ELASTIC_ADMIT_RETRIES"):
+        Environment.get_env().init()
+
+
+def test_status_entry_shape():
+    st = supervisor.status()["elastic"]
+    assert st["state"] == "full"
+    assert st["world_size"] == 8 and st["active_size"] == 8
+    assert "budget_remaining" in st and "shrinks" in st
+
+
+def test_zero_shed_loss_escalates_to_restart():
+    """A loss attributing only devices already outside the active world (a
+    stale preemption notice re-surfacing) must escalate to the restart
+    rung, not run a no-op reshard — the loop's reshard branch spends
+    neither budget nor retry attempts, so honoring it spins forever
+    (regression)."""
+    elastic._set_active(tuple(jax.devices()[:6]))
+    coord = elastic.ElasticCoordinator(capacity_budget=4)
+    with pytest.raises(MLSLError, match="nothing to shed"):
+        coord.shrink(
+            None, None,
+            error=MLSLDeviceLossError("stale", devices=jax.devices()[6:]),
+            step=3,
+        )
+    assert stats.ELASTIC_COUNTERS["restart_fallbacks"] == 1
+    assert stats.ELASTIC_COUNTERS["shrinks"] == 0
+
+
+def test_drain_failure_counts_restart_fallback():
+    """A failed drain (unsupported trainer shape here) escalates to the
+    restart rung AND counts restart_fallbacks — the ELASTIC totals line
+    must answer 'did capacity churn cost a restart' truthfully
+    (regression: only the budget/no-shed paths used to count)."""
+    coord = elastic.ElasticCoordinator(capacity_budget=4)
+    with pytest.raises(MLSLError, match="restart rung"):
+        coord.shrink(
+            object(), None,
+            error=MLSLDeviceLossError("preempted",
+                                      devices=jax.devices()[7:]),
+            step=1,
+        )
+    assert stats.ELASTIC_COUNTERS["restart_fallbacks"] == 1
+    # the registry never moved: the recovery rebuilds the pre-shrink world
+    assert elastic.active_devices() is None
+
+
+def test_programmatic_config_arms_elastic(tmp_path, monkeypatch):
+    """Config(elastic=True, capacity_budget=N) set programmatically — no
+    env vars — must arm the loop's coordinator and bind the budget, the
+    same contract as MLSL_ELASTIC=1/MLSL_CAPACITY_BUDGET (regression: only
+    the env vars used to be consulted)."""
+    from mlsl_tpu.resilience import FaultTolerantLoop
+
+    monkeypatch.delenv("MLSL_ELASTIC", raising=False)
+    monkeypatch.delenv("MLSL_CAPACITY_BUDGET", raising=False)
+    env = Environment.get_env().init()
+    try:
+        env.config.elastic = True
+        env.config.capacity_budget = 3
+        loop = FaultTolerantLoop(lambda: None, str(tmp_path / "ck"))
+        assert loop.elastic is not None
+        assert loop.elastic.capacity_budget == 3
+    finally:
+        env.finalize()
+    # the documented factory pattern: the loop is constructed BEFORE any
+    # Environment exists, so arming must get a second chance at run()
+    # (after the factory's env init) — pinned via the shared helper
+    loop = FaultTolerantLoop(lambda: None, str(tmp_path / "ck2"))
+    assert loop.elastic is None
+    env = Environment.get_env().init()
+    try:
+        env.config.elastic = True
+        env.config.capacity_budget = 2
+        loop._arm_elastic_if_configured()  # what run() does post-factory
+        assert loop.elastic is not None
+        assert loop.elastic.capacity_budget == 2
+    finally:
+        env.finalize()
+
+
+def test_reset_clears_budget_snapshot():
+    """A dead coordinator's capacity budget must not leak into status():
+    reset() clears the budget snapshot alongside the registry (regression)."""
+    elastic.ElasticCoordinator(capacity_budget=3)
+    assert supervisor.status()["elastic"]["capacity_budget"] == 3
+    elastic.reset()
+    st = supervisor.status()["elastic"]
+    assert st["capacity_budget"] is None
+    assert st["budget_remaining"] is None
+
+
+def test_dispatch_site_does_not_consume_silent_plan():
+    """The collective-dispatch pass over the device.lost site fires only
+    error-shaped plans; a 'silent' plan is elastic grow's (the rejoiner
+    corruption) and must stay armed — firing it at the first gradient
+    collective would burn its budget before grow ever polls (regression)."""
+    from mlsl_tpu.comm.collectives import _ChaosDispatch
+
+    d = _ChaosDispatch(lambda *bufs: "ok", "allreduce")
+    p = chaos.plan("device.lost", "silent")
+    assert d() == "ok"  # the launch passes the site with the plan untouched
+    assert p.hits == 0 and p.fires == 0
+    # grow's unfiltered poll is the one consumer of the silent plan
+    fired = chaos.inject("device.lost", phase="admit")
+    assert fired is p and p.fires == 1
+    # an error-shaped loss still surfaces at dispatch
+    chaos.clear()
+    chaos.plan("device.lost", "error")
+    with pytest.raises(MLSLDeviceLossError):
+        d()
+
+
+# -- shared trainer harness ---------------------------------------------------
+
+
+def _make_trainer(batch=24, **kw):
+    from mlsl_tpu.models.mlp import LAYERS, get_layer, init, loss_fn
+    from mlsl_tpu.models.train import DataParallelTrainer
+
+    env = Environment.get_env().init()
+    d = env.get_process_count()
+    dist = env.create_distribution(d, 1)
+    sess = env.create_session()
+    sess.set_global_minibatch_size(batch)
+    return DataParallelTrainer(
+        env, dist, sess, init(jax.random.PRNGKey(0)), loss_fn, LAYERS,
+        get_layer, lr=0.1, **kw,
+    )
+
+
+def _batch(trainer, step, batch=24):
+    rng = np.random.default_rng(step)
+    x = rng.normal(size=(batch, 8)).astype(np.float32)
+    y = rng.integers(0, 4, size=(batch,)).astype(np.int32)
+    return trainer.shard_batch(x, y)
+
+
+def _host_du(trainer):
+    """Host oracle: every rank's owned shard concatenated in rank order."""
+    out = {}
+    for name, tree in trainer._du_opt_state.items():
+        out[name] = jax.tree.map(
+            lambda l: np.concatenate([
+                np.asarray(s.data).reshape(-1)
+                for s in sorted(l.addressable_shards,
+                                key=lambda s: s.device.id)
+            ]),
+            tree,
+        )
+    return out
+
+
+# -- live ZeRO-1 reshard: exact state-movement parity -------------------------
+
+
+@pytest.mark.slow
+def test_zero1_reshard_moves_state_exactly():
+    """Shrink 8 -> 6 mid-run: every elementwise ZeRO-1 leaf on the survivor
+    world must equal the host re-slice of the old world's shards EXACTLY
+    (the reshard moves bytes, it computes nothing), replicated leaves carry,
+    and the shrunk trainer keeps training."""
+    import optax
+
+    factory = lambda: _make_trainer(
+        distributed_update=True, optimizer=optax.adam(1e-2)
+    )
+    trainer = factory()
+    for s in range(2):
+        trainer.step(_batch(trainer, s))
+    jax.block_until_ready(trainer.params)
+    truth_du = _host_du(trainer)
+    truth_params = jax.device_get(trainer.params)
+    counts = dict(trainer.layer_counts)
+    padded_old = dict(trainer.padded_counts)
+    d_old = trainer.data_size
+
+    coord = elastic.ElasticCoordinator(capacity_budget=4)
+    lost = jax.devices()[6:]
+    new_trainer = coord.shrink(
+        trainer, factory,
+        error=MLSLDeviceLossError("2 hosts preempted", devices=lost),
+        step=2,
+    )
+    assert new_trainer.data_size == 6
+    assert elastic.active_devices() == tuple(jax.devices()[:6])
+    # params carried bit-exact
+    for a, b in zip(jax.tree.leaves(truth_params),
+                    jax.tree.leaves(jax.device_get(new_trainer.params))):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    # every ZeRO-1 leaf: new shards == re-slice of the old full vector
+    new_du = _host_du(new_trainer)
+    checked_reshard = checked_carry = 0
+    for name in truth_du:
+        old_leaves = jax.tree.leaves(truth_du[name])
+        new_leaves = jax.tree.leaves(new_du[name])
+        for old_full, new_full in zip(old_leaves, new_leaves):
+            k_old = old_full.shape[0] // d_old
+            if k_old * d_old == padded_old[name] and k_old > 1:
+                want = old_full[: counts[name]]
+                want = np.pad(
+                    want, (0, new_trainer.padded_counts[name] - want.shape[0])
+                )
+                np.testing.assert_array_equal(new_full, want)
+                checked_reshard += 1
+            else:
+                # replicated leaf (adam's step count): same value everywhere
+                np.testing.assert_array_equal(
+                    new_full.reshape(6, -1),
+                    np.broadcast_to(old_full[:k_old], (6, k_old)),
+                )
+                checked_carry += 1
+    assert checked_reshard > 0 and checked_carry > 0
+    assert stats.ELASTIC_COUNTERS["reshard_buffers"] == (
+        checked_reshard + checked_carry
+    )
+    # the survivor trainer trains (shapes, programs, groups all re-derived)
+    loss = new_trainer.step(_batch(new_trainer, 2))
+    assert np.isfinite(np.asarray(jax.device_get(loss))).all()
+    Environment.get_env().finalize()
+
+
+# -- leaf-role classification: scalars vs k==1 owned shards -------------------
+
+
+def test_du_leaf_roles_probe_optax_state():
+    """adam's state flattens to (count, mu, nu): the step count is
+    world-invariant, the moments scale with the owned shard — classified by
+    probing the transform at two counts, never by leaf shape."""
+    import optax
+
+    class T:
+        optimizer = optax.adam(1e-2)
+
+    state = T.optimizer.init(jnp.zeros((1,), jnp.float32))
+    assert elastic._du_leaf_roles(T(), state) == [False, True, True]
+
+
+def test_du_leaf_roles_adafactor_schema():
+    # init_adafactor_state dict, sorted-key flatten order:
+    # count, m, v, v_col, v_row — only the elementwise v/m ride the shard
+    state = {"count": 0, "v_row": 0, "v_col": 0, "v": 0, "m": 0}
+    assert elastic._du_leaf_roles(object(), state) == [
+        False, True, True, False, False,
+    ]
+
+
+def test_du_leaf_roles_unknown_state_is_none():
+    assert elastic._du_leaf_roles(object(), (np.zeros(3),)) is None
+
+
+@pytest.mark.slow
+def test_tiny_layer_scalar_state_survives_reshard():
+    """A layer with fewer parameters than the world has ranks makes the
+    owned shard k==1 on BOTH sides of the reshard, so by shape alone adam's
+    replicated step count is indistinguishable from an owned leaf — and the
+    owned path would mix rank copies with zero padding. The step count must
+    CARRY to every survivor; the k==1 moments must RESHARD (regression)."""
+    import optax
+
+    from mlsl_tpu.models.train import DataParallelTrainer
+
+    def factory(batch=24):
+        env = Environment.get_env().init()
+        d = env.get_process_count()
+        dist = env.create_distribution(d, 1)
+        sess = env.create_session()
+        sess.set_global_minibatch_size(batch)
+        return DataParallelTrainer(
+            env, dist, sess,
+            {"t": {"b": jnp.zeros((4,), jnp.float32)}},  # 4 params < ranks
+            lambda p, b: jnp.mean((p["t"]["b"] - 1.0) ** 2),
+            ["t"], lambda p, n: p[n], lr=0.1,
+            distributed_update=True, optimizer=optax.adam(1e-2),
+        )
+
+    trainer = factory()
+    for s in range(2):
+        trainer.step(_batch(trainer, s))
+    jax.block_until_ready(trainer.params)
+    truth = _host_du(trainer)
+    coord = elastic.ElasticCoordinator(capacity_budget=4)
+    trainer = coord.shrink(
+        trainer, factory,
+        error=MLSLDeviceLossError("preempted", devices=jax.devices()[6:]),
+        step=2,
+    )
+    assert trainer.data_size == 6
+    new = _host_du(trainer)
+    old_count, old_mu, old_nu = jax.tree.leaves(truth["t"])
+    new_count, new_mu, new_nu = jax.tree.leaves(new["t"])
+    # the step count carried: every survivor holds the old scalar, none
+    # zero-padded (the owned path would have left ranks 4-5 at 0)
+    assert old_count[0] == 2
+    np.testing.assert_array_equal(new_count, np.full(6, old_count[0]))
+    # the k==1 moments resharded: real elements + survivor padding
+    for old_full, new_full in ((old_mu, new_mu), (old_nu, new_nu)):
+        np.testing.assert_array_equal(new_full, np.pad(old_full[:4], (0, 2)))
+    # and the survivor trainer still trains
+    loss = trainer.step(_batch(trainer, 2))
+    assert np.isfinite(np.asarray(jax.device_get(loss))).all()
+    Environment.get_env().finalize()
+
+
+# -- admission audit: a corrupted rejoiner is rejected, resynced, admitted ----
+
+
+@pytest.mark.slow
+def test_admission_rejects_corrupted_rejoiner(capfd):
+    factory = lambda: _make_trainer()
+    trainer = factory()
+    trainer.step(_batch(trainer, 0))
+    coord = elastic.ElasticCoordinator(capacity_budget=4, admit_retries=1)
+    trainer = coord.shrink(
+        trainer, factory,
+        error=MLSLDeviceLossError("preempted", devices=jax.devices()[6:]),
+        step=1,
+    )
+    trainer.step(_batch(trainer, 1))
+    jax.block_until_ready(trainer.params)
+    # a silent device.lost plan corrupts the REJOINING copy during grow
+    chaos.plan("device.lost", "silent")
+    trainer = coord.grow(trainer, factory, step=2)
+    c = stats.ELASTIC_COUNTERS
+    assert c["admit_rejects"] >= 1, "corrupted rejoiner was never rejected"
+    assert c["resyncs"] >= 1
+    assert c["admits"] == 1, "replica admitted only after the audit passed"
+    assert trainer.dist.topology.world_size == 8
+    # post-admission state really is consistent: a fresh audit agrees
+    from mlsl_tpu import sentinel as sentinel_mod
+
+    res = sentinel_mod.Sentinel(trainer.mesh).audit_now(trainer, step=2)
+    assert res.equal
+    err = capfd.readouterr().err
+    assert "admission audit REJECTED" in err
+    Environment.get_env().finalize()
+
+
+@pytest.mark.slow
+def test_admission_persistent_divergence_abandons_grow():
+    """Persistent divergence ABANDONS the grow (the DESIGN.md contract):
+    grow() returns a rebuilt SURVIVOR trainer with the harvest carried back
+    — never an exception into the restart ladder — and disarms the return
+    flags so the next poll doesn't re-attempt the same bad replica."""
+    factory = lambda: _make_trainer()
+    trainer = factory()
+    trainer.step(_batch(trainer, 0))
+    jax.block_until_ready(trainer.params)
+    coord = elastic.ElasticCoordinator(capacity_budget=4, admit_retries=0)
+    trainer = coord.shrink(
+        trainer, factory,
+        error=MLSLDeviceLossError("preempted", devices=jax.devices()[6:]),
+        step=1,
+    )
+    truth_params = jax.device_get(trainer.params)
+    chaos.plan("device.lost", "silent")
+    trainer = coord.grow(trainer, factory, step=2)
+    # abandoned: still shrunk, return flags disarmed, state intact
+    assert trainer.data_size == 6
+    assert elastic.active_devices() == tuple(jax.devices()[:6])
+    assert coord._pending_return is False and coord._return_due is None
+    c = stats.ELASTIC_COUNTERS
+    assert c["grows"] == 0 and c["grow_abandons"] == 1
+    st = supervisor.status()["elastic"]
+    assert st["last_reshard"]["verdict"] == "abandoned"
+    for a, b in zip(jax.tree.leaves(truth_params),
+                    jax.tree.leaves(jax.device_get(trainer.params))):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    # a fresh announce re-attempts; the replica is clean now -> admitted
+    coord.announce_return()
+    trainer = coord.maybe_grow(trainer, factory, step=3)
+    assert trainer.dist.topology.world_size == 8
+    assert c["grows"] == 1 and c["admits"] == 1
+    Environment.get_env().finalize()
+
+
+@pytest.mark.slow
+def test_persistent_divergence_in_loop_stays_shrunk_no_restart(tmp_path):
+    """The loop-integration regression: an abandoned grow used to raise
+    into FaultTolerantLoop's generic RECOVERABLE handler with the return
+    flags still armed — every subsequent step re-attempted the identical
+    grow and burned a checkpoint-restart recovery (a spiral to the abort
+    budget). It must stay shrunk with ZERO restores and keep training."""
+    from mlsl_tpu.resilience import FaultTolerantLoop
+
+    armed = [0]
+
+    def hook(step, attempt):
+        if step == 2 and armed[0] == 0:
+            armed[0] = 1
+            chaos.plan("device.lost", "error")  # lose a device mid-step 2
+        if step == 4 and armed[0] == 1:
+            armed[0] = 2
+            chaos.plan("device.lost", "silent")  # poison the timed grow
+
+    coord = elastic.ElasticCoordinator(capacity_budget=4, grow_after=3,
+                                       admit_retries=0)
+    loop = FaultTolerantLoop(
+        lambda: _make_trainer(batch=56), str(tmp_path / "ck"),
+        save_every=50, fault_hook=hook, elastic=coord,
+    )
+    trainer = loop.run(lambda t, s: _batch(t, s, batch=56), steps=8)
+    c = stats.ELASTIC_COUNTERS
+    assert c["shrinks"] == 1 and c["grow_abandons"] == 1
+    assert loop.recoveries == 0 and c["restart_fallbacks"] == 0
+    # stayed shrunk through the end of the run
+    assert trainer.dist.topology.world_size == 7
+    assert elastic.active_devices() is not None
+
+
+# -- capacity budget: exhaustion escalates to the restart rung ----------------
+
+
+@pytest.mark.slow
+def test_capacity_budget_escalates_to_restart(tmp_path):
+    from mlsl_tpu.resilience import FaultTolerantLoop
+
+    armed = [0]
+
+    def hook(step, attempt):
+        if step == 2 and armed[0] == 0:
+            armed[0] = 1
+            raise MLSLDeviceLossError(
+                "half the pod preempted", devices=jax.devices()[3:]
+            )
+
+    coord = elastic.ElasticCoordinator(capacity_budget=2)
+    loop = FaultTolerantLoop(
+        lambda: _make_trainer(batch=24), str(tmp_path / "ck"), save_every=2,
+        fault_hook=hook, elastic=coord,
+    )
+    trainer = loop.run(lambda t, s: _batch(t, s), steps=4)
+    # losing 5 devices exceeds the budget of 2: the loss fell back to the
+    # restart rung (checkpoint recovery), and the world NEVER shrank
+    assert loop.recoveries == 1
+    assert trainer.dist.topology.world_size == 8
+    c = stats.ELASTIC_COUNTERS
+    assert c["restart_fallbacks"] == 1 and c["shrinks"] == 0
+    assert elastic.active_devices() is None
+
+
+# -- tuned-profile staleness across a world-size change (the PR fix) ----------
+
+
+def test_stale_profile_rejected_after_world_change(tmp_path, monkeypatch,
+                                                   capfd):
+    """The regression this PR fixes: a recovery/reshard re-init used to
+    re-apply a tuned profile keyed to the FULL world without re-checking the
+    fingerprint against the active (shrunk) world. It must be rejected with
+    a warning, not silently honored."""
+    from mlsl_tpu import sysinfo, tuner
+
+    full_fp = sysinfo.topology_fingerprint()  # the 8-device world
+    path = str(tmp_path / "prof.json")
+    with open(path, "w") as f:
+        json.dump({
+            "version": 1, "fingerprint": full_fp, "created": "",
+            "cells": [{"kind": "allreduce", "shape": [8],
+                       "compression": "none", "max_bytes": None,
+                       "algo": "rhd"}],
+            "knobs": {},
+        }, f)
+    monkeypatch.setenv("MLSL_TUNE_PROFILE", path)
+    # full world: the profile matches and applies
+    env = Environment.get_env().init()
+    assert env.config.tuned_profile is not None
+    env.finalize()
+    # shrunk world (the post-reshard rebuild): same file must now be STALE
+    elastic._set_active(tuple(jax.devices()[:6]))
+    env = Environment.get_env().init()
+    try:
+        assert len(env.devices) == 6
+        assert env.config.tuned_profile is None, (
+            "stale profile silently honored after a world-size change"
+        )
+        err = capfd.readouterr().err
+        assert "different topology" in err
+    finally:
+        env.finalize()
+
+
+def test_fingerprint_tracks_active_devices():
+    from mlsl_tpu import sysinfo
+
+    full = sysinfo.topology_fingerprint()
+    sub = sysinfo.topology_fingerprint(jax.devices()[:6])
+    assert full["num_devices"] == 8 and sub["num_devices"] == 6
+    assert full != sub
+
+
+def test_fingerprint_counts_distinct_hosts():
+    """num_hosts counts DISTINCT hosts, not max(process_index)+1: a
+    survivor subset that excludes every device of a low-indexed host is a
+    single-host world, and a profile swept on a genuine 2-host spread (real
+    cross-host DCN in its measurements) must not transfer to it
+    (regression)."""
+    from mlsl_tpu import sysinfo
+
+    class D:
+        def __init__(self, pi):
+            self.process_index = pi
+
+    survivors_one_host = sysinfo.topology_fingerprint([D(1)] * 4)
+    two_hosts = sysinfo.topology_fingerprint([D(0), D(0), D(1), D(1)])
+    assert survivors_one_host["num_hosts"] == 1
+    assert two_hosts["num_hosts"] == 2
+    assert survivors_one_host != two_hosts
+
+
+# -- checkpoint world recording -----------------------------------------------
+
+
+@pytest.mark.slow
+def test_checkpoint_records_world_and_warns_on_mismatch(tmp_path, capfd):
+    from mlsl_tpu.checkpoint import (
+        CheckpointManager, restore_trainer, save_trainer,
+    )
+
+    trainer = _make_trainer()
+    mgr = CheckpointManager(str(tmp_path / "ck"))
+    save_trainer(mgr, trainer, step=0, wait=True)
+    assert mgr.recorded_world(0) == 8
+    Environment.get_env().finalize()
+    # rebuild on a shrunk world: restore warns (and, params being
+    # replicated, still restores)
+    elastic._set_active(tuple(jax.devices()[:4]))
+    t2 = _make_trainer(batch=16)
+    mgr2 = CheckpointManager(str(tmp_path / "ck"))
+    restored = restore_trainer(mgr2, t2)
+    assert restored == 0
+    err = capfd.readouterr().err
+    assert "world size 8" in err and "active world is 4" in err
+    Environment.get_env().finalize()

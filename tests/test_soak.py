@@ -244,6 +244,151 @@ def test_silent_soak_full(tmp_path, monkeypatch):
     assert loop.recoveries > 0
 
 
+# -- elastic soak (ISSUE 14): device loss -> shrink -> grow, zero restores ----
+#
+# Global batch 56 divides both the full world (8 ranks, 7 rows each) and the
+# post-loss world (7 ranks, 8 rows each), so per-rank local batches stay
+# equal-sized on both sides of the reshard and the mean-of-means loss is the
+# SAME global-batch mean throughout — the loss trajectory is mathematically
+# continuous across shrink and grow, up to float reduction order. The probe
+# records the device-MEAN loss (partition-invariant), not rank 0's local one.
+
+_ELASTIC_BATCH = 56
+
+
+def _make_elastic_trainer():
+    """World-aware factory: the elastic contract — a reshard rebuild must
+    size the Distribution from the ACTIVE world, not a constant."""
+    from mlsl_tpu.models.mlp import LAYERS, get_layer, init, loss_fn
+    from mlsl_tpu.models.train import DataParallelTrainer
+
+    env = Environment.get_env().init()
+    d = env.get_process_count()
+    dist = env.create_distribution(d, 1)
+    sess = env.create_session()
+    sess.set_global_minibatch_size(_ELASTIC_BATCH)
+    return DataParallelTrainer(
+        env, dist, sess, init(jax.random.PRNGKey(0)), loss_fn, LAYERS,
+        get_layer, lr=0.1,
+    )
+
+
+def _elastic_batch_fn(trainer, step):
+    rng = np.random.default_rng(step)
+    x = rng.normal(size=(_ELASTIC_BATCH, 8)).astype(np.float32)
+    y = rng.integers(0, 4, size=(_ELASTIC_BATCH,)).astype(np.int32)
+    return trainer.shard_batch(x, y)
+
+
+def _elastic_run(tmp_path, tag, steps, fault_step=None):
+    from mlsl_tpu.resilience import FaultTolerantLoop
+
+    losses = {}
+    armed = [False]
+
+    def hook(step, attempt):
+        if fault_step is not None and step == fault_step and not armed[0]:
+            armed[0] = True
+            chaos.plan("device.lost", "error")  # MLSLDeviceLossError at
+            # the next collective dispatch — mid-step, like a real loss
+
+    loop = FaultTolerantLoop(
+        _make_elastic_trainer, str(tmp_path / tag), save_every=50,
+        fault_hook=hook,
+    )
+    trainer = loop.run(
+        _elastic_batch_fn, steps=steps,
+        on_step=lambda s, l: losses.__setitem__(
+            s, float(np.asarray(jax.device_get(l)).mean())
+        ),
+    )
+    world = trainer.dist.topology.world_size
+    Environment.get_env().finalize()
+    return loop, losses, world
+
+
+def _elastic_soak(tmp_path, monkeypatch, steps, fault_step, grow_after):
+    from mlsl_tpu import elastic
+
+    monkeypatch.setenv("MLSL_ELASTIC", "1")
+    monkeypatch.setenv("MLSL_ELASTIC_GROW_AFTER", str(grow_after))
+    # uninterrupted twin first (elastic armed but never triggered: the
+    # coordinator must be inert without a loss)
+    _, base_losses, base_world = _elastic_run(tmp_path, "twin", steps)
+    assert base_world == 8
+    assert stats.ELASTIC_COUNTERS["shrinks"] == 0
+    stats.reset_elastic_counters()
+    elastic.reset()
+    loop, losses, world = _elastic_run(
+        tmp_path, "elastic", steps, fault_step=fault_step
+    )
+    chaos.clear()
+    c = stats.ELASTIC_COUNTERS
+    # the cycle: shrink -> continue -> grow -> continue, with ZERO full
+    # checkpoint restores and the rejoiner admitted through its audit
+    assert loop.recoveries == 0, "elastic run fell back to checkpoint restart"
+    assert c["device_losses"] == 1 and c["shrinks"] == 1
+    assert c["grows"] == 1 and c["admits"] >= 1
+    assert world == 8, "capacity never grew back"
+    # loss-trajectory continuity: every step's global-mean loss tracks the
+    # uninterrupted twin (same global batch either side of the reshard;
+    # only float reduction order differs), and the averaged tail agrees
+    assert losses.keys() == base_losses.keys()
+    ks = sorted(losses)
+    np.testing.assert_allclose(
+        [losses[k] for k in ks], [base_losses[k] for k in ks],
+        rtol=2e-3, atol=2e-3,
+    )
+    tail = ks[-4:]
+    assert abs(
+        np.mean([losses[k] for k in tail])
+        - np.mean([base_losses[k] for k in tail])
+    ) < 2e-3
+    # attribution: every shrink/grow/admit is greppable in mlsl_stats.log
+    import os
+
+    text = open(stats.stats_path()).read() if os.path.exists(
+        stats.stats_path()) else ""
+    for word in ("DEVICE_LOSSES", "SHRINKS", "GROWS", "ADMITS"):
+        assert word in text, f"ELASTIC {word} line missing from stats log"
+    return loop, losses, base_losses
+
+
+@pytest.mark.soak
+def test_elastic_soak_fast(tmp_path, monkeypatch):
+    """Tier-1 variant: one seeded device.lost mid-run, shrink at the faulted
+    step, grow 3 steps later — bounded wall-clock (scripts/run_soak.sh runs
+    the full variant)."""
+    _elastic_soak(tmp_path, monkeypatch, steps=9, fault_step=3, grow_after=3)
+
+
+@pytest.mark.slow
+@pytest.mark.soak
+def test_elastic_soak_full(tmp_path, monkeypatch):
+    """Standalone elastic soak: longer run, tracing armed — the Perfetto
+    timeline must attribute the whole cycle (chaos.fired at the loss,
+    elastic.shrink, the admission audit, elastic.grow)."""
+    import json
+
+    from mlsl_tpu import obs
+    from mlsl_tpu.obs import export
+
+    obs.enable(capacity=262144)
+    try:
+        _elastic_soak(
+            tmp_path, monkeypatch, steps=25, fault_step=6, grow_after=5
+        )
+        path = export.write_trace()
+        assert path is not None
+        doc = json.load(open(path))
+        names = {e.get("name") for e in doc["traceEvents"]}
+        for want in ("chaos.fired", "elastic.shrink", "elastic.grow",
+                     "elastic.admit"):
+            assert want in names, f"{want} span missing from the timeline"
+    finally:
+        obs.disable()
+
+
 @pytest.mark.slow
 @pytest.mark.soak
 def test_soak_full(tmp_path):
