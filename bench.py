@@ -368,6 +368,20 @@ def main():
               f"falling back to the subprocess probe", file=sys.stderr)
         overlap, overlap_backend = _overlap_probe_cpu_mesh()
 
+    # Telemetry-plane latency distributions (obs/metrics.py): the standard
+    # row carries step p50/p99 and dispatch->wait p99 from the SAME
+    # histogram registry a production scrape reads, so the bench numbers
+    # and the /metrics numbers share one definition. Collected over a short
+    # untimed window (the overlap-probe pattern) against a fresh registry;
+    # a user-armed MLSL_METRICS registry is restored untouched.
+    step_p50 = step_p99 = wait_p99 = None
+    try:
+        step_p50, step_p99, wait_p99 = _latency_percentiles(
+            trainer, trainer_pl, fw_batch, _sync
+        )
+    except Exception as e:
+        print(f"bench: latency percentiles skipped ({e})", file=sys.stderr)
+
     # Two-tier hierarchical-vs-flat ratio (comm/algos/hier.py): tracked on
     # the synthetic 8-dev two-tier CPU mesh with the DCN bandwidth-delay
     # simulator (benchmarks/hier_bench.py) — a single attached chip has no
@@ -426,6 +440,11 @@ def main():
             round(hier_vs_flat, 4) if hier_vs_flat is not None else None
         ),
         "hier_backend": hier_backend,
+        "step_ms_p50": round(step_p50, 3) if step_p50 is not None else None,
+        "step_ms_p99": round(step_p99, 3) if step_p99 is not None else None,
+        "dispatch_wait_p99_ms": (
+            round(wait_p99, 3) if wait_p99 is not None else None
+        ),
         "batch": batch,
         "pipeline_step_ms": round(pipe_ms, 3) if pipe_ms is not None else None,
         "images_per_s": round(batch / (pipe_ms / 1e3)) if pipe_ms else None,
@@ -455,6 +474,45 @@ def main():
     print(json.dumps(result))
     if not args.quick:  # --quick CPU runs are smoke tests, not evidence
         _persist_measurement(result)
+
+
+def _latency_percentiles(trainer, trainer_pl, batch, sync,
+                         fw_steps: int = 5, pl_steps: int = 3):
+    """-> (step_ms_p50, step_ms_p99, dispatch_wait_p99_ms) from the metrics
+    histogram registry over a short live window: ``fw_steps`` standard
+    trainer steps feed the step_ms histogram, ``pl_steps`` per-layer steps
+    feed the dispatch->wait latency histogram (the standard trainer may ride
+    the fused program, which builds no CommRequest). A registry the user
+    armed (MLSL_METRICS=1) is swapped out and restored so the bench window
+    never pollutes their series."""
+    from mlsl_tpu.obs import metrics as obs_metrics
+
+    prev = obs_metrics._registry
+    # cadence effectively off: this window wants pure histograms, not
+    # loss-readback ticks in the middle of the measurement
+    reg = obs_metrics.MetricsRegistry(every=1 << 30)
+    obs_metrics._registry = reg
+    step_p50 = step_p99 = wait_p99 = None
+    try:
+        for _ in range(fw_steps):
+            trainer.step(batch)
+        sync(trainer.params)
+        # read the step percentiles BEFORE the per-layer window: trainer_pl
+        # steps feed the same step_ms histogram and would skew the standard
+        # row's number with the slower host per-layer schedule
+        h = reg.find("mlsl_step_ms")
+        if h is not None and h.count:
+            step_p50, step_p99 = h.percentile(50), h.percentile(99)
+        for _ in range(pl_steps):
+            trainer_pl.step(batch)
+        sync(trainer_pl.params)
+    finally:
+        obs_metrics._registry = prev
+    waits = [s for s in reg.series()
+             if s.name == "mlsl_dispatch_wait_ms" and s.count]
+    if waits:
+        wait_p99 = max(s.percentile(99) for s in waits)
+    return step_p50, step_p99, wait_p99
 
 
 def _overlap_from_trace(trainer, batch, sync, steps: int = 3):

@@ -122,6 +122,8 @@ def main():
              [sys.executable, "benchmarks/input_pipeline_bench.py"], 1200),
             ("sentinel_overhead",
              [sys.executable, "benchmarks/sentinel_overhead_bench.py"], 900),
+            ("metrics_overhead",
+             [sys.executable, "benchmarks/metrics_overhead_bench.py"], 900),
             ("algo_sweep",
              [sys.executable, "benchmarks/algo_sweep_bench.py", "--quant"],
              1800),

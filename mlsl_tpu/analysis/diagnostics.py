@@ -75,6 +75,8 @@ CODES: Dict[str, Tuple[str, str]] = {
     "MLSL-A205": (ERROR, "bare except swallows the MLSL error taxonomy"),
     "MLSL-A206": (ERROR, "wall-clock time.time() in retry/backoff/poll math "
                          "(use time.monotonic)"),
+    "MLSL-A207": (ERROR, "metrics-registry series internals mutated outside "
+                         "the obs/metrics record/observe/sample paths"),
 }
 
 

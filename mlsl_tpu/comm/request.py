@@ -35,6 +35,7 @@ import jax
 import jax.numpy as jnp
 
 from mlsl_tpu import chaos, checker, supervisor
+from mlsl_tpu.obs import metrics as obs_metrics
 from mlsl_tpu.obs import tracer as obs
 from mlsl_tpu.comm.mesh import NUM_GRID_AXES, ProcessGroup
 from mlsl_tpu.log import (
@@ -919,6 +920,9 @@ class CommRequest:
             tr.complete("wait", "req", t0, track=self._trace_name,
                         req=self.name or self.uid, epoch=self._epoch,
                         algo=self.algo)
+        m = obs_metrics._registry
+        if m is not None:
+            self._record_done_metrics(m)
         return out
 
     def _wait_inner(self, timeout: Optional[float]) -> jax.Array:
@@ -982,8 +986,32 @@ class CommRequest:
             if tr is not None:
                 tr.instant("test.done", "req", track=self._trace_name,
                            req=self.name or self.uid, epoch=self._epoch)
+            m = obs_metrics._registry
+            if m is not None:
+                self._record_done_metrics(m)
             return True, out
         return False, None
+
+    def _record_done_metrics(self, m) -> None:
+        """Telemetry-plane feed at round completion (metrics armed only):
+        the dispatch->wait in-flight latency histogram plus the achieved
+        algbw (payload bytes over in-flight time — the algorithm-bandwidth
+        definition) labeled by the algorithm the selection table chose and
+        its tier shape, so /metrics exposes the per-algo/per-tier bandwidth
+        distribution a tuned profile's effect shows up in."""
+        started = self._started_at
+        if not started:
+            return
+        waited_s = time.monotonic() - started
+        m.observe("mlsl_dispatch_wait_ms", waited_s * 1e3,
+                  kind=self.desc.kind)
+        if waited_s > 0 and self._payload:
+            m.observe(
+                "mlsl_algbw_gbps", self._payload / waited_s / 1e9,
+                buckets=obs_metrics.ALGBW_BUCKETS_GBPS,
+                algo=self.algo,
+                tier="two-tier" if self.algo == "hier" else "flat",
+            )
 
 
 def in_graph_descriptor(kind: str, name: str, algo: str, count: int,

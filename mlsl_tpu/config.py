@@ -51,6 +51,8 @@ _ENV_FIELDS = {
     "MLSL_FEED_CACHE_MB": "feed_cache_mb",
     "MLSL_FEED_WIRE_DTYPE": "feed_wire_dtype",
     "MLSL_SENTINEL_EVERY": "sentinel_every",
+    "MLSL_METRICS_EVERY": "metrics_every",
+    "MLSL_STRAGGLER_EVERY": "straggler_every",
 }
 
 
@@ -285,6 +287,46 @@ class Config:
     # discoverability/printing only.
     chaos_spec: str = ""            # MLSL_CHAOS
 
+    # --- telemetry plane (mlsl_tpu.obs.metrics/serve/straggler;
+    # docs/TUNING.md §19) ---
+    # Arm the typed time-series registry: counter/gauge/histogram series
+    # over every stats counter family plus per-step scalars (loss,
+    # grad-norm, step_ms, input_stall_ms, dispatch->wait latency, per-algo
+    # achieved algbw). Disabled = one module-attr check per site, zero
+    # allocations (the tracer contract). Armed implicitly by
+    # MLSL_METRICS_PORT.
+    metrics: bool = False           # MLSL_METRICS
+    # Sampler cadence in steps: loss readback, counter-family snapshot,
+    # ring sample, and the JSONL append happen every this-many steps.
+    # Tunable via a tuner profile (tuner.KNOB_RANGES); exported env wins.
+    metrics_every: int = 20         # MLSL_METRICS_EVERY
+    # Scrape surface: serve /metrics (Prometheus text), /healthz
+    # (supervisor.status() as JSON) and /statusz (human summary) from a
+    # stdlib HTTP daemon thread on this port. 0 = off.
+    metrics_port: int = 0           # MLSL_METRICS_PORT
+    # Timestamped samples retained per series (ring, deque(maxlen)).
+    metrics_retention: int = 512    # MLSL_METRICS_RETENTION
+    # Straggler sentinel (obs/straggler.py): fire when one replica's
+    # windowed median step time exceeds this multiple of its peers'
+    # median, sustained over straggler_sustain consecutive audits.
+    # 0 = off; armed values must be > 1.
+    straggler_skew: float = 0.0     # MLSL_STRAGGLER_SKEW
+    # Observed steps per cross-replica audit window. Tunable via a tuner
+    # profile (tuner.KNOB_RANGES); exported env wins.
+    straggler_every: int = 20       # MLSL_STRAGGLER_EVERY
+    # Consecutive suspect audits before a replica is CONFIRMED (one GC
+    # pause / load spike must not flag, let alone shed).
+    straggler_sustain: int = 2      # MLSL_STRAGGLER_SUSTAIN
+    # Hand a confirmed straggler to the elastic coordinator as a shed
+    # candidate (synthetic DEVICE_LOSS through ElasticCoordinator.shed;
+    # needs MLSL_ELASTIC armed to act). Off = observe/flag only.
+    straggler_shed: bool = False    # MLSL_STRAGGLER_SHED
+    # Watchdog-trip device profile: on MLSLTimeoutError also capture a
+    # short jax.profiler trace next to the flight record, so a wedged wait
+    # arrives with host timeline AND device profile. Read per trip by
+    # core/stats (recorded here for discoverability, like chaos_spec).
+    profile_on_trip: bool = False   # MLSL_PROFILE_ON_TRIP
+
     # --- observability tier (mlsl_tpu.obs span tracer) ---
     # Kept for discoverability/printing only, like chaos_spec: the tracer is
     # process-wide (armed at import from MLSL_TRACE, or obs.enable()) and the
@@ -479,6 +521,38 @@ class Config:
             "MLSL_VERIFY_SEVERITY must be 'error' or 'warn' (got %r)",
             self.verify_severity,
         )
+        mlsl_assert(
+            self.metrics_every >= 1,
+            "MLSL_METRICS_EVERY must be >= 1 (got %d)", self.metrics_every,
+        )
+        mlsl_assert(
+            0 <= self.metrics_port <= 65535,
+            "MLSL_METRICS_PORT must be in [0, 65535] (0 = off; got %d)",
+            self.metrics_port,
+        )
+        mlsl_assert(
+            self.metrics_retention >= 2,
+            "MLSL_METRICS_RETENTION must be >= 2 (got %d)",
+            self.metrics_retention,
+        )
+        mlsl_assert(
+            self.straggler_skew == 0 or self.straggler_skew > 1.0,
+            "MLSL_STRAGGLER_SKEW must be 0 (off) or > 1 — a skew ratio at "
+            "or below 1 would flag healthy replicas (got %r)",
+            self.straggler_skew,
+        )
+        mlsl_assert(
+            self.straggler_every >= 3,
+            "MLSL_STRAGGLER_EVERY must be >= 3 (a replica needs 3 window "
+            "samples to be judged — a smaller window closes before anyone "
+            "is judgeable and silently disables detection; got %d)",
+            self.straggler_every,
+        )
+        mlsl_assert(
+            self.straggler_sustain >= 1,
+            "MLSL_STRAGGLER_SUSTAIN must be >= 1 (got %d)",
+            self.straggler_sustain,
+        )
 
     @staticmethod
     def from_env() -> "Config":
@@ -562,6 +636,23 @@ class Config:
         c.ckpt_save_retries = _env_int("MLSL_CKPT_SAVE_RETRIES", c.ckpt_save_retries)
         c.ckpt_retry_backoff_s = _env_float(
             "MLSL_CKPT_RETRY_BACKOFF_S", c.ckpt_retry_backoff_s
+        )
+        c.metrics = _env_bool("MLSL_METRICS", c.metrics)
+        c.metrics_every = _env_int("MLSL_METRICS_EVERY", c.metrics_every)
+        c.metrics_port = _env_int("MLSL_METRICS_PORT", c.metrics_port)
+        c.metrics_retention = _env_int(
+            "MLSL_METRICS_RETENTION", c.metrics_retention
+        )
+        c.straggler_skew = _env_float("MLSL_STRAGGLER_SKEW", c.straggler_skew)
+        c.straggler_every = _env_int(
+            "MLSL_STRAGGLER_EVERY", c.straggler_every
+        )
+        c.straggler_sustain = _env_int(
+            "MLSL_STRAGGLER_SUSTAIN", c.straggler_sustain
+        )
+        c.straggler_shed = _env_bool("MLSL_STRAGGLER_SHED", c.straggler_shed)
+        c.profile_on_trip = _env_bool(
+            "MLSL_PROFILE_ON_TRIP", c.profile_on_trip
         )
         c.verify = _env_bool("MLSL_VERIFY", c.verify)
         c.verify_severity = os.environ.get(

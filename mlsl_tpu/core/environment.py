@@ -118,6 +118,21 @@ class Environment:
         from mlsl_tpu import tuner
 
         tuner.init_profile(self.config, self.devices)
+        # telemetry plane (obs/metrics.py + obs/serve.py): arm the registry
+        # when MLSL_METRICS or a scrape port asks for it, and start the
+        # /metrics + /healthz + /statusz daemon thread on MLSL_METRICS_PORT.
+        # Both are process-wide and idempotent (the tracer contract): a
+        # recovery teardown/rebuild cycle keeps the series history and the
+        # scrape surface alive mid-incident.
+        if self.config.metrics or self.config.metrics_port:
+            from mlsl_tpu.obs import metrics as obs_metrics
+
+            obs_metrics.enable(every=self.config.metrics_every,
+                               retention=self.config.metrics_retention)
+        if self.config.metrics_port:
+            from mlsl_tpu.obs import serve as obs_serve
+
+            obs_serve.start_server(self.config.metrics_port)
         self.dispatcher = Dispatcher(self.config)
         self._initialized = True
         self._init_pid = os.getpid()

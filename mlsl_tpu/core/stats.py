@@ -80,6 +80,9 @@ def record_watchdog_event(descriptor: str, phase: str, waited_s: float) -> None:
         if path:
             evt["flight_record"] = path
             log_warning("watchdog flight record written: %s", path)
+    prof = _profile_on_trip(descriptor)
+    if prof:
+        evt["device_profile"] = prof
     try:
         with open(stats_path(), "a") as f:
             f.write(
@@ -88,6 +91,41 @@ def record_watchdog_event(descriptor: str, phase: str, waited_s: float) -> None:
             )
     except OSError:
         pass
+
+
+#: how long the on-trip device profile samples the wedged state (seconds):
+#: long enough for the profiler to catch the in-flight executable / idle
+#: devices, short enough that the trip still raises promptly
+PROFILE_ON_TRIP_WINDOW_S = 0.25
+
+
+def _profile_on_trip(reason: str) -> Optional[str]:
+    """``MLSL_PROFILE_ON_TRIP=1``: capture a short jax.profiler device trace
+    of the wedged state, next to the flight record — the host timeline says
+    WHERE the wait stuck, the device profile says what (if anything) the
+    chips were doing under it. Best-effort by contract: a profiler failure
+    (already active, unsupported backend) must never replace the
+    MLSLTimeoutError the watchdog exists to raise."""
+    v = (os.environ.get("MLSL_PROFILE_ON_TRIP") or "").strip().lower()
+    if v in ("", "0", "false", "no", "off"):
+        return None
+    out_dir = os.path.join(
+        obs.trace_dir(), f"profile-trip-{time.time_ns() // 1_000_000}"
+    )
+    try:
+        jax.profiler.start_trace(out_dir)
+        try:
+            time.sleep(PROFILE_ON_TRIP_WINDOW_S)
+        finally:
+            jax.profiler.stop_trace()
+    except Exception as e:  # profiler busy/unsupported: keep the trip primary
+        log_warning(
+            "MLSL_PROFILE_ON_TRIP capture failed (%s: %s); continuing with "
+            "the host flight record only (%s)", type(e).__name__, e, reason,
+        )
+        return None
+    log_warning("watchdog device profile written: %s", out_dir)
+    return out_dir
 
 
 # Bucket-round accounting (core/bucketing.py): process-wide like the watchdog
@@ -321,6 +359,36 @@ def record_analysis(kind: str, errors: int, warnings: int,
 def reset_analysis_counters() -> None:
     for k in ANALYSIS_COUNTERS:
         ANALYSIS_COUNTERS[k] = 0
+
+
+# Straggler-sentinel accounting (mlsl_tpu.obs.straggler): cross-replica
+# skew audits, confirmed-straggler flags, and elastic sheds — process-wide
+# like the degrade counters (the sentinel is fed from the trainer with no
+# Session handle). Flags and sheds are cold (a confirmed straggler is rarer
+# than a breaker trip) and append an immediate STRAGGLER line, the DEGRADE
+# transition contract; per-audit bookkeeping only bumps the counter.
+STRAGGLER_COUNTERS: Dict[str, int] = {
+    "audits": 0,          # cross-replica comparisons run
+    "flags": 0,           # confirmed stragglers (sustained skew) flagged
+    "sheds": 0,           # flagged replicas handed to the elastic coordinator
+    "shed_fallbacks": 0,  # shed handoffs the coordinator refused/failed
+}
+
+
+def record_straggler(event: str, detail: str = "") -> None:
+    """One straggler-sentinel event (see STRAGGLER_COUNTERS keys)."""
+    STRAGGLER_COUNTERS[event] += 1
+    if event != "audits":  # audits are the per-interval heartbeat, not news
+        try:
+            with open(stats_path(), "a") as f:
+                f.write(f"{'STRAGGLER':<16} {event.upper():<8} {detail}\n")
+        except OSError:
+            pass
+
+
+def reset_straggler_counters() -> None:
+    for k in STRAGGLER_COUNTERS:
+        STRAGGLER_COUNTERS[k] = 0
 
 
 def record_comm_retry(phase: str, request: str, error: BaseException,
@@ -882,6 +950,17 @@ class Statistics:
                 f"reshard_buffers {ec['reshard_buffers']} "
                 f"restart_fallbacks {ec['restart_fallbacks']}"
             )
+        gc = STRAGGLER_COUNTERS
+        if any(gc.values()):
+            # the straggler story: how many skew audits ran, which replicas
+            # were confirmed slow, and whether any were shed — one grep
+            # ('STRAGGLER') answers "did one replica tax this run"
+            lines.append(
+                f"{'STRAGGLER':<16} {'SKEW':<8} "
+                f"audits {gc['audits']} flags {gc['flags']} "
+                f"sheds {gc['sheds']} "
+                f"shed_fallbacks {gc['shed_fallbacks']}"
+            )
         kc = CHKP_COUNTERS
         if any(kc.values()):
             lines.append(
@@ -908,6 +987,9 @@ class Statistics:
                      # elastic's healthy vocabulary is 'full', which never
                      # equals CLOSED — list it only when actually shrunk
                      else st["state"] == "shrunk" if name == "elastic"
+                     # straggler's healthy vocabulary is 'off'/'watching'
+                     # (the elastic lesson): list only when flagged
+                     else st["state"] == "flagged" if name == "straggler"
                      else st.get("trips") or st["state"] != supervisor.CLOSED)
             )
             fb = " ".join(

@@ -314,6 +314,72 @@ class ElasticCoordinator:
                  step, len(survivors), self.shed_total, self.capacity_budget)
         return new_trainer
 
+    # -- straggler shed (obs/straggler.py -> here) --------------------------
+
+    def shed(self, trainer, make_trainer, replica: int, step: int = 0):
+        """Hand a confirmed straggler replica to the shrink machinery: the
+        telemetry plane's measurement loop closed into action
+        (docs/DESIGN.md "Telemetry plane"). ``replica`` carries the
+        numbering the straggler sentinel's observations use — the feeding
+        process's ``jax.process_index()`` (models/train.py). On a
+        multi-process world the shed therefore names ALL of that process's
+        active devices (the slow HOST is the straggler unit — shedding one
+        of its chips would leave the stall in place); on a single-process
+        world every device shares process index 0, so the id falls back to
+        active-world device order (the proof-mesh/test numbering, where
+        data replica r IS device r). The shed is a synthetic DEVICE_LOSS
+        through :meth:`shrink`, so the capacity budget, the A140/A141
+        coverage plans, and the grow/re-admission audit all apply untouched
+        — a shed straggler that recovers rejoins through the same
+        fingerprint audit as a returning preempted host.
+
+        Raises (MLSLError) when the replica does not name active capacity
+        or the shrink refuses (budget) — the caller (FaultTolerantLoop)
+        logs, counts ``shed_fallbacks``, and keeps training on the full
+        world: shedding a straggler is an optimization and must never cost
+        availability."""
+        from mlsl_tpu.core import stats as stats_mod
+
+        active = _active if _active is not None else self.world
+        procs = {getattr(d, "process_index", 0) for d in active}
+        if len(procs) > 1:
+            devs = tuple(d for d in active
+                         if getattr(d, "process_index", 0) == int(replica))
+        elif 0 <= int(replica) < len(active):
+            devs = (active[int(replica)],)
+        else:
+            devs = ()
+        if not devs:
+            stats_mod.record_straggler(
+                "shed_fallbacks",
+                f"replica={replica} names no active capacity "
+                f"(world={len(active)}, processes={len(procs)})",
+            )
+            raise MLSLError(
+                f"straggler shed: replica {replica} does not name active "
+                f"capacity (active world {len(active)} devices across "
+                f"{len(procs)} process(es))"
+            )
+        err = MLSLDeviceLossError(
+            f"straggler shed: replica {replica} confirmed slow at step "
+            f"{step}", devices=devs,
+        )
+        try:
+            new_trainer = self.shrink(trainer, make_trainer, error=err,
+                                      step=step)
+        except Exception:
+            stats_mod.record_straggler(
+                "shed_fallbacks",
+                f"replica={replica} step={step} shrink refused",
+            )
+            raise
+        stats_mod.record_straggler(
+            "sheds",
+            f"replica={replica} step={step} "
+            f"devices={','.join(str(d) for d in devs)}",
+        )
+        return new_trainer
+
     # -- grow --------------------------------------------------------------
 
     def maybe_grow(self, trainer, make_trainer, step: int):

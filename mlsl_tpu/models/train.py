@@ -19,6 +19,7 @@ device-local flattened layer gradient is the shard — no host round-trips in th
 from __future__ import annotations
 
 import functools
+import time
 from typing import Callable, Dict, List, Optional
 
 import numpy as np
@@ -35,6 +36,7 @@ from mlsl_tpu.comm.mesh import (
     SEQ_AXIS,
 )
 from mlsl_tpu.log import mlsl_assert
+from mlsl_tpu.obs import metrics as obs_metrics
 from mlsl_tpu.obs import tracer as obs_trace
 from mlsl_tpu.types import CompressionType, DataType, OpType
 
@@ -353,6 +355,25 @@ class DataParallelTrainer:
                 self.sentinel = sentinel_mod.Sentinel.from_config(
                     cfg, self.mesh
                 )
+        # Straggler sentinel (obs/straggler.py): per-replica step-time skew
+        # watch, armed from Config (MLSL_STRAGGLER_*). This process feeds
+        # its own replica id; FaultTolerantLoop polls shed_candidate()
+        # between steps and hands a confirmed straggler to the elastic
+        # coordinator.
+        self.straggler = None
+        if cfg is not None:
+            from mlsl_tpu.obs import straggler as straggler_mod
+
+            if straggler_mod.armed(cfg):
+                self.straggler = straggler_mod.StragglerSentinel(
+                    skew=cfg.straggler_skew,
+                    every=cfg.straggler_every,
+                    sustain=cfg.straggler_sustain,
+                    shed=cfg.straggler_shed,
+                )
+        self._replica_id = jax.process_index()
+        self._gnorm_fn = None       # lazy telemetry grad-norm program
+        self._stall_ms_seen = 0.0   # FEED stall total at the last sample
         # force_graph_path bypasses the fused shortcut so the per-layer
         # Start/Wait machinery can be measured even when no comm is needed
         # (bench.py times it against the fused program on one chip). An
@@ -926,11 +947,89 @@ class DataParallelTrainer:
             if not self.sentinel.gate(loss, grads, self.params,
                                       self._step_no):
                 return grads, False
+        m = obs_metrics._registry
+        if m is not None and self._step_no % m.every == 0:
+            # telemetry cadence: the (local) gradient norm, recorded here
+            # because only the host grad paths expose a gradient boundary
+            self._record_grad_norm(m, grads)
         return grads, True
 
     # -- the training step (reference loop mlsl_test.cpp:660-698) ----------
 
+    def step(self, batch) -> jax.Array:
+        """One training step. With the telemetry plane disarmed this is a
+        zero-overhead passthrough (two module/attr None-checks); armed, the
+        step wall time feeds the ``mlsl_step_ms`` histogram and the
+        straggler sentinel, and every ``MLSL_METRICS_EVERY`` steps the
+        cadence tick samples loss/grad-norm/input-stall plus every counter
+        family (``_sample_telemetry``)."""
+        m = obs_metrics._registry
+        if m is None and self.straggler is None:
+            return self._step_impl(batch)
+        t0 = time.perf_counter()
+        loss = self._step_impl(batch)
+        self._post_step_telemetry(m, loss, t0)
+        return loss
+
     def step_accum(self, batches) -> jax.Array:
+        m = obs_metrics._registry
+        if m is None and self.straggler is None:
+            return self._step_accum_impl(batches)
+        t0 = time.perf_counter()
+        loss = self._step_accum_impl(batches)
+        self._post_step_telemetry(m, loss, t0)
+        return loss
+
+    def _post_step_telemetry(self, m, loss, t0: float) -> None:
+        """Armed-path epilogue: step wall time into the histogram + the
+        straggler feed, cadence tick every ``m.every`` steps."""
+        step_ms = (time.perf_counter() - t0) * 1e3
+        if m is not None:
+            m.observe("mlsl_step_ms", step_ms)
+            if self._step_no % m.every == 0:
+                self._sample_telemetry(m, loss)
+        strag = self.straggler
+        if strag is not None:
+            strag.observe(self._replica_id, step_ms)
+            strag.maybe_audit(self._step_no)
+
+    def _sample_telemetry(self, m, loss) -> None:
+        """One cadence tick (``MLSL_METRICS_EVERY``): the scalars that cost
+        a device sync or IO live here, NOT per step — loss readback (one
+        host sync), the input-stall delta since the last tick, a gauge
+        snapshot of every core/stats counter family, one timestamped sample
+        per series, and the JSONL append."""
+        try:
+            # per-device loss buffers (the step's native shape) read back as
+            # the device mean — the same scalar the examples log
+            m.set("mlsl_loss", float(np.asarray(loss).mean()))
+        except (TypeError, ValueError):  # non-numeric custom loss: skip
+            pass
+        from mlsl_tpu.core import stats as stats_mod
+
+        stall = float(stats_mod.FEED_COUNTERS["stall_ms"])
+        m.set("mlsl_input_stall_ms", max(0.0, stall - self._stall_ms_seen))
+        self._stall_ms_seen = stall
+        m.sample_families()
+        m.write_jsonl(records=m.sample())
+
+    def _record_grad_norm(self, m, grads) -> None:
+        """Telemetry grad-norm at the cadence tick (host grad paths only —
+        the fused/unsplit-overlap programs expose no gradient boundary).
+        One small jitted program, built lazily on first use."""
+        if self._gnorm_fn is None:
+            def sq(tree):
+                leaves = jax.tree.leaves(tree)
+                return sum(jnp.sum(jnp.square(g)) for g in leaves)
+
+            self._gnorm_fn = jax.jit(sq)
+        try:
+            m.set("mlsl_grad_norm",
+                  float(jnp.sqrt(self._gnorm_fn(grads))))
+        except (TypeError, ValueError):  # pragma: no cover - odd dtypes
+            pass
+
+    def _step_accum_impl(self, batches) -> jax.Array:
         """Gradient accumulation (the Caffe iter_size pattern the reference's
         per-layer sync was built around): k local fwd/bwd passes, ONE gradient
         sync + update. Each entry of ``batches`` is a shard_batch() result with
@@ -971,7 +1070,7 @@ class DataParallelTrainer:
             return loss
         return self._sync_and_update(grads, loss)
 
-    def step(self, batch) -> jax.Array:
+    def _step_impl(self, batch) -> jax.Array:
         self._step_no += 1
         if chaos._plans:
             self._chaos_state_sites()

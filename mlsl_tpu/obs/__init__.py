@@ -14,7 +14,18 @@ Env knobs: ``MLSL_TRACE`` (arm), ``MLSL_TRACE_DIR`` (output directory,
 default CWD), ``MLSL_TRACE_CAPACITY`` (ring size in events, default 65536).
 
 On a watchdog trip (``MLSLTimeoutError``) the flight recorder dumps the
-trailing window of spans to ``trace-crash-<ts>.json`` automatically.
+trailing window of spans to ``trace-crash-<ts>.json`` automatically (and,
+with ``MLSL_PROFILE_ON_TRIP=1``, a jax.profiler device trace next to it).
+
+The telemetry plane (docs/DESIGN.md "Telemetry plane") rides in the same
+package: ``obs.metrics`` (typed time-series registry, ``MLSL_METRICS=1``),
+``obs.serve`` (``/metrics`` + ``/healthz`` + ``/statusz`` on
+``MLSL_METRICS_PORT``), ``obs.straggler`` (cross-replica skew sentinel,
+``MLSL_STRAGGLER_SKEW``)::
+
+    MLSL_METRICS=1 MLSL_METRICS_PORT=9090 python train.py
+    curl localhost:9090/metrics   # Prometheus text
+    curl localhost:9090/healthz   # supervisor.status() as JSON
 """
 
 from mlsl_tpu.obs.tracer import (  # noqa: F401
@@ -34,6 +45,17 @@ from mlsl_tpu.obs.export import (  # noqa: F401
     to_trace_events,
     write_trace,
 )
+from mlsl_tpu.obs import metrics  # noqa: F401
+from mlsl_tpu.obs import serve  # noqa: F401
+from mlsl_tpu.obs import straggler  # noqa: F401
+from mlsl_tpu.obs.metrics import (  # noqa: F401
+    MetricsRegistry,
+    enable as enable_metrics,
+    disable as disable_metrics,
+    get_registry,
+)
+from mlsl_tpu.obs.serve import start_server, stop_server  # noqa: F401
+from mlsl_tpu.obs.straggler import StragglerSentinel  # noqa: F401
 
 __all__ = [
     "DEFAULT_CAPACITY",
@@ -49,4 +71,14 @@ __all__ = [
     "summarize",
     "to_trace_events",
     "write_trace",
+    "metrics",
+    "serve",
+    "straggler",
+    "MetricsRegistry",
+    "StragglerSentinel",
+    "enable_metrics",
+    "disable_metrics",
+    "get_registry",
+    "start_server",
+    "stop_server",
 ]

@@ -270,6 +270,34 @@ class FaultTolerantLoop:
                 "DataParallelTrainer only)", type(trainer).__name__,
             )
 
+    def _maybe_shed_straggler(self, trainer, step: int):
+        """Between-steps poll of the trainer's straggler sentinel
+        (obs/straggler.py): a confirmed straggler with ``MLSL_STRAGGLER_SHED``
+        armed is handed to the elastic coordinator as a synthetic device
+        loss (``ElasticCoordinator.shed``) — measurement closed into action.
+        A refused/failed shed (capacity budget, mapping failure) logs and
+        keeps the full world: shedding a slow replica is an optimization,
+        never worth availability. Returns the (possibly shrunk) trainer."""
+        strag = getattr(trainer, "straggler", None)
+        if strag is None or self.elastic is None:
+            return trainer
+        cand = strag.shed_candidate()
+        if cand is None:
+            return trainer
+        try:
+            new_trainer = self.elastic.shed(
+                trainer, self.make_trainer, replica=cand, step=step
+            )
+        except Exception as e:
+            log_warning(
+                "straggler shed of replica %s refused (%s: %s); continuing "
+                "on the full world", cand, type(e).__name__, e,
+            )
+            strag.clear_candidate()
+            return trainer
+        strag.clear_candidate()
+        return new_trainer
+
     def _abort(self, step: int, error: BaseException, why: str) -> None:
         """The ladder's last rung is exhausted: every retry and breaker
         fallback failed to absorb this fault, and ``why`` names the bound
@@ -283,19 +311,22 @@ class FaultTolerantLoop:
             cls = supervisor.classify(error)
             status = supervisor.status()
             states = {
-                # breaker-shaped entries only: 'analysis' (verdict-shaped)
-                # and 'elastic' (mesh-shaped, 'full'/'shrunk') have their
-                # own ANALYSIS/ELASTIC stats lines and are not breakers
+                # breaker-shaped entries only: 'analysis' (verdict-shaped),
+                # 'elastic' (mesh-shaped, 'full'/'shrunk') and 'straggler'
+                # ('off'/'watching'/'flagged') have their own stats lines
+                # and their own fields below — not breakers
                 name: st["state"]
                 for name, st in status.items()
-                if "state" in st and name != "elastic"
+                if "state" in st and name not in ("elastic", "straggler")
             }
             log_error(
                 "recovery ladder exhausted at step %d (%s; %d/%d recoveries "
-                "spent): %s: %s [class=%s] breakers=%s elastic=%s",
+                "spent): %s: %s [class=%s] breakers=%s elastic=%s "
+                "straggler=%s",
                 step, why, self.recoveries, self.max_total_recoveries,
                 type(error).__name__, error, cls.value, states,
                 status.get("elastic", {}).get("state", "?"),
+                status.get("straggler", {}).get("state", "?"),
             )
             if obs._tracer is not None:
                 from mlsl_tpu.obs import export as obs_export
@@ -352,6 +383,10 @@ class FaultTolerantLoop:
                         # raises MLSLIntegrityError -> the recovery path
                         # below, where restore prefers verified steps
                         sent.maybe_audit(trainer, step)
+                    # straggler shed poll (obs/straggler.py): a confirmed
+                    # slow replica becomes a synthetic DEVICE_LOSS through
+                    # the elastic coordinator; failures keep the full world
+                    trainer = self._maybe_shed_straggler(trainer, step)
                     if step % self.save_every == 0:
                         # inside the try: a device fault surfacing during the save's
                         # device read must take the recovery path too

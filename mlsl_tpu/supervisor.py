@@ -369,6 +369,15 @@ def status() -> Dict[str, dict]:
     from mlsl_tpu import elastic as _elastic
 
     out["elastic"] = _elastic.status()
+    # telemetry plane (mlsl_tpu.obs): the straggler sentinel's skew verdicts
+    # and the metrics registry summary — this dict IS the /healthz body
+    # (obs/serve.py), so everything here must stay JSON-serializable
+    # (round-trip pinned by tests/test_metrics.py)
+    from mlsl_tpu.obs import metrics as _metrics
+    from mlsl_tpu.obs import straggler as _straggler
+
+    out["straggler"] = _straggler.status()
+    out["metrics"] = _metrics.status()
     return out
 
 

@@ -56,6 +56,15 @@ KNOB_RANGES = {
     # keep gate+audit overhead under its budget on this machine; an
     # exported MLSL_SENTINEL_EVERY always wins (0 = audit off)
     "sentinel_every": 0,
+    # telemetry sampler cadence (obs/metrics.py): profiles may carry the
+    # cadence benchmarks/metrics_overhead_bench.py measured to keep the
+    # armed-path cost under its 2% budget on this machine; an exported
+    # MLSL_METRICS_EVERY always wins
+    "metrics_every": 1,
+    # straggler audit window (obs/straggler.py): an exported
+    # MLSL_STRAGGLER_EVERY always wins; floor = the judgeable minimum
+    # (MIN_WINDOW_SAMPLES — below it no replica is ever judged)
+    "straggler_every": 3,
 }
 
 #: string-valued knobs -> allowed values: same load-time validation contract

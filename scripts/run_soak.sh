@@ -7,11 +7,15 @@
 # fault-free run, and every retry / breaker trip / degraded dispatch /
 # recovery attributable in mlsl_stats.log and the exported Perfetto trace.
 # The fast bounded variant (test_soak_fast_bounded) runs inside tier-1.
-# Also runs the silent-corruption soak (ISSUE 9) and the elastic soak
+# Also runs the silent-corruption soak (ISSUE 9), the elastic soak
 # (ISSUE 14: seeded device.lost -> shrink -> grow with zero checkpoint
 # restores, loss-trajectory continuity vs an uninterrupted twin, and the
 # admission audit + every shrink/grow/admit attributable in mlsl_stats.log
-# and the Perfetto trace); their fast variants run inside tier-1 too.
+# and the Perfetto trace), and the straggler soak (ISSUE 15: a seeded
+# collective.dispatch:delay%p budget on one replica flagged by the
+# straggler sentinel within one audit interval, zero false positives on
+# the fault-free twin, and the shed handoff into the elastic coordinator
+# exercised under chaos); their fast variants run inside tier-1 too.
 set -euo pipefail
 cd "$(dirname "$0")/.."
 exec env JAX_PLATFORMS=cpu python -m pytest tests/test_soak.py -q -m soak \

@@ -1,13 +1,21 @@
 #!/usr/bin/env python
-"""Terminal viewer for mlsl_tpu trace files (obs/export.py output).
+"""Terminal viewer for mlsl_tpu trace files (obs/export.py output) and
+metrics JSONL streams (obs/metrics.py sampler output).
 
-Summarizes a Chrome/Perfetto trace_event JSON — per-(cat, name) span
-statistics, busiest tracks, slowest spans, instant counts — without leaving
-the terminal; load the same file in ui.perfetto.dev or chrome://tracing for
-the graphical timeline.
+Default mode summarizes a Chrome/Perfetto trace_event JSON — per-(cat, name)
+span statistics, busiest tracks, slowest spans, instant counts — without
+leaving the terminal; load the same file in ui.perfetto.dev or
+chrome://tracing for the graphical timeline.
+
+``--metrics`` mode summarizes a telemetry JSONL file (``mlsl_metrics.jsonl``,
+written on the MLSL_METRICS_EVERY cadence): per-series p50/p95/p99 tables —
+over the sampled values for gauges/counters, over the carried percentiles
+for histograms — plus a ``/statusz``-style one-screen health summary
+(step/wait latency, loss, straggler flags, counter-family totals).
 
 Usage:
     python scripts/trace_view.py trace-<ts>.json [--top N] [--tail N]
+    python scripts/trace_view.py --metrics mlsl_metrics.jsonl [--top N]
 
 ``--tail N`` additionally prints the last N events in time order (the
 flight-recorder reading mode: what happened right before the trip).
@@ -43,14 +51,87 @@ def tail_lines(doc: dict, n: int) -> str:
     return "\n".join(out)
 
 
+def metrics_report(path: str, top: int) -> int:
+    """--metrics mode: per-series percentile tables + health summary."""
+    from mlsl_tpu.obs import metrics as metrics_mod
+
+    with open(path) as f:
+        acc = metrics_mod.summarize_jsonl(f)
+    if not acc:
+        print(f"{path}: no metrics records")
+        return 1
+    n_lines = sum(e["n_samples"] for e in acc.values())
+    print(f"{path}: {len(acc)} series, {n_lines} records")
+    print()
+    print("per-series summary (gauges/counters over sampled values; "
+          "histograms carry their own percentiles):")
+    print(metrics_mod.render_summary(acc))
+
+    # the /statusz-style one-screen health summary: the handful of series an
+    # operator checks first, pulled out of the table above
+    def latest(name):
+        for (n, lk), ent in acc.items():
+            if n == name and not lk:
+                return ent
+        return None
+
+    print()
+    print("health summary:")
+    step = latest("mlsl_step_ms")
+    if step and isinstance(step.get("last"), dict):
+        s = step["last"]
+        print(f"  step_ms        p50={s.get('p50', 0):.3f} "
+              f"p95={s.get('p95', 0):.3f} p99={s.get('p99', 0):.3f} "
+              f"(n={s.get('n', 0)})")
+    waits = [ent for (n, _), ent in acc.items()
+             if n == "mlsl_dispatch_wait_ms"
+             and isinstance(ent.get("last"), dict)]
+    if waits:
+        p99 = max(float(e["last"].get("p99") or 0.0) for e in waits)
+        n = sum(int(e["last"].get("n") or 0) for e in waits)
+        print(f"  dispatch_wait  p99={p99:.3f} ms (n={n})")
+    loss = latest("mlsl_loss")
+    if loss and loss.get("last") is not None:
+        print(f"  loss           last={loss['last']:.6g} "
+              f"(min={loss.get('min', 0):.6g} max={loss.get('max', 0):.6g})")
+    stall = latest("mlsl_input_stall_ms")
+    if stall and stall.get("last") is not None:
+        print(f"  input_stall    last_window={stall['last']:.1f} ms "
+              f"max_window={stall.get('max', 0):.1f} ms")
+    flags = latest("mlsl_straggler_flags")
+    audits = latest("mlsl_straggler_audits")
+    if audits and audits.get("last"):
+        print(f"  straggler      audits={int(audits['last'])} "
+              f"flags={int(flags['last']) if flags and flags.get('last') else 0}")
+    busiest = sorted(
+        ((ent.get("last") or 0.0, name, lk) for (name, lk), ent in acc.items()
+         if ent["kind"] != "histogram" and isinstance(ent.get("last"), float)
+         and name.startswith("mlsl_")),
+        reverse=True,
+    )[:top]
+    if busiest:
+        print("  top counters  " + ", ".join(
+            f"{name}{'{' + lk + '}' if lk else ''}={int(v) if v == int(v) else round(v, 3)}"
+            for v, name, lk in busiest))
+    return 0
+
+
 def main() -> int:
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
-    ap.add_argument("trace", help="trace-*.json / trace-crash-*.json file")
+    ap.add_argument("trace",
+                    help="trace-*.json file, or a metrics JSONL with "
+                         "--metrics")
     ap.add_argument("--top", type=int, default=10,
                     help="rows in the busiest/slowest listings")
     ap.add_argument("--tail", type=int, default=0,
                     help="also print the last N events in time order")
+    ap.add_argument("--metrics", action="store_true",
+                    help="summarize a metrics JSONL (obs/metrics.py sampler "
+                         "output) instead of a trace")
     args = ap.parse_args()
+
+    if args.metrics:
+        return metrics_report(args.trace, args.top)
 
     from mlsl_tpu.obs.export import summarize
 
@@ -68,4 +149,7 @@ def main() -> int:
 
 
 if __name__ == "__main__":
-    sys.exit(main())
+    try:
+        sys.exit(main())
+    except BrokenPipeError:  # `trace_view ... | head` is a normal usage
+        sys.exit(0)

@@ -93,6 +93,17 @@ def _reset_supervisor():
 
     elastic.reset()
     stats.reset_elastic_counters()
+    # the telemetry plane is process-wide by design (registry/server/
+    # straggler survive Environment rebuilds); tests that arm it must not
+    # leave later tests sampling into a stale registry or a bound port
+    from mlsl_tpu.obs import metrics as obs_metrics
+    from mlsl_tpu.obs import serve as obs_serve
+    from mlsl_tpu.obs import straggler as obs_straggler
+
+    obs_serve.stop_server()
+    obs_metrics.disable()
+    obs_straggler.reset()
+    stats.reset_straggler_counters()
 
 
 @pytest.fixture(autouse=True)

@@ -1,0 +1,28 @@
+"""Known-bad fixture for lint rule A207 (tests/test_analysis.py): reaching
+into the metrics registry's series internals instead of using the
+record/observe API. Every mutation below must flag — a write that races the
+lock-free record paths can tear a histogram mid-scrape or wedge a sample
+ring, and the whole point of the ``_m*`` naming is that the linter can see
+it happening."""
+
+from mlsl_tpu.obs import metrics
+
+
+def hand_roll_a_counter():
+    reg = metrics.enable()
+    c = reg.counter("bad_total")
+    c._mval += 1                                   # A207: bypasses inc()
+    return c
+
+
+def tamper_with_a_histogram(h):
+    h._mcounts[0] += 1                             # A207: torn bucket count
+    h._msum = 0.0                                  # A207: sum/count skew
+
+
+def inject_a_series(reg, series):
+    reg._mseries[("rogue", ())] = series           # A207: unlocked insert
+
+
+def drop_samples(g):
+    g._msamples.clear()                            # A207: ring mutation

@@ -535,3 +535,61 @@ def _timed(fn):
     t0 = time.perf_counter()
     fn()
     return time.perf_counter() - t0
+
+
+# ---------------------------------------------------------------------------
+# A207: metrics-registry single-mutation discipline (ISSUE 15)
+# ---------------------------------------------------------------------------
+
+
+def test_fixture_metrics_direct_mutation_pinned():
+    """The known-bad fixture: every direct write to a series' _m* internals
+    flags A207 — _mval bypassing inc(), a torn _mcounts/_msum pair, an
+    unlocked _mseries insert, a cleared sample ring."""
+    path = os.path.join(FIXTURES, "metrics_direct_mutation.py")
+    rep = lint.lint_file(path, root=os.path.dirname(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__)))))
+    assert rep.codes() == ["MLSL-A207"], rep.format()
+    assert len(rep.errors) >= 4  # one per tampering pattern in the fixture
+    tampered = {d.message.split()[3] for d in rep.errors}
+    assert {"_mval", "_mcounts", "_msum", "_mseries", "_msamples"} <= tampered
+
+
+def test_a207_allows_the_registry_itself_and_api_users():
+    # the registry's own record paths are the allowed scopes
+    src = (
+        "class Counter:\n"
+        "    def inc(self, v):\n"
+        "        self._mval += v\n"
+        "    def record_sample(self, ts):\n"
+        "        self._msamples.append(ts)\n"
+        "    def _get(self, key, s):\n"
+        "        self._mseries[key] = s\n"
+    )
+    assert not lint.lint_source(src, "obs/metrics.py").diagnostics
+    # ...but the SAME writes outside obs/metrics.py flag
+    rep = lint.lint_source(src, "models/train.py")
+    assert rep.codes() == ["MLSL-A207"]
+    # API users never touch internals: clean anywhere
+    user = (
+        "def feed(m):\n"
+        "    m.inc('c')\n"
+        "    m.set('g', 2.0)\n"
+        "    m.observe('h', 1.5, algo='lax')\n"
+    )
+    assert not lint.lint_source(user, "models/train.py").diagnostics
+    # exporter-shaped READS of internals stay legal outside record scopes
+    reader = (
+        "def to_prometheus(self):\n"
+        "    return sum(self._mcounts)\n"
+    )
+    assert not lint.lint_source(reader, "obs/metrics.py").diagnostics
+
+
+def test_a207_pragma_and_code_registered():
+    src = (
+        "def hack(c):\n"
+        "    c._mval += 1  # mlsl-lint: disable=A207 -- test oracle\n"
+    )
+    assert not lint.lint_source(src, "x.py").diagnostics
+    assert "MLSL-A207" in diagnostics.CODES
