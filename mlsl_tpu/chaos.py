@@ -102,6 +102,18 @@ SITES: Dict[str, str] = {
     "device.lost": "device/slice loss at collective dispatch "
                    "(comm/collectives.py) and at elastic re-admission "
                    "(elastic.py grow; silent corrupts the rejoining copy)",
+    # Pod-control-plane faults (control/plane.py): fired on the SENDER's
+    # heartbeat/notice paths — error = frame lost, delay = late frame,
+    # hang = wedged sender. A lost heartbeat feeds the PEER's miss
+    # accounting (which is the machinery under test); a lost/delayed
+    # notice degrades to retry-next-tick, never to a lost drain.
+    "control.heartbeat": "heartbeat fan-out tick (control/plane.py): one "
+                         "inject per peer send; error drops the frame, "
+                         "delay/hang stall the sender into a miss",
+    "control.notice": "preemption-notice delivery and drain-order "
+                      "broadcast (control/plane.py): error/delay/hang "
+                      "model a lost notice, a late drain order, and a "
+                      "partitioned leader",
 }
 
 KINDS = ("error", "delay", "hang", "bitrot", "silent")

@@ -53,6 +53,7 @@ _ENV_FIELDS = {
     "MLSL_SENTINEL_EVERY": "sentinel_every",
     "MLSL_METRICS_EVERY": "metrics_every",
     "MLSL_STRAGGLER_EVERY": "straggler_every",
+    "MLSL_HEARTBEAT_MISSES": "heartbeat_misses",
 }
 
 
@@ -327,6 +328,40 @@ class Config:
     # core/stats (recorded here for discoverability, like chaos_spec).
     profile_on_trip: bool = False   # MLSL_PROFILE_ON_TRIP
 
+    # --- pod control plane (mlsl_tpu.control; docs/TUNING.md §20) ---
+    # Heartbeat cadence on the control channel (stdlib TCP, separate from
+    # the JAX collective fabric). Detection latency is
+    # interval * misses; LAN/localhost pods can run well under a second.
+    heartbeat_interval_s: float = 2.0   # MLSL_HEARTBEAT_INTERVAL_S
+    # Consecutive missed intervals before a peer is declared locally dead
+    # and proposed for a loss-epoch commit. Tunable via a tuner profile
+    # (tuner.KNOB_RANGES: false-positive resharding vs detection latency);
+    # exported env wins.
+    heartbeat_misses: int = 3           # MLSL_HEARTBEAT_MISSES
+    # Boot grace: silence from a never-heard peer is tolerated this long
+    # (it may still be importing jax / compiling) before miss accounting
+    # treats it like any other death.
+    heartbeat_grace_s: float = 30.0     # MLSL_HEARTBEAT_GRACE_S
+    # Cluster-scheduler hook (ROADMAP #2a): a scheduler that cannot
+    # deliver SIGTERM writes this file; its appearance is a preemption
+    # notice for this host, coordinated pod-wide like the signal.
+    preemption_file: str = ""           # MLSL_PREEMPTION_FILE
+    # Control-world bootstrap. Explicit form: "host:port,host:port,..."
+    # (rank-ordered). Localhost shorthand for the CPU pod sim:
+    # control_port (base) + control_world (N members, consecutive ports).
+    # Both empty/0 = this process is not a pod member (the default — no
+    # socket is ever opened).
+    control_addrs: str = ""             # MLSL_CONTROL_ADDRS
+    control_port: int = 0               # MLSL_CONTROL_PORT
+    control_world: int = 0              # MLSL_CONTROL_WORLD
+    control_rank: int = -1              # MLSL_CONTROL_RANK
+    # jax.distributed.initialize retry budget (the gloo TCP preamble race,
+    # KNOWN_FAILURES.md): attempts beyond the first, exponential backoff
+    # from dist_init_backoff_s. Control-channel commit sends reuse the
+    # same retry idiom.
+    dist_init_retries: int = 3          # MLSL_DIST_INIT_RETRIES
+    dist_init_backoff_s: float = 0.5    # MLSL_DIST_INIT_BACKOFF_S
+
     # --- observability tier (mlsl_tpu.obs span tracer) ---
     # Kept for discoverability/printing only, like chaos_spec: the tracer is
     # process-wide (armed at import from MLSL_TRACE, or obs.enable()) and the
@@ -553,6 +588,56 @@ class Config:
             "MLSL_STRAGGLER_SUSTAIN must be >= 1 (got %d)",
             self.straggler_sustain,
         )
+        mlsl_assert(
+            self.heartbeat_interval_s > 0,
+            "MLSL_HEARTBEAT_INTERVAL_S must be > 0 (got %r)",
+            self.heartbeat_interval_s,
+        )
+        mlsl_assert(
+            self.heartbeat_misses >= 1,
+            "MLSL_HEARTBEAT_MISSES must be >= 1 (a zero miss budget would "
+            "declare every peer dead on the first tick; got %d)",
+            self.heartbeat_misses,
+        )
+        mlsl_assert(
+            self.heartbeat_grace_s >= 0,
+            "MLSL_HEARTBEAT_GRACE_S must be >= 0 (got %r)",
+            self.heartbeat_grace_s,
+        )
+        mlsl_assert(
+            0 <= self.control_port <= 65535,
+            "MLSL_CONTROL_PORT must be in [0, 65535] (0 = off; got %d)",
+            self.control_port,
+        )
+        mlsl_assert(
+            self.control_world >= 0,
+            "MLSL_CONTROL_WORLD must be >= 0 (got %d)", self.control_world,
+        )
+        mlsl_assert(
+            not (self.control_addrs and self.control_world),
+            "MLSL_CONTROL_ADDRS and MLSL_CONTROL_PORT/WORLD are mutually "
+            "exclusive bootstrap forms — set one",
+        )
+        if self.control_addrs or self.control_world:
+            world = (
+                len(self.control_addrs.split(","))
+                if self.control_addrs else self.control_world
+            )
+            mlsl_assert(
+                0 <= self.control_rank < world,
+                "MLSL_CONTROL_RANK must name this process's slot in the "
+                "%d-member control world (got %d)", world, self.control_rank,
+            )
+        mlsl_assert(
+            self.dist_init_retries >= 0,
+            "MLSL_DIST_INIT_RETRIES must be >= 0 (got %d)",
+            self.dist_init_retries,
+        )
+        mlsl_assert(
+            self.dist_init_backoff_s >= 0,
+            "MLSL_DIST_INIT_BACKOFF_S must be >= 0 (got %r)",
+            self.dist_init_backoff_s,
+        )
 
     @staticmethod
     def from_env() -> "Config":
@@ -653,6 +738,30 @@ class Config:
         c.straggler_shed = _env_bool("MLSL_STRAGGLER_SHED", c.straggler_shed)
         c.profile_on_trip = _env_bool(
             "MLSL_PROFILE_ON_TRIP", c.profile_on_trip
+        )
+        c.heartbeat_interval_s = _env_float(
+            "MLSL_HEARTBEAT_INTERVAL_S", c.heartbeat_interval_s
+        )
+        c.heartbeat_misses = _env_int(
+            "MLSL_HEARTBEAT_MISSES", c.heartbeat_misses
+        )
+        c.heartbeat_grace_s = _env_float(
+            "MLSL_HEARTBEAT_GRACE_S", c.heartbeat_grace_s
+        )
+        c.preemption_file = os.environ.get(
+            "MLSL_PREEMPTION_FILE", c.preemption_file
+        )
+        c.control_addrs = os.environ.get(
+            "MLSL_CONTROL_ADDRS", c.control_addrs
+        )
+        c.control_port = _env_int("MLSL_CONTROL_PORT", c.control_port)
+        c.control_world = _env_int("MLSL_CONTROL_WORLD", c.control_world)
+        c.control_rank = _env_int("MLSL_CONTROL_RANK", c.control_rank)
+        c.dist_init_retries = _env_int(
+            "MLSL_DIST_INIT_RETRIES", c.dist_init_retries
+        )
+        c.dist_init_backoff_s = _env_float(
+            "MLSL_DIST_INIT_BACKOFF_S", c.dist_init_backoff_s
         )
         c.verify = _env_bool("MLSL_VERIFY", c.verify)
         c.verify_severity = os.environ.get(

@@ -73,10 +73,8 @@ class Environment:
         if coordinator_address is not None and not Environment._jax_distributed_up:
             # jax.distributed.initialize may only run once per process; init/finalize
             # cycles of the Environment must not re-run it.
-            jax.distributed.initialize(
-                coordinator_address=coordinator_address,
-                num_processes=num_processes,
-                process_id=process_id,
+            self._distributed_init_with_retry(
+                coordinator_address, num_processes, process_id
             )
             Environment._jax_distributed_up = True
         self.config = Config.from_env()
@@ -133,6 +131,17 @@ class Environment:
             from mlsl_tpu.obs import serve as obs_serve
 
             obs_serve.start_server(self.config.metrics_port)
+        # pod control plane (mlsl_tpu.control): when the config names a
+        # control world, join it — membership/heartbeat over a stdlib TCP
+        # channel separate from the JAX collective fabric. Process-wide and
+        # idempotent like the telemetry plane: pod membership must survive
+        # an Environment rebuild mid-recovery.
+        if self.config.control_addrs or (
+            self.config.control_port and self.config.control_world
+        ):
+            from mlsl_tpu import control as control_mod
+
+            control_mod.ensure_started(self.config)
         self.dispatcher = Dispatcher(self.config)
         self._initialized = True
         self._init_pid = os.getpid()
@@ -151,6 +160,53 @@ class Environment:
                 raise
         self._dump_config()
         return self
+
+    @staticmethod
+    def _distributed_init_with_retry(
+        coordinator_address: str,
+        num_processes: Optional[int],
+        process_id: Optional[int],
+    ) -> None:
+        """jax.distributed.initialize with MLSL_DIST_INIT_RETRIES backoff.
+
+        The known gloo TCP preamble race (KNOWN_FAILURES.md) and plain
+        coordinator-not-up-yet races surface here as RuntimeError/OSError
+        during the coordination-service handshake. Retrying INSIDE init —
+        with a best-effort shutdown between attempts so the client can
+        rebind — is the library-side fix that let tests/test_multiprocess.py
+        drop its test-side retry-on-SIGABRT wrapper. Only the handshake is
+        retryable; a failure after the world is up propagates (that is the
+        control plane's job, not init's)."""
+        import time as _time
+
+        from mlsl_tpu.config import _env_float, _env_int
+        from mlsl_tpu.log import log_warning
+
+        retries = max(0, _env_int("MLSL_DIST_INIT_RETRIES", 3))
+        backoff_s = max(0.0, _env_float("MLSL_DIST_INIT_BACKOFF_S", 0.5))
+        for attempt in range(retries + 1):
+            if attempt:
+                _time.sleep(backoff_s * (2 ** (attempt - 1)))
+            try:
+                jax.distributed.initialize(
+                    coordinator_address=coordinator_address,
+                    num_processes=num_processes,
+                    process_id=process_id,
+                )
+                return
+            except (RuntimeError, OSError) as e:
+                if attempt >= retries:
+                    raise
+                log_warning(
+                    "jax.distributed.initialize failed (attempt %d/%d, "
+                    "retrying in %.2gs): %s: %s",
+                    attempt + 1, retries + 1,
+                    backoff_s * (2 ** attempt), type(e).__name__, e,
+                )
+                try:
+                    jax.distributed.shutdown()
+                except Exception:
+                    pass  # half-initialized client: nothing to unwind
 
     _jax_cache_defaults = None  # knob values before our first mutation
 

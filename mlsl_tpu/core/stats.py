@@ -391,6 +391,49 @@ def reset_straggler_counters() -> None:
         STRAGGLER_COUNTERS[k] = 0
 
 
+# Pod-control-plane accounting (mlsl_tpu.control): heartbeat traffic,
+# membership detection/commit, election, and drain coordination —
+# process-wide like the other families (pod membership outlives every
+# Environment rebuild). Heartbeat traffic is the hot path (every interval x
+# every peer) and only bumps counters; everything else is a cold membership
+# event and appends an immediate CONTROL line, the DEGRADE transition
+# contract — the acceptance story ("who noticed the death, who committed
+# the epoch, who ordered the drain") must be readable from mlsl_stats.log.
+CONTROL_COUNTERS: Dict[str, int] = {
+    "heartbeats_sent": 0,   # frames sent (hot: counter only)
+    "heartbeats_recv": 0,   # frames received (hot: counter only)
+    "send_failures": 0,     # control-channel sends that failed (hot)
+    "deaths_detected": 0,   # peers locally declared dead (miss budget)
+    "epochs_committed": 0,  # membership/drain epochs applied (fenced)
+    "stale_rejected": 0,    # stale-epoch / deposed-leader orders rejected
+    "elections": 0,         # leadership changes observed
+    "notices": 0,           # preemption notices submitted locally
+    "drain_decisions": 0,   # pod-wide drain verdicts made (leader only)
+    "drains_executed": 0,   # local drain executions completed
+    "evicted": 0,           # this rank declared dead by the pod (partition)
+}
+
+_CONTROL_HOT = ("heartbeats_sent", "heartbeats_recv", "send_failures")
+
+
+def record_control(event: str, detail: str = "", line: bool = True,
+                   count: bool = True) -> None:
+    """One control-plane event (see CONTROL_COUNTERS keys)."""
+    if count:
+        CONTROL_COUNTERS[event] += 1
+    if line and event not in _CONTROL_HOT:
+        try:
+            with open(stats_path(), "a") as f:
+                f.write(f"{'CONTROL':<16} {event.upper():<16} {detail}\n")
+        except OSError:
+            pass
+
+
+def reset_control_counters() -> None:
+    for k in CONTROL_COUNTERS:
+        CONTROL_COUNTERS[k] = 0
+
+
 def record_comm_retry(phase: str, request: str, error: BaseException,
                       attempt: int, delay_s: float) -> None:
     """One rung-2 retry of a transient dispatch/wait failure (called by
@@ -961,6 +1004,22 @@ class Statistics:
                 f"sheds {gc['sheds']} "
                 f"shed_fallbacks {gc['shed_fallbacks']}"
             )
+        cc = CONTROL_COUNTERS
+        if any(cc.values()):
+            # the pod story: detection -> one fenced epoch -> drain — one
+            # grep ('CONTROL') answers "did the pod agree on what happened"
+            lines.append(
+                f"{'CONTROL':<16} {'POD':<8} "
+                f"hb_sent {cc['heartbeats_sent']} "
+                f"hb_recv {cc['heartbeats_recv']} "
+                f"send_failures {cc['send_failures']} "
+                f"deaths {cc['deaths_detected']} "
+                f"epochs {cc['epochs_committed']} "
+                f"stale_rejected {cc['stale_rejected']} "
+                f"elections {cc['elections']} notices {cc['notices']} "
+                f"drain_decisions {cc['drain_decisions']} "
+                f"drains {cc['drains_executed']} evicted {cc['evicted']}"
+            )
         kc = CHKP_COUNTERS
         if any(kc.values()):
             lines.append(
@@ -990,6 +1049,11 @@ class Statistics:
                      # straggler's healthy vocabulary is 'off'/'watching'
                      # (the elastic lesson): list only when flagged
                      else st["state"] == "flagged" if name == "straggler"
+                     # control's healthy vocabulary is 'off'/'member'/
+                     # 'leader': list only when the pod actually lost
+                     # members (or this rank was evicted by it)
+                     else bool(st.get("dead")) or st.get("evicted")
+                     if name == "control"
                      else st.get("trips") or st["state"] != supervisor.CLOSED)
             )
             fb = " ".join(

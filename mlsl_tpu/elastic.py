@@ -384,8 +384,20 @@ class ElasticCoordinator:
 
     def maybe_grow(self, trainer, make_trainer, step: int):
         """Between-steps poll: grow back to the full world when shrunk and
-        capacity has returned (announce_return or the grow_after timer)."""
+        capacity has returned (announce_return or the grow_after timer).
+
+        In a pod, grow re-admission is a LEADER decision (mlsl_tpu.control):
+        the coordinator's single-controller assumptions — active-world
+        registry, capacity budget, admission audit — are epoch-fenced
+        behind the elected leader, so a deposed leader polling here cannot
+        originate a stale re-admission. Defense in depth with the loop-side
+        gate in resilience.py: both must agree this process decides."""
         if _active is None:
+            return trainer
+        from mlsl_tpu import control as control_mod
+
+        plane = control_mod.get_active()
+        if plane is not None and not plane.may_decide():
             return trainer
         due = self._pending_return or (
             self._return_due is not None and step >= self._return_due

@@ -371,7 +371,13 @@ class DataParallelTrainer:
                     sustain=cfg.straggler_sustain,
                     shed=cfg.straggler_shed,
                 )
-        self._replica_id = jax.process_index()
+        # straggler attribution: the pod rank when a control plane is armed
+        # (pod-wide peer medians need pod-unique replica ids — remote ranks'
+        # samples arrive over heartbeat frames under THEIR rank), else
+        # jax.process_index() as before
+        from mlsl_tpu import control as control_mod
+
+        self._replica_id = control_mod.replica_id(jax.process_index())
         self._gnorm_fn = None       # lazy telemetry grad-norm program
         self._stall_ms_seen = 0.0   # FEED stall total at the last sample
         # force_graph_path bypasses the fused shortcut so the per-layer

@@ -47,11 +47,19 @@ DEFAULT_ADDR = ""  # all interfaces: the scrape surface is for remote collectors
 
 
 def healthz_doc() -> dict:
-    """The /healthz body: ``supervisor.status()`` verbatim. Lazy import —
-    supervisor sits above obs in the import graph."""
+    """The /healthz body: ``supervisor.status()`` verbatim (lazy import —
+    supervisor sits above obs in the import graph), plus — on the pod
+    LEADER only — a ``pod`` key merging every member's last pushed status
+    snapshot and heartbeat age (mlsl_tpu.control): one scrape of the leader
+    answers for the whole pod, which is the point of electing one."""
+    from mlsl_tpu import control as control_mod
     from mlsl_tpu import supervisor
 
-    return supervisor.status()
+    doc = supervisor.status()
+    plane = control_mod.get_active()
+    if plane is not None and plane.is_leader():
+        doc["pod"] = plane.pod_status()
+    return doc
 
 
 def statusz_text() -> str:
@@ -67,14 +75,22 @@ def statusz_text() -> str:
         breakers = ", ".join(
             f"{name}:{st['state']}"
             for name, st in sorted(doc.items())
-            # breaker-shaped entries only: elastic is on the world line and
-            # straggler has its own line below — listing 'watching' here
-            # would read a healthy sentinel as a degraded subsystem
+            # breaker-shaped entries only: elastic is on the world line,
+            # straggler and control have their own lines below — listing
+            # 'watching'/'member' here would read a healthy sentinel as a
+            # degraded subsystem
             if isinstance(st, dict) and "state" in st
-            and name not in ("elastic", "straggler")
+            and name not in ("elastic", "straggler", "control")
         )
         if breakers:
             lines.append(f"subsystems: {breakers}")
+        ctl = doc.get("control", {})
+        if ctl.get("state", "off") != "off":
+            lines.append(
+                f"pod: {ctl.get('state')} rank={ctl.get('rank')} "
+                f"epoch={ctl.get('epoch')} leader={ctl.get('leader')} "
+                f"alive={ctl.get('alive')} dead={ctl.get('dead')}"
+            )
         strag = doc.get("straggler", {})
         if strag.get("state", "off") != "off":
             lines.append(

@@ -104,6 +104,7 @@ class StragglerSentinel:
         self._win_step: Dict[int, List[float]] = {}
         self._win_wait: Dict[int, List[float]] = {}
         self._suspect_streak: Dict[int, int] = {}
+        self._remote_replicas: set = set()
         self._audits = 0
         self._flagged: Dict[int, dict] = {}
         self._candidate: Optional[int] = None
@@ -126,6 +127,19 @@ class StragglerSentinel:
             m.observe("mlsl_replica_step_ms", step_ms, replica=replica)
             if wait_ms is not None:
                 m.observe("mlsl_replica_wait_ms", wait_ms, replica=replica)
+
+    def observe_remote(self, replica: int, samples) -> None:
+        """Feed a REMOTE rank's step times (delivered over control-plane
+        heartbeat frames — ROADMAP #2b closed: the multi-host plumb only
+        had to deliver observations). Runs on the control listener thread:
+        host-side list appends under the same lock as :meth:`observe`, no
+        device work (the A202 contract). Remote ranks are tracked so
+        /healthz shows the audit baseline truly spans the pod."""
+        replica = int(replica)
+        with self._lock:
+            self._remote_replicas.add(replica)
+        for ms in samples:
+            self.observe(replica, float(ms))
 
     # -- compare -----------------------------------------------------------
 
@@ -273,6 +287,7 @@ class StragglerSentinel:
                 "flagged": {str(r): dict(v)
                             for r, v in self._flagged.items()},
                 "shed_candidate": self._candidate,
+                "remote_replicas": sorted(self._remote_replicas),
             }
 
 

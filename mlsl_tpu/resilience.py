@@ -15,6 +15,7 @@ from __future__ import annotations
 import contextlib
 import signal
 import threading
+import time as _time
 from typing import Callable, Optional
 
 import jax
@@ -312,12 +313,14 @@ class FaultTolerantLoop:
             status = supervisor.status()
             states = {
                 # breaker-shaped entries only: 'analysis' (verdict-shaped),
-                # 'elastic' (mesh-shaped, 'full'/'shrunk') and 'straggler'
-                # ('off'/'watching'/'flagged') have their own stats lines
-                # and their own fields below — not breakers
+                # 'elastic' (mesh-shaped, 'full'/'shrunk'), 'straggler'
+                # ('off'/'watching'/'flagged') and 'control' (membership-
+                # shaped, 'off'/'member'/'leader') have their own stats
+                # lines and their own fields below — not breakers
                 name: st["state"]
                 for name, st in status.items()
-                if "state" in st and name not in ("elastic", "straggler")
+                if "state" in st
+                and name not in ("elastic", "straggler", "control")
             }
             log_error(
                 "recovery ladder exhausted at step %d (%s; %d/%d recoveries "
@@ -359,11 +362,26 @@ class FaultTolerantLoop:
         reported = step - 1  # on_step fires once per step, replays stay silent
         last_saved = restored
         self.preempted = False
+        # pod control plane (mlsl_tpu.control): committed membership losses
+        # surface HERE, on the dispatch thread, as the device-loss error the
+        # reshard rung below absorbs — the control threads only queue (the
+        # A202 contract). Pod-level elastic decisions (grow re-admission,
+        # straggler shed) are re-homed behind the elected leader; followers
+        # apply committed epochs instead of originating them.
+        from mlsl_tpu import control as control_mod
+
+        plane = control_mod.get_active()
         guard = PreemptionGuard() if self.handle_preemption else None
         with guard if guard is not None else _NULL_GUARD:
             while step < steps:
                 try:
-                    if self.elastic is not None:
+                    if plane is not None:
+                        pod_fault = plane.take_loss()
+                        if pod_fault is not None:
+                            raise pod_fault
+                    if self.elastic is not None and (
+                        plane is None or plane.may_decide()
+                    ):
                         # between-steps growth poll: returned capacity is
                         # re-admitted (through the fingerprint admission
                         # audit) before the step runs; failures route
@@ -375,8 +393,16 @@ class FaultTolerantLoop:
                         self.fault_hook(
                             step, attempts if step == failed_step else 0
                         )
+                    _t0 = _time.monotonic()
                     loss = trainer.step(batch_fn(trainer, step))
                     jax.block_until_ready(trainer.params)
+                    if plane is not None:
+                        # publish this member's health + step clock for the
+                        # next heartbeat frame (host-read scalars only)
+                        plane.push_status(
+                            supervisor.status(), step=step,
+                            step_ms=(_time.monotonic() - _t0) * 1e3,
+                        )
                     sent = getattr(trainer, "sentinel", None)
                     if sent is not None:
                         # cadence audit (MLSL_SENTINEL_EVERY): divergence
@@ -385,8 +411,10 @@ class FaultTolerantLoop:
                         sent.maybe_audit(trainer, step)
                     # straggler shed poll (obs/straggler.py): a confirmed
                     # slow replica becomes a synthetic DEVICE_LOSS through
-                    # the elastic coordinator; failures keep the full world
-                    trainer = self._maybe_shed_straggler(trainer, step)
+                    # the elastic coordinator; failures keep the full world.
+                    # Leader-only in a pod: a shed is a pod-level decision.
+                    if plane is None or plane.may_decide():
+                        trainer = self._maybe_shed_straggler(trainer, step)
                     if step % self.save_every == 0:
                         # inside the try: a device fault surfacing during the save's
                         # device read must take the recovery path too
@@ -446,7 +474,25 @@ class FaultTolerantLoop:
                 if on_step is not None and step > reported:
                     on_step(step, loss)
                     reported = step
-                if guard is not None and guard.triggered:
+                # pod drain decisions arrive out-of-band (the leader
+                # broadcasts one verdict per noticed rank): consume any
+                # pending one; a shrink aimed at ANOTHER rank is the
+                # survivors' business (their loss event reshards the mesh)
+                drain = plane.take_drain() if plane is not None else None
+                if (
+                    guard is not None and guard.triggered
+                    and drain is None and plane is not None
+                ):
+                    # coordinated drain: submit the SIGTERM as a structured
+                    # notice and wait (bounded) for the pod's ONE decision;
+                    # a timeout (partitioned leader) falls back to the
+                    # local drain below rather than hanging the grace window
+                    drain = plane.coordinate_preemption("sigterm")
+                if (guard is not None and guard.triggered) or (
+                    drain is not None
+                    and (drain["mode"] == "save"
+                         or drain["rank"] == plane.rank)
+                ):
                     # drain in-flight saves and leave a final resume point; a
                     # failure here must not abort the graceful exit — the last
                     # cadence checkpoint remains the resume point
@@ -477,6 +523,11 @@ class FaultTolerantLoop:
                             "preemption drain failed (%s: %s); resume point is "
                             "the last committed checkpoint",
                             type(e).__name__, e,
+                        )
+                    if plane is not None:
+                        plane.record_drain_executed(
+                            step, drain["mode"] if drain is not None
+                            else "local",
                         )
                     break
                 step += 1

@@ -378,6 +378,13 @@ def status() -> Dict[str, dict]:
 
     out["straggler"] = _straggler.status()
     out["metrics"] = _metrics.status()
+    # pod control plane (mlsl_tpu.control): membership epoch, leadership,
+    # survivor set and heartbeat ages — {"state": "off"} when this process
+    # is not a pod member. Same JSON-serializability contract as above:
+    # this dict rides heartbeat frames AND the /healthz body.
+    from mlsl_tpu import control as _control
+
+    out["control"] = _control.status()
     return out
 
 

@@ -65,6 +65,12 @@ KNOB_RANGES = {
     # MLSL_STRAGGLER_EVERY always wins; floor = the judgeable minimum
     # (MIN_WINDOW_SAMPLES — below it no replica is ever judged)
     "straggler_every": 3,
+    # heartbeat miss budget (control/plane.py): profiles may carry the
+    # consecutive-miss count measured to cover this pod's worst GC/compile
+    # pause without false-declaring a host dead (each extra miss delays
+    # real-failure detection by one MLSL_HEARTBEAT_INTERVAL_S); an exported
+    # MLSL_HEARTBEAT_MISSES always wins
+    "heartbeat_misses": 1,
 }
 
 #: string-valued knobs -> allowed values: same load-time validation contract

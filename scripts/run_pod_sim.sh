@@ -1,0 +1,17 @@
+#!/usr/bin/env bash
+# Run the multi-process pod lifecycle harness standalone (like run_soak.sh),
+# so CI can wire it as its own job separately from tier-1. Each worker is a
+# real OS process (`python -m mlsl_tpu.control.sim`) with its own control
+# plane over localhost TCP; the suite SIGKILLs members mid-run and asserts:
+# detection within the heartbeat miss budget, exactly ONE epoch-fenced
+# membership commit per fault (identical on every survivor), zero checkpoint
+# restores, leadership surviving the death of the leader itself, the
+# leader's merged /healthz scraped over real HTTP showing the shrunken
+# world, and a SIGTERM becoming ONE coordinated pod drain attributable in
+# mlsl_stats.log. Includes the slow sequential-kill soak
+# (test_pod_soak_sequential_kills); the fast variants also run inside
+# tier-1 via the `pod` marker.
+set -euo pipefail
+cd "$(dirname "$0")/.."
+exec env JAX_PLATFORMS=cpu python -m pytest tests/test_pod.py -q -m pod \
+    -p no:cacheprovider "$@"

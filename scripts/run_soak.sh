@@ -16,7 +16,10 @@
 # straggler sentinel within one audit interval, zero false positives on
 # the fault-free twin, and the shed handoff into the elastic coordinator
 # exercised under chaos); their fast variants run inside tier-1 too.
+# The multi-process pod lifecycle soak (ISSUE 16: real SIGKILLs over OS
+# processes, epoch-fenced reshards, coordinated SIGTERM drain) rides along
+# via tests/test_pod.py — also runnable alone with scripts/run_pod_sim.sh.
 set -euo pipefail
 cd "$(dirname "$0")/.."
-exec env JAX_PLATFORMS=cpu python -m pytest tests/test_soak.py -q -m soak \
-    -p no:cacheprovider "$@"
+exec env JAX_PLATFORMS=cpu python -m pytest tests/test_soak.py tests/test_pod.py \
+    -q -m 'soak or pod' -p no:cacheprovider "$@"

@@ -104,6 +104,13 @@ def _reset_supervisor():
     obs_metrics.disable()
     obs_straggler.reset()
     stats.reset_straggler_counters()
+    # the pod control plane is process-wide by design (membership outlives
+    # Environment rebuilds); tests that arm one must not leave later tests
+    # heartbeating into dead sockets
+    from mlsl_tpu import control
+
+    control.reset()
+    stats.reset_control_counters()
 
 
 @pytest.fixture(autouse=True)

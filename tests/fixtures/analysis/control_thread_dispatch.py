@@ -1,0 +1,36 @@
+"""Known-bad fixture for lint rule A202 (tests/test_analysis.py): device
+dispatch reachable from a control-plane background thread. The shipped
+control plane (mlsl_tpu/control/plane.py) passes A202 BY CONSTRUCTION —
+heartbeat frames carry host-read scalars the training thread pushed, and
+committed losses surface on the dispatch thread via take_loss(). This
+module is the shape that contract forbids: a heartbeat loop that "helpfully"
+reads device state itself, so the frame build blocks on an in-flight
+collective from a thread the supervisor cannot see — exactly the hang the
+rule exists to catch."""
+
+import threading
+
+import jax
+
+
+class ChattyControlPlane:
+    def start(self):
+        self._hb = threading.Thread(target=self._hb_loop, daemon=True)
+        self._hb.start()
+
+    def _hb_loop(self):
+        while True:
+            self._send_frame()
+
+    def _send_frame(self):
+        frame = self._build_payload()
+        self._post(frame)
+
+    def _build_payload(self):
+        # A202: device read on the heartbeat thread — the loss lives on
+        # device, and materializing it here synchronizes with dispatch
+        jax.block_until_ready(self.last_loss)
+        return {"loss": float(self.last_loss)}
+
+    def _post(self, frame):
+        return frame

@@ -593,3 +593,33 @@ def test_a207_pragma_and_code_registered():
     )
     assert not lint.lint_source(src, "x.py").diagnostics
     assert "MLSL-A207" in diagnostics.CODES
+
+
+# ---------------------------------------------------------------------------
+# A202: the control plane's threading contract (ISSUE 16)
+# ---------------------------------------------------------------------------
+
+
+def test_fixture_control_thread_dispatch_pinned():
+    """The known-bad fixture: a control-plane heartbeat loop whose frame
+    build reaches device dispatch (block_until_ready three calls deep from
+    the Thread target) flags A202. The shipped plane passes by construction
+    — heartbeat frames serialize host-read scalars pushed by the training
+    thread — and this fixture pins the violation that contract forbids."""
+    path = os.path.join(FIXTURES, "control_thread_dispatch.py")
+    rep = lint.lint_file(path, root=os.path.dirname(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__)))))
+    assert rep.codes() == ["MLSL-A202"], rep.format()
+    assert "_hb_loop" in rep.errors[0].message
+
+
+def test_shipped_control_plane_is_a202_clean():
+    """The positive half, pinned directly (the clean-tree gate covers it
+    too, but a control-plane regression should fail HERE with a name that
+    says what broke): both control modules lint clean."""
+    import mlsl_tpu
+
+    pkg = os.path.dirname(os.path.abspath(mlsl_tpu.__file__))
+    for mod in ("plane.py", "channel.py"):
+        rep = lint.lint_file(os.path.join(pkg, "control", mod))
+        assert not rep.diagnostics, rep.format()

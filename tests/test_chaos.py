@@ -159,9 +159,17 @@ SITE_CONFIGS = {
     "device.lost": ("plain", 3),
 }
 
+# The pod-control sites fire on the control plane's heartbeat thread, not
+# inside a training step, so the loop-recovery matrix above cannot exercise
+# them: their error/delay/hang behaviors (dropped frames within the miss
+# budget, a stalled sender detected as death, a lost notice degrading to
+# retry) are pinned by the chaos tests in tests/test_control.py.
+CONTROL_SITES = {"control.heartbeat", "control.notice"}
+
 
 def test_matrix_covers_every_registered_site():
-    assert set(SITE_CONFIGS) == set(chaos.SITES)
+    assert set(SITE_CONFIGS) | CONTROL_SITES == set(chaos.SITES)
+    assert not (set(SITE_CONFIGS) & CONTROL_SITES)
 
 
 @pytest.mark.slow

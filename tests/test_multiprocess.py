@@ -142,35 +142,22 @@ def _run_world(tmp_path, tag):
     return procs, outs
 
 
-def _gloo_transport_race(procs, outs):
-    """The known pre-existing gloo TCP flake (KNOWN_FAILURES.md): a worker
-    dies on `gloo::EnforceNotMet ... op.preamble.length <= op.nbytes` (a
-    transport-level race in gloo's TCP pair, load-dependent, observed at
-    pre-PR-6 HEAD ~2-in-5 under load) and the surviving worker aborts ~100s
-    later on the coordination-service heartbeat timeout. Both land as
-    SIGABRT (-6). Only this infrastructure signature is retryable — a
-    Python-level failure (returncode 1, wrong csum) is a real bug and fails
-    immediately."""
-    if not any(p.returncode == -6 for p in procs):
-        return False
-    text = "".join(outs)
-    return ("gloo" in text and "preamble" in text) or "heartbeat timeout" in text
-
-
 @pytest.mark.slow
 @pytest.mark.filterwarnings("ignore")
 def test_two_process_world(tmp_path):
-    # slow-marked for the tier-1 driver budget (~70s per attempt, and the
-    # pre-existing gloo preamble race can burn all 3 retries under load —
-    # KNOWN_FAILURES.md): it joins the multiprocess_e2e matrix in the
-    # standalone slow suite, which was already the home of every other
-    # multi-process test
-    for attempt in range(3):
-        procs, outs = _run_world(tmp_path, attempt)
-        if all(p.returncode == 0 for p in procs):
-            break
-        if not (attempt < 2 and _gloo_transport_race(procs, outs)):
-            break  # non-retryable failure (or retries exhausted): assert below
+    # slow-marked for the tier-1 driver budget (~70s): it joins the
+    # multiprocess_e2e matrix in the standalone slow suite, which was
+    # already the home of every other multi-process test.
+    #
+    # ONE attempt, no test-side retry wrapper (ISSUE 16): init-time
+    # rendezvous flakes are now absorbed inside Environment.init by the
+    # MLSL_DIST_INIT_RETRIES backoff loop (core/environment.py), where every
+    # embedder gets them — not by test scaffolding only this file had. The
+    # MID-RUN gloo TCP preamble race (SIGABRT -6 with `op.preamble.length
+    # <= op.nbytes`, load-dependent) remains a documented pre-existing flake
+    # with no library-level answer — see KNOWN_FAILURES.md for the
+    # signature before treating a failure here as a regression.
+    procs, outs = _run_world(tmp_path, 0)
     for i, (p, out) in enumerate(zip(procs, outs)):
         assert p.returncode == 0, f"proc {i} failed:\n{out[-2000:]}"
         assert f"proc {i} OK" in out, out[-2000:]
