@@ -136,6 +136,11 @@ def main():
             ("pallas_ring",
              [sys.executable, "benchmarks/pallas_ring_bench.py", "--bidir"],
              2400),
+            # r06: small-message latency class — the rhd/ring crossover
+            # curve and the fused quantized MoE exchange vs the inline lax
+            # wire (BASELINE.md "Expected r06 rows")
+            ("latency",
+             [sys.executable, "benchmarks/latency_bench.py"], 2400),
             # two-tier hierarchical curve: on a single slice this runs the
             # synthetic 2x4 split + DCN simulator (flat-vs-hier ordering);
             # on a real multislice attachment drop the sim and the env

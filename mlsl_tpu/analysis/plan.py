@@ -448,8 +448,15 @@ def _check_request(rep: Report, req, cfg, anchor: str) -> None:
                         f"cover chunk count {n} on "
                         f"'{req.name or req.uid}': the tail of the payload "
                         "would never cross the DCN", anchor)
-    if req.algo == "pallas_ring":
+    if req.algo in ("pallas_ring", "pallas_ring2d"):
+        # the 2D snake ring runs the identical kernel program over the
+        # snake-ordered neighbour tables, so the 1D accounting mirror IS
+        # its accounting mirror (same hop/slot schedule, different peers)
         _check_pallas_request(rep, req, cfg, anchor)
+    elif req.algo == "pallas_rhd":
+        _check_pallas_rhd_request(rep, req, cfg, anchor)
+    elif req.algo == "pallas_a2a":
+        _check_pallas_a2a_request(rep, req, cfg, anchor)
 
 
 # ---------------------------------------------------------------------------
@@ -669,6 +676,70 @@ def _check_pallas_request(rep: Report, req, cfg, anchor: str) -> None:
                     f"{PALLAS_VMEM_BUDGET // 2**20} MiB budget: shrink the "
                     "chunk (MLSL_LARGE_MSG_SIZE_MB) or the slot count",
                     f"{anchor}/pallas")
+
+
+def _check_pallas_rhd_request(rep: Report, req, cfg, anchor: str) -> None:
+    """A130-A132 for the recursive-halving/doubling latency kernel: replay
+    its static_accounting mirror per chunk program and bound the scratch the
+    build actually allocates (acc + recv slots, ops/rhd_kernels._rhd_call)."""
+    from mlsl_tpu.ops import rhd_kernels as rhd
+    from mlsl_tpu.ops import ring_kernels as rk
+
+    d = req.desc
+    slots = rk.env_slots(getattr(cfg, "pallas_ring_slots", None))
+    g = 1 if d.group.is_self else int(d.group.size)
+    if g <= 1:
+        return
+    for n in _chunk_counts(req):
+        events, total_hops, ndirs = rhd.static_accounting(g, slots)
+        verify_hop_trace(events, slots=slots, ndirs=ndirs,
+                         total_hops=total_hops,
+                         anchor=f"{anchor}/pallas_rhd", report=rep)
+        m, m_rows = rhd.geometry(g, int(n))
+        c, _k, r = rhd._split(g)
+        slots_eff = min(max(slots, 2), max(rhd.rounds(g), 1))
+        buf_rows = m_rows if r else max(m_rows // 2, 8)
+        est = 4 * 128 * (m_rows + slots_eff * buf_rows)
+        if est > PALLAS_VMEM_BUDGET:
+            rep.add("MLSL-A132",
+                    f"estimated rhd VMEM working set {est / 2**20:.1f} MiB "
+                    f"(m={m} elems x {slots_eff} slots) exceeds the "
+                    f"{PALLAS_VMEM_BUDGET // 2**20} MiB budget: this payload "
+                    "belongs to the ring class, lower "
+                    "MLSL_PALLAS_RHD_MAX_BYTES", f"{anchor}/pallas_rhd")
+
+
+def _check_pallas_a2a_request(rep: Report, req, cfg, anchor: str) -> None:
+    """A130-A132 for the fused alltoall: replay its accounting mirror and
+    bound the codec scratch (local + staging chunks plus per-slot wire
+    images, ops/a2a_kernels._a2a_call)."""
+    from mlsl_tpu.ops import a2a_kernels as a2a
+    from mlsl_tpu.ops import ring_kernels as rk
+
+    d = req.desc
+    slots = rk.env_slots(getattr(cfg, "pallas_ring_slots", None))
+    g = 1 if d.group.is_self else int(d.group.size)
+    if g <= 1:
+        return
+    quantized = a2a.quant_enabled(cfg)
+    block = getattr(cfg, "quant_block_elems", 256)
+    for n in _chunk_counts(req):
+        events, total_hops, ndirs = a2a.static_accounting(g, slots)
+        verify_hop_trace(events, slots=slots, ndirs=ndirs,
+                         total_hops=total_hops,
+                         anchor=f"{anchor}/pallas_a2a", report=rep)
+        # an alltoall desc's count is the PER-DESTINATION slice (the
+        # send_count the lax body rides); the kernel exchanges g of them
+        _rc, chunk, rows = a2a.geometry(g, g * int(n), block, quantized)
+        wire = chunk + 4 * rows if quantized else chunk * 4
+        est = 2 * 4 * chunk + (slots + 1) * wire
+        if est > PALLAS_VMEM_BUDGET:
+            rep.add("MLSL-A132",
+                    f"estimated a2a VMEM working set {est / 2**20:.1f} MiB "
+                    f"(chunk {chunk} elems x {slots} slots) exceeds the "
+                    f"{PALLAS_VMEM_BUDGET // 2**20} MiB budget: shrink the "
+                    "per-destination slice or the slot count",
+                    f"{anchor}/pallas_a2a")
 
 
 # ---------------------------------------------------------------------------

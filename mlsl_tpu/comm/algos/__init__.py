@@ -32,6 +32,27 @@ extends) the algorithm dimension:
                 selectable for COMPRESSION=QUANTIZATION requests (a
                 compressed case the table routes — quant_ring's
                 ``ring='pallas'`` wire).
+- ``pallas_rhd`` — the latency-class fused allreduce (ops/rhd_kernels.py,
+                algos/pallas_rhd.py): recursive halving/doubling as ONE
+                Pallas kernel — 2*log2(G) remote-DMA exchange rounds between
+                VMEM slots with pre/post folds for non-power-of-two groups
+                (rhd's exact pair math). The small-message regime's answer:
+                selected by tuned cells / MLSL_ALGO like any algorithm, and
+                by the heuristic rung for sub-payload-band dense SUM
+                allreduces when MLSL_PALLAS_RHD armed it.
+- ``pallas_ring2d`` — the fused ring riding a 2-live-axis sub-torus
+                (algos/pallas_ring2d.py): the SAME kernel as pallas_ring
+                over the snake (boustrophedon) Hamiltonian cycle, so one
+                ring drives both ICI axes' links (and both directions of
+                each with MLSL_PALLAS_RING_BIDIR) — the groups the 1D ring
+                refuses and ring2d served with composed lax phases.
+- ``pallas_a2a`` — the fused quantized all-to-all (ops/a2a_kernels.py,
+                algos/pallas_a2a.py) and the first member of the NEW
+                ``alltoall`` engine kind: MoE dispatch/combine with the
+                int8 blockwise codec fused at the VMEM boundary (quantize
+                on send-slot write, dequantize on receive — wire bytes
+                <= 1/3 of f32). models/moe.py routes through
+                ``inline_alltoall``'s selection instead of hardcoded lax.
 - ``hier``    — two-tier hierarchical allreduce for pod-scale worlds
                 (algos/hier.py): intra-slice reduce-scatter -> inter-slice
                 allreduce over the 1/L shard -> intra-slice all-gather,
@@ -73,9 +94,10 @@ from mlsl_tpu.types import CompressionType, ReductionType
 #: the baseline algorithm: the single-shot lax program (comm/collectives.py)
 DEFAULT = "lax"
 
-#: engine kinds: only elementwise-reduction collectives have alternative
-#: lowerings (the reference's algorithm choice is likewise allreduce-only)
-ENGINE_KINDS = ("allreduce", "reduce_scatter")
+#: engine kinds: the elementwise-reduction collectives (the reference's
+#: algorithm choice is likewise allreduce-first) plus — new with the fused
+#: kernel family — the MoE dispatch/combine exchange
+ENGINE_KINDS = ("allreduce", "reduce_scatter", "alltoall")
 
 
 def group_shape(group: ProcessGroup) -> Tuple[int, ...]:
@@ -138,6 +160,28 @@ def _eligible_hier(kind: str, group: ProcessGroup, op) -> bool:
     return hier.eligible(kind, group, op)
 
 
+def _eligible_pallas_rhd(kind: str, group: ProcessGroup, op) -> bool:
+    # allreduce only, SUM only, single-live-axis uniform groups, and a
+    # backend that can run the kernel — lazily imported like pallas_ring
+    from mlsl_tpu.ops import rhd_kernels
+
+    return rhd_kernels.eligible(kind, group, op)
+
+
+def _eligible_pallas_ring2d(kind: str, group: ProcessGroup, op) -> bool:
+    # exactly two live mesh axes (the snake cycle is 2D), SUM only
+    from mlsl_tpu.ops import ring_kernels
+
+    return ring_kernels.eligible_dense2d(kind, group, op)
+
+
+def _eligible_pallas_a2a(kind: str, group: ProcessGroup, op) -> bool:
+    # alltoall only (op-less), single-live-axis or color-flat uniform groups
+    from mlsl_tpu.ops import a2a_kernels
+
+    return a2a_kernels.eligible(kind, group, op=op)
+
+
 #: name -> eligibility predicate; builders are resolved lazily (the bodies
 #: import jax)
 _ELIGIBLE = {
@@ -145,6 +189,9 @@ _ELIGIBLE = {
     "rhd": _eligible_rhd,
     "ring2d": _eligible_ring2d,
     "pallas_ring": _eligible_pallas_ring,
+    "pallas_rhd": _eligible_pallas_rhd,
+    "pallas_ring2d": _eligible_pallas_ring2d,
+    "pallas_a2a": _eligible_pallas_a2a,
     "hier": _eligible_hier,
 }
 
@@ -155,6 +202,11 @@ def eligible(algo: str, kind: str, group: ProcessGroup, op=None) -> bool:
     """Can ``algo`` lower (kind, group, op)? Unknown names are never eligible."""
     if kind not in ENGINE_KINDS:
         return algo == DEFAULT
+    if kind == "alltoall" and algo not in (DEFAULT, "pallas_a2a"):
+        # the reduction algorithms' predicates predate the alltoall kind and
+        # do not check it — the central guard keeps a global MLSL_ALGO=rhd
+        # from claiming the MoE exchange it cannot lower
+        return False
     pred = _ELIGIBLE.get(algo)
     return bool(pred and pred(kind, group, op))
 
@@ -251,6 +303,25 @@ def select(
             "selected algorithm %s not eligible for %s on group %s; "
             "falling back to %s", name, kind, group_shape(group), DEFAULT,
         )
+        return DEFAULT
+    if name == DEFAULT:
+        # an explicit or tuned 'lax' pins the baseline — the heuristic rung
+        # must not override an operator's measured/forced choice
+        return DEFAULT
+    # Heuristic rung (below explicit and tuned): the latency-class fused
+    # allreduce for payloads inside the small-message band — ONLY when the
+    # operator armed MLSL_PALLAS_RHD, so with no knob and no profile the
+    # dispatched program stays bit-for-bit the baseline (the engine's
+    # founding contract).
+    if (
+        kind == "allreduce"
+        and getattr(config, "pallas_rhd", False)
+        and eligible("pallas_rhd", kind, group, op)
+    ):
+        from mlsl_tpu.ops import rhd_kernels
+
+        if payload_bytes <= rhd_kernels.env_max_bytes(config):
+            return _breaker_gate("pallas_rhd", kind)
     return DEFAULT
 
 
@@ -322,6 +393,21 @@ def inline_eligible(algo: str, kind: str, group: ProcessGroup, op=None) -> bool:
 
         if not ring_kernels.inline_ok(group):
             return False
+    if algo == "pallas_rhd":
+        from mlsl_tpu.ops import rhd_kernels
+
+        if not rhd_kernels.inline_ok(group):
+            return False
+    if algo == "pallas_ring2d":
+        from mlsl_tpu.ops import ring_kernels
+
+        if not ring_kernels.inline_ok2d(group):
+            return False
+    if algo == "pallas_a2a":
+        from mlsl_tpu.ops import a2a_kernels
+
+        if not a2a_kernels.inline_ok(group):
+            return False
     return eligible(algo, kind, group, op)
 
 
@@ -356,6 +442,32 @@ def inline_plan(kind: str, group: ProcessGroup, algo: str, count: int, *,
             return (lambda x, mypos: (x, mypos), [],
                     lambda carry: carry[0][:recv_count])
         return lambda x, mypos: (x, mypos), [], lambda carry: carry[0]
+    if kind == "alltoall":
+        if algo == DEFAULT:
+            from jax import lax as _lax
+
+            ax = group.axes if len(group.axes) > 1 else group.axes[0]
+            g = int(group.size)
+
+            def lax_a2a(carry):
+                cur, mypos = carry
+                out = _lax.all_to_all(cur.reshape(g, -1), ax,
+                                      split_axis=0, concat_axis=0)
+                return out.reshape(-1), mypos
+
+            return (lambda x, mypos: (x, mypos), [lax_a2a],
+                    lambda carry: carry[0])
+        from mlsl_tpu.comm.algos import pallas_a2a
+        from mlsl_tpu.ops import a2a_kernels
+
+        # codec/slot knobs from the caller's config, same contract as the
+        # fused ring: the in-graph kernel runs the host path's geometry
+        return pallas_a2a.steps(
+            kind, group, count,
+            block=int(getattr(config, "quant_block_elems", 256)),
+            quantized=a2a_kernels.quant_enabled(config),
+            slots=getattr(config, "pallas_ring_slots", None),
+        )
     if algo == DEFAULT:
         sizes = collectives._axis_sizes(group.topology.mesh)
 
@@ -384,6 +496,21 @@ def inline_plan(kind: str, group: ProcessGroup, algo: str, count: int, *,
         # profiles apply there) — the in-graph kernel must run the same
         # slot geometry as the host-path requests
         return pallas_ring.steps(
+            kind, group, count, op=rop, recv_count=recv_count,
+            slots=getattr(config, "pallas_ring_slots", None),
+            bidir=getattr(config, "pallas_ring_bidir", None),
+        )
+    if algo == "pallas_rhd":
+        from mlsl_tpu.comm.algos import pallas_rhd
+
+        return pallas_rhd.steps(
+            kind, group, count, op=rop, recv_count=recv_count,
+            slots=getattr(config, "pallas_ring_slots", None),
+        )
+    if algo == "pallas_ring2d":
+        from mlsl_tpu.comm.algos import pallas_ring2d
+
+        return pallas_ring2d.steps(
             kind, group, count, op=rop, recv_count=recv_count,
             slots=getattr(config, "pallas_ring_slots", None),
             bidir=getattr(config, "pallas_ring_bidir", None),
@@ -422,6 +549,12 @@ def build(kind: str, group: ProcessGroup, dtype, algo: str, **kw) -> Callable:
         from mlsl_tpu.comm.algos import rhd as impl
     elif algo == "pallas_ring":
         from mlsl_tpu.comm.algos import pallas_ring as impl
+    elif algo == "pallas_rhd":
+        from mlsl_tpu.comm.algos import pallas_rhd as impl
+    elif algo == "pallas_ring2d":
+        from mlsl_tpu.comm.algos import pallas_ring2d as impl
+    elif algo == "pallas_a2a":
+        from mlsl_tpu.comm.algos import pallas_a2a as impl
     elif algo == "hier":
         from mlsl_tpu.comm.algos import hier as impl
     else:
@@ -479,13 +612,48 @@ def inline_allreduce(x, axis, *, group: ProcessGroup = None, config=None,
     return _lax.pmax(x, axis)
 
 
-def inline_alltoall(x, axis, *, split_axis=0, concat_axis=0, tiled=False):
-    """In-graph alltoall (the MoE expert dispatch/combine exchange). One
-    lowering today — the lax baseline — but the engine owns the call site,
-    so stats/lint see every dispatch path and a tiered decomposition slots
-    in here when the DCN alltoall lands."""
+def inline_alltoall(x, axis, *, split_axis=0, concat_axis=0, tiled=False,
+                    group: ProcessGroup = None, config=None):
+    """In-graph alltoall (the MoE expert dispatch/combine exchange). With
+    ``group`` (and config) the selection table picks the lowering — a forced
+    ``MLSL_ALGO=alltoall=pallas_a2a`` or a tuned cell routes the exchange
+    through the fused quantized kernel; with only ``axis`` (or a selected
+    kernel the backend cannot emit in-graph) the lax baseline applies, with
+    a debug log naming the fallback so an operator forcing the kernel
+    off-TPU sees WHY the wire stayed f32.
+
+    The kernel path applies to the MoE layout specifically: leading dim ==
+    group size, ``split_axis == concat_axis == 0``, untiled — exactly the
+    flat chunks-by-member convention ops/a2a_kernels.py exchanges."""
     from jax import lax as _lax
 
+    if (
+        group is not None and not group.is_self and int(group.size) > 1
+        and split_axis == 0 and concat_axis == 0 and not tiled
+        and int(x.shape[0]) == int(group.size)
+        and x.dtype == np.float32  # the fused kernel's codec/scratch are f32
+    ):
+        count = int(np.prod(x.shape))
+        algo = select("alltoall", group, count * 4, CompressionType.NONE,
+                      config)
+        if algo != DEFAULT:
+            if inline_eligible(algo, "alltoall", group):
+                from mlsl_tpu.comm import collectives
+
+                sizes = collectives._axis_sizes(group.topology.mesh)
+                prep, phases, finish = inline_plan(
+                    "alltoall", group, algo, count, config=config,
+                )
+                carry = prep(x.reshape(-1),
+                             collectives._group_rank(group.axes, sizes))
+                for phase in phases:
+                    carry = phase(carry)
+                return finish(carry).reshape(x.shape)
+            log_debug(
+                "alltoall algorithm %s selected but not emittable in-graph "
+                "on this backend/group; falling back to the lax exchange",
+                algo,
+            )
     return _lax.all_to_all(x, axis, split_axis=split_axis,
                            concat_axis=concat_axis, tiled=tiled)
 

@@ -1,0 +1,57 @@
+"""The ``pallas_rhd`` algos-engine lowering: latency-class fused allreduce.
+
+Recursive halving/doubling (the ``rhd`` pair math, eplib/allreduce_pr.c) as
+ONE Pallas kernel (ops/rhd_kernels.py): 2*log2(G) symmetric remote-DMA
+exchange rounds between VMEM slots instead of the ring's 2(G-1) hops — the
+small-message (``msg_priority_threshold``-class) regime where per-hop
+latency, not algbw, decides (ROADMAP #1, decode-time serving).
+
+Selection: a forced ``MLSL_ALGO=pallas_rhd`` or a tuned-profile cell works
+like every other algorithm; additionally the heuristic rung prefers this
+kernel for sub-``MLSL_PALLAS_RHD_MAX_BYTES`` dense SUM allreduces when the
+operator armed ``MLSL_PALLAS_RHD=1`` — an explicit knob, so untuned default
+behavior stays bit-for-bit the baseline (the engine's founding contract).
+
+``build`` compiles the standalone host-dispatch program over the flat world
+mesh (interpreter-executable off-TPU for tier-1 parity); ``steps`` exposes
+the compiled-overlap phase form (TPU only — rhd_kernels.inline_ok)."""
+
+from __future__ import annotations
+
+from typing import Callable
+
+from mlsl_tpu.comm.mesh import ProcessGroup
+from mlsl_tpu.log import mlsl_assert
+
+
+def eligible(kind: str, group: ProcessGroup, op=None) -> bool:
+    from mlsl_tpu.ops import rhd_kernels
+
+    return rhd_kernels.eligible(kind, group, op)
+
+
+def steps(kind: str, group: ProcessGroup, count: int, *, op=None,
+          recv_count=None, slots=None):
+    from mlsl_tpu.ops import rhd_kernels
+
+    return rhd_kernels.steps(kind, group, count, op=op,
+                             recv_count=recv_count, slots=slots)
+
+
+def build(kind: str, group: ProcessGroup, *, op=None, recv_count=None,
+          slots=None, **_) -> Callable:
+    """Compile the standalone pallas_rhd program (build_collective calling
+    convention); geometry resolves at trace time from the buffer length."""
+    from mlsl_tpu.ops import rhd_kernels
+    from mlsl_tpu.ops import ring_kernels as rk
+
+    mlsl_assert(eligible(kind, group, op),
+                "pallas_rhd cannot lower %s on this group/backend", kind)
+
+    def body(x):
+        inner = rhd_kernels.allreduce_body(
+            group, int(x.shape[0]), slots=slots,
+        )
+        return inner(x)
+
+    return rk.build_flat_program(body, group, kind)

@@ -1,0 +1,54 @@
+"""The ``pallas_ring2d`` algos-engine lowering: the fused ring on a 2D torus.
+
+The SAME fused ring kernel as ``pallas_ring`` (ops/ring_kernels.py) — only
+the neighbor addressing changes: the ring is the boustrophedon (snake)
+Hamiltonian cycle of a 2-live-axis sub-torus, whose edge set mixes minor-
+axis links inside each row with major-axis links between rows, so ONE ring
+keeps both ICI axes' links busy. With ``MLSL_PALLAS_RING_BIDIR`` the PR 10
+block-row split then rides each link's two directions on top — both axes,
+both directions, one kernel.
+
+Covers exactly the groups the 1D ``pallas_ring`` refuses (two live axes,
+where ``ring2d``'s composed lax phases were the only topology-aware option).
+``build``/``steps`` follow pallas_ring verbatim."""
+
+from __future__ import annotations
+
+from typing import Callable
+
+from mlsl_tpu.comm.mesh import ProcessGroup
+from mlsl_tpu.log import mlsl_assert
+
+
+def eligible(kind: str, group: ProcessGroup, op=None) -> bool:
+    from mlsl_tpu.ops import ring_kernels
+
+    return ring_kernels.eligible_dense2d(kind, group, op)
+
+
+def steps(kind: str, group: ProcessGroup, count: int, *, op=None,
+          recv_count=None, slots=None, bidir=None):
+    from mlsl_tpu.ops import ring_kernels
+
+    return ring_kernels.steps(kind, group, count, op=op,
+                              recv_count=recv_count, slots=slots,
+                              bidir=bidir, snake=True)
+
+
+def build(kind: str, group: ProcessGroup, *, op=None, recv_count=None,
+          slots=None, bidir=None, **_) -> Callable:
+    """Compile the standalone snake-ring program (build_collective calling
+    convention); geometry resolves at trace time from the buffer length."""
+    from mlsl_tpu.ops import ring_kernels as rk
+
+    mlsl_assert(eligible(kind, group, op),
+                "pallas_ring2d cannot lower %s on this group/backend", kind)
+
+    def body(x):
+        inner = rk.dense_ring_body(
+            kind, group, int(x.shape[0]), x.dtype,
+            recv_count=recv_count, slots=slots, bidir=bidir, snake=True,
+        )
+        return inner(x)
+
+    return rk.build_flat_program(body, group, kind)

@@ -51,7 +51,9 @@ from jax.sharding import PartitionSpec as P
 
 from mlsl_tpu import chaos
 from mlsl_tpu.comm import algos
-from mlsl_tpu.comm.collectives import _BUF_SPEC, _axis_sizes, _group_rank, smap
+from mlsl_tpu.comm.collectives import (
+    _BUF_SPEC, _axis_sizes, _body_allgather, _group_rank, smap,
+)
 from mlsl_tpu.comm.mesh import NUM_GRID_AXES, ProcessGroup
 from mlsl_tpu.core import stats as stats_mod
 from mlsl_tpu.log import log_debug, mlsl_assert
@@ -477,6 +479,209 @@ def zero_residuals(plan: OverlapPlan, topo) -> Dict[str, jax.Array]:
         )
         for k, el in plan.err_lens.items()
     }
+
+
+# ---------------------------------------------------------------------------
+# ZeRO-1 two-phase staged update (reduce-scatter -> owned update -> all-gather)
+# ---------------------------------------------------------------------------
+
+
+class _Zero1Unit:
+    """One layer's ZeRO-1 exchange as staged phases: the reduce-scatter
+    phases of the selected algorithm, the owned-shard SGD update, then the
+    all-gather phases that reassemble the updated parameter. With the fused
+    ring selected, BOTH wire phases are single Pallas kernel launches
+    (ops/ring_kernels.py: ``kind='reduce_scatter'`` and the gather-only
+    ``kind='all_gather'`` mode) — the host ZeRO-1 path's two lax programs
+    (core/parameter_set.py grad/increment requests) collapsed into two
+    kernel stages the overlap scheduler interleaves between layers."""
+
+    def __init__(self, name: str, count: int, algo: str,
+                 group: ProcessGroup, *, lr: float, denom: float,
+                 config=None):
+        self.name = name
+        self.count = int(count)
+        self.algo = algo
+        g = max(int(group.size), 1)
+        self.padded = -(-self.count // g) * g
+        self.shard = self.padded // g
+        self._lr, self._denom = float(lr), float(denom)
+        self._degenerate = group.is_self or group.size <= 1
+        if self._degenerate:
+            self.nphases = 1
+            return
+        self._rs_prep, self._rs_phases, self._rs_finish = algos.inline_plan(
+            "reduce_scatter", group, algo, self.padded,
+            op=ReductionType.SUM, recv_count=self.shard, config=config,
+        )
+        if algo in ("pallas_ring", "pallas_ring2d"):
+            # the gather phase rides the SAME kernel family as the reduce
+            # phase: one fused all_gather launch over the same ring/snake
+            from mlsl_tpu.ops import ring_kernels as rk_ops
+
+            (self._ag_prep, self._ag_phases,
+             self._ag_finish) = rk_ops.steps(
+                "all_gather", group, self.shard,
+                slots=getattr(config, "pallas_ring_slots", None),
+                snake=(algo == "pallas_ring2d"),
+            )
+        else:
+            sizes = _axis_sizes(group.topology.mesh)
+            axes = group.axes
+
+            def ag_phase(carry):
+                cur, mypos = carry
+                return _body_allgather(cur, axes=axes, sizes=sizes), mypos
+
+            self._ag_prep = lambda x, mypos: (x, mypos)
+            self._ag_phases = [ag_phase]
+            self._ag_finish = lambda carry: carry[0]
+        # reduce phases + the owned-shard update (its own stage: the
+        # boundary between the two wire directions) + gather phases
+        self.nphases = len(self._rs_phases) + 1 + len(self._ag_phases)
+        self.per_tick = 1
+
+    def prep(self, p_flat, g_flat, mypos):
+        pad = self.padded - self.count
+        p = jnp.pad(p_flat, (0, pad)) if pad else p_flat
+        gr = jnp.pad(g_flat, (0, pad)) if pad else g_flat
+        if self._degenerate:
+            return {"p": p, "g": gr, "mypos": mypos}
+        return {"p": p, "carry": self._rs_prep(gr, mypos), "mypos": mypos}
+
+    def advance(self, state, i: int):
+        if self._degenerate:
+            state["p"] = state["p"] - self._lr * (state["g"] / self._denom)
+            return state
+        n_rs = len(self._rs_phases)
+        if i < n_rs:
+            state["carry"] = self._rs_phases[i](state["carry"])
+        elif i == n_rs:
+            # owned-shard update: this member updates ONLY its 1/G slice —
+            # the ZeRO-1 contract; everyone else's slices arrive updated
+            # through the gather phases
+            gshard = self._rs_finish(state["carry"]) / self._denom
+            owned = lax.dynamic_slice_in_dim(
+                state["p"], state["mypos"] * self.shard, self.shard
+            )
+            state["carry"] = self._ag_prep(
+                owned - self._lr * gshard, state["mypos"]
+            )
+        else:
+            state["carry"] = self._ag_phases[i - n_rs - 1](state["carry"])
+        return state
+
+    def finish(self, state):
+        if self._degenerate:
+            return state["p"][: self.count]
+        return self._ag_finish(state["carry"])[: self.count]
+
+
+def _zero1_algo(group: ProcessGroup, payload: int, config,
+                forced: Optional[str]) -> str:
+    """Per-unit reduce-scatter algorithm for the ZeRO-1 plan: the same
+    forced > table > in-graph-gate cascade as ``_unit_algo``, keyed on the
+    reduce_scatter kind."""
+    name = forced or algos.select(
+        "reduce_scatter", group, payload, CompressionType.NONE, config,
+        op=ReductionType.SUM,
+    )
+    if name and name != algos.DEFAULT and not algos.inline_eligible(
+        name, "reduce_scatter", group, ReductionType.SUM
+    ):
+        log_debug(
+            "zero1: algorithm %s not in-graph eligible on group %s; "
+            "falling back to %s", name, algos.group_shape(group),
+            algos.DEFAULT,
+        )
+        return algos.DEFAULT
+    return name or algos.DEFAULT
+
+
+def build_zero1_update(
+    group: ProcessGroup,
+    counts: Sequence[int],
+    *,
+    lr: float,
+    denom: float = 1.0,
+    algo: Optional[str] = None,
+    config=None,
+    stages: Optional[int] = None,
+) -> Tuple[Callable, List[_Zero1Unit]]:
+    """Compile the staged ZeRO-1 update standalone: -> (fn, units).
+
+    ``fn(param_bufs, grad_bufs) -> new param bufs`` over standard
+    (R, D, S, M, n) distributed buffers, newest-first (the reversed list
+    starts first, like a backward pass). Each layer is ONE `_Zero1Unit`:
+    reduce-scatter the gradient, update the owned 1/G shard with SGD
+    (``p -= lr * g / denom``), all-gather the updated parameter — the
+    optimizer-state-sharded schedule the host path runs as two separate
+    request families, emitted here as in-graph stages with the phase
+    boundaries pinned like the allreduce schedule. With the fused ring
+    selected (forced/tuned ``pallas_ring``/``pallas_ring2d``), both wire
+    phases are single Pallas kernel launches."""
+    mlsl_assert(counts, "zero1 plan needs at least one layer")
+    stages = int(stages if stages is not None
+                 else getattr(config, "overlap_stages", DEFAULT_STAGES))
+    units = [
+        _Zero1Unit(
+            f"p{i}", int(c),
+            _zero1_algo(group, int(c) * 4, config, algo),
+            group, lr=lr, denom=denom, config=config,
+        )
+        for i, c in enumerate(counts)
+    ]
+    for u in units:
+        u.per_tick = max(1, -(-u.nphases // max(stages, 1)))
+    topo = group.topology
+    degenerate = group.is_self or group.size <= 1
+    names = [u.name for u in units]
+
+    def body(p_bufs, g_bufs):
+        if degenerate:
+            mypos = jnp.int32(0)
+        else:
+            sizes = _axis_sizes(group.topology.mesh)
+            mypos = _group_rank(group.axes, sizes)
+        flat_p = {n: b.reshape(b.shape[NUM_GRID_AXES:])
+                  for n, b in zip(names, p_bufs)}
+        flat_g = {n: b.reshape(b.shape[NUM_GRID_AXES:])
+                  for n, b in zip(names, g_bufs)}
+        inflight: List[list] = []  # [unit, state, phase_idx]
+        out: Dict[str, jax.Array] = {}
+
+        def tick() -> None:
+            for ent in inflight:
+                u = ent[0]
+                for _ in range(u.per_tick):
+                    if ent[2] < u.nphases:
+                        ent[1] = u.advance(ent[1], ent[2])
+                        ent[2] += 1
+            _pin([e for e in inflight if e[2] < e[0].nphases])
+            for ent in [e for e in inflight if e[2] >= e[0].nphases]:
+                inflight.remove(ent)
+                out[ent[0].name] = ent[0].finish(ent[1])
+
+        for u in reversed(units):
+            inflight.append([u, u.prep(flat_p[u.name], flat_g[u.name],
+                                       mypos), 0])
+            tick()
+        while inflight:
+            tick()
+        return [out[n][None, None, None, None] for n in names]
+
+    sm = smap(
+        body, topo.mesh,
+        in_specs=([_BUF_SPEC] * len(names), [_BUF_SPEC] * len(names)),
+        out_specs=[_BUF_SPEC] * len(names),
+        check=False,
+    )
+    jitted = jax.jit(sm)
+
+    def fn(param_bufs, grad_bufs):
+        return jitted(list(param_bufs), list(grad_bufs))
+
+    return fn, units
 
 
 # ---------------------------------------------------------------------------

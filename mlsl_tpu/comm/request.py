@@ -330,21 +330,51 @@ class CommRequest:
             self.dispatcher.config, op=kw.get("op"),
         )
         lax_kw = dict(kw)
-        if self.algo == "pallas_ring":
+        if self.algo in ("pallas_ring", "pallas_ring2d"):
             # kernel-geometry knobs ride the build kw (and so the program
             # cache key) — but never the 'lax' fallback build below
             cfg = self.dispatcher.config
             kw["slots"] = int(getattr(cfg, "pallas_ring_slots", 2))
             kw["bidir"] = bool(getattr(cfg, "pallas_ring_bidir", False))
+        elif self.algo in ("pallas_rhd", "pallas_a2a"):
+            cfg = self.dispatcher.config
+            kw["slots"] = int(getattr(cfg, "pallas_ring_slots", 2))
+            if self.algo == "pallas_a2a":
+                from mlsl_tpu.ops import a2a_kernels
+                kw["block"] = int(getattr(cfg, "quant_block_elems", 256))
+                kw["quantized"] = a2a_kernels.quant_enabled(cfg)
         chunks = self._plan_chunks()
-        if self.algo == "pallas_ring":
+        span_count = ((chunks[0].stop - chunks[0].start) if chunks
+                      else d.count)
+        span_programs = len(chunks) if chunks else 1
+        if self.algo in ("pallas_ring", "pallas_ring2d"):
+            # the snake ring is the same kernel program over 2D neighbour
+            # tables — the 1D describe_plan IS its wire plan
             self._set_pallas_span(
                 d, None, quantized=False, slots=kw["slots"],
-                bidir=kw["bidir"],
-                count=(chunks[0].stop - chunks[0].start) if chunks
-                else d.count,
-                programs=len(chunks) if chunks else 1,
+                bidir=kw["bidir"], count=span_count,
+                programs=span_programs,
             )
+        elif self.algo == "pallas_rhd":
+            from mlsl_tpu.ops import rhd_kernels
+            g = 1 if d.group.is_self else int(d.group.size)
+            m, _ = rhd_kernels.geometry(g, span_count)
+            self._span_args = {
+                "pallas.hop": rhd_kernels.describe_plan(g, m, kw["slots"])
+            }
+        elif self.algo == "pallas_a2a":
+            from mlsl_tpu.ops import a2a_kernels
+            cfg = self.dispatcher.config
+            g = 1 if d.group.is_self else int(d.group.size)
+            # an alltoall desc's count is the PER-DESTINATION send_count;
+            # the kernel's wire plan covers the g-chunk exchange
+            self._span_args = {
+                "pallas.hop": a2a_kernels.describe_plan(
+                    g, g * span_count,
+                    int(getattr(cfg, "quant_block_elems", 256)),
+                    a2a_kernels.quant_enabled(cfg), kw["slots"],
+                )
+            }
         if chunks is None:
             self._fns = [algos.build(d.kind, d.group, dtype, self.algo, **kw)]
             self._chunk_slices = [slice(None)]

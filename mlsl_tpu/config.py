@@ -46,6 +46,7 @@ _ENV_FIELDS = {
     "MLSL_QUANT_BLOCK_ELEMS": "quant_block_elems",
     "MLSL_HIER_DCN_CODEC": "hier_dcn_codec",
     "MLSL_PALLAS_RING_SLOTS": "pallas_ring_slots",
+    "MLSL_PALLAS_RHD_MAX_BYTES": "pallas_rhd_max_bytes",
     "MLSL_OVERLAP_STAGES": "overlap_stages",
     "MLSL_FEED_DEPTH": "feed_depth",
     "MLSL_FEED_CACHE_MB": "feed_cache_mb",
@@ -146,6 +147,23 @@ class Config:
     # duplex ICI link). Changes quantization grouping order, so the
     # quantized EF-parity oracle covers the unidirectional form only.
     pallas_ring_bidir: bool = False  # MLSL_PALLAS_RING_BIDIR
+    # Arm the latency-class fused allreduce heuristic rung: with this set,
+    # dense SUM allreduces whose payload fits the small-message band lower
+    # to 'pallas_rhd' (ops/rhd_kernels.py — log2(G) halving/doubling rounds
+    # in one kernel) WITHOUT a tuned profile or MLSL_ALGO. Off by default:
+    # untuned selection stays bit-for-bit the baseline. A forced or tuned
+    # 'pallas_rhd' works regardless of this knob, like any algorithm.
+    pallas_rhd: bool = False         # MLSL_PALLAS_RHD
+    # Upper edge (bytes) of the heuristic band above. 0 = derive from the
+    # reference's small-message boundary: 4 x msg_priority_threshold
+    # elements' worth of f32 payload (rhd_kernels.env_max_bytes). Tunable
+    # via a tuner profile (tuner.KNOB_RANGES); an exported env always wins.
+    pallas_rhd_max_bytes: int = 0    # MLSL_PALLAS_RHD_MAX_BYTES
+    # Fuse the int8 blockwise codec into the 'pallas_a2a' alltoall wire
+    # (quantize on send-slot write, dequantize on receive — wire bytes
+    # <= 1/3 of f32). Off = the same kernel exchanges dense f32. The codec
+    # block size rides MLSL_QUANT_BLOCK_ELEMS like every quantized wire.
+    pallas_a2a_quant: bool = True    # MLSL_PALLAS_A2A_QUANT
     # Interpreter gate, recorded for discoverability like chaos_spec: the
     # kernels read the SAME env var per build ('1' force-interpret, '0'
     # force-compiled, '' = compiled on TPU / interpreter elsewhere — but
@@ -437,6 +455,11 @@ class Config:
             "MLSL_PALLAS_RING_SLOTS must be >= 2 (the ring needs a double "
             "buffer; got %d)", self.pallas_ring_slots,
         )
+        mlsl_assert(
+            self.pallas_rhd_max_bytes >= 0,
+            "MLSL_PALLAS_RHD_MAX_BYTES must be >= 0 (0 = derive from "
+            "MLSL_MSG_PRIORITY_THRESHOLD; got %d)", self.pallas_rhd_max_bytes,
+        )
         # MLSL_MESH_TIERS grammar, checked locally (comm.mesh's
         # parse_mesh_tiers applies the same rules but imports jax; validate()
         # must stay importable without it). World-coverage is checked where
@@ -690,6 +713,11 @@ class Config:
                                        c.pallas_ring_slots)
         c.pallas_ring_bidir = _env_bool("MLSL_PALLAS_RING_BIDIR",
                                         c.pallas_ring_bidir)
+        c.pallas_rhd = _env_bool("MLSL_PALLAS_RHD", c.pallas_rhd)
+        c.pallas_rhd_max_bytes = _env_int("MLSL_PALLAS_RHD_MAX_BYTES",
+                                          c.pallas_rhd_max_bytes)
+        c.pallas_a2a_quant = _env_bool("MLSL_PALLAS_A2A_QUANT",
+                                       c.pallas_a2a_quant)
         c.pallas_interpret = os.environ.get("MLSL_PALLAS_INTERPRET",
                                             c.pallas_interpret).strip()
         c.topk_ratio = _env_float("MLSL_TOPK_RATIO", c.topk_ratio)

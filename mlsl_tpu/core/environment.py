@@ -205,8 +205,8 @@ class Environment:
                 )
                 try:
                     jax.distributed.shutdown()
-                except Exception:
-                    pass  # half-initialized client: nothing to unwind
+                except Exception:  # mlsl-lint: disable=A205 -- half-
+                    pass  # initialized client: nothing to unwind
 
     _jax_cache_defaults = None  # knob values before our first mutation
 

@@ -356,10 +356,23 @@ class Session:
             # change compiles a DIFFERENT kernel, and a plan entry recorded
             # under the old geometry must not skip re-warming it
             pallas_key = ()
-            if req.algo == "pallas_ring":
+            if req.algo in ("pallas_ring", "pallas_ring2d"):
                 pallas_key = (
                     int(getattr(cfg, "pallas_ring_slots", 2)),
                     bool(getattr(cfg, "pallas_ring_bidir", False)),
+                )
+            elif req.algo == "pallas_rhd":
+                # the rhd kernel's only compile-time knob is slot depth
+                pallas_key = (int(getattr(cfg, "pallas_ring_slots", 2)),)
+            elif req.algo == "pallas_a2a":
+                # wire-codec identity: toggling the int8 codec (or its block
+                # grid) compiles a DIFFERENT kernel
+                from mlsl_tpu.ops import a2a_kernels
+
+                pallas_key = (
+                    int(getattr(cfg, "pallas_ring_slots", 2)),
+                    int(getattr(cfg, "quant_block_elems", 256)),
+                    bool(a2a_kernels.quant_enabled(cfg)),
                 )
             elif req.algo == "hier":
                 # two-tier variant identity: a DCN-codec or tier-shape

@@ -107,6 +107,8 @@ def moe_ffn(
     capacity_factor: float = 1.25,
     top_k: int = 1,
     compute_dtype=jnp.float32,
+    group=None,
+    config=None,
 ) -> Tuple[jax.Array, jax.Array]:
     """SPMD MoE feed-forward (call inside shard_map over ``axis`` of size ep).
 
@@ -117,6 +119,13 @@ def moe_ffn(
     capacity competition are all sharded over the ep axis.
     params['w1'/'w2']: this rank's expert shard (El = E/ep experts); 'wg'
     replicated. -> (out (T, D) f32 replicated, aux-loss scalar for this slice).
+
+    ``group``/``config``: the expert-axis ProcessGroup and mlsl Config, when
+    the caller has them (HybridTrainer threads its model group). With both,
+    the dispatch/combine exchanges route through the collective engine's
+    selection table (MLSL_ALGO > tuned profile > inline lax) — a forced or
+    tuned ``pallas_a2a`` cell lowers them to the fused quantized alltoall
+    kernel. Without, the lax baseline applies unchanged.
     """
     t, d = x.shape
     el = params["w1"].shape[0]
@@ -144,9 +153,11 @@ def moe_ffn(
     # (comm/algos inline helpers): the engine owns the call site, so the
     # lint gate, stats attribution, and future tiered alltoall lowerings
     # all apply here without touching the routing math
-    recv = algos.inline_alltoall(buf, axis, split_axis=0, concat_axis=0)
+    recv = algos.inline_alltoall(buf, axis, split_axis=0, concat_axis=0,
+                                 group=group, config=config)
     y = _expert_ffn(recv, params["w1"], params["w2"], compute_dtype)  # (ep, El, C, D)
-    back = algos.inline_alltoall(y, axis, split_axis=0, concat_axis=0)
+    back = algos.inline_alltoall(y, axis, split_axis=0, concat_axis=0,
+                                 group=group, config=config)
     y_full = back.reshape(n_experts, capacity, d)
     out_slice = jnp.einsum("tec,ecd->td", combine, y_full)         # (Tl, D)
     out = algos.inline_allgather(out_slice, axis, gather_axis=0,

@@ -175,7 +175,8 @@ def _ln(x, scale, bias, eps=1e-5):
     return (x - mu) * lax.rsqrt(var + eps) * scale + bias
 
 
-def forward_local(params, tokens, cfg: TransformerConfig, sp: int, tp: int):
+def forward_local(params, tokens, cfg: TransformerConfig, sp: int, tp: int,
+                  comm=None):
     """SPMD forward on local shards (call inside shard_map).
 
     tokens: (Bl, Sl) int32. params: LOCAL shards per param_specs. Returns
@@ -183,6 +184,10 @@ def forward_local(params, tokens, cfg: TransformerConfig, sp: int, tp: int):
     'model' (psum'd), sharded over data/seq — and the MoE aux-loss total, 0.0
     without experts). The LM head is applied by the loss (local_loss), which owns
     the replicated-vs-vocab-sharded distinction.
+
+    ``comm``: optional (model ProcessGroup, mlsl Config) pair; with it the MoE
+    dispatch/combine exchanges route through the collective engine's selection
+    table (comm/algos.inline_alltoall) instead of pinning the lax baseline.
     """
     emb = params["embed"]
     cdt = jnp.dtype(cfg.dtype)
@@ -233,6 +238,8 @@ def forward_local(params, tokens, cfg: TransformerConfig, sp: int, tp: int):
                 a.reshape(bl * sl_, dm).astype(jnp.float32),
                 mp, MODEL_AXIS, tp, cfg.capacity_factor, cfg.moe_top_k,
                 compute_dtype=cdt,
+                group=comm[0] if comm else None,
+                config=comm[1] if comm else None,
             )
             h = (h.astype(jnp.float32) + o2d.reshape(bl, sl_, dm)).astype(cdt)
         else:
@@ -294,12 +301,12 @@ def _sharded_vocab_ce(h, head_local, labels, vocab_local: int):
     return jnp.sum(lse - label_logit)
 
 
-def local_loss(params, tokens, labels, cfg, sp, tp):
+def local_loss(params, tokens, labels, cfg, sp, tp, comm=None):
     """Sum (not mean) of CE over the LOCAL token shard — the reduction across
     data/seq shards belongs to the MLSL gradient requests. Owns the LM head:
     replicated (dense softmax) or model-axis vocab-sharded (pmax/psum CE, full-V
     logits never materialize). Returns (ce_sum, aux)."""
-    h, aux = forward_local(params, tokens, cfg, sp, tp)
+    h, aux = forward_local(params, tokens, cfg, sp, tp, comm=comm)
     head = params["final"]["head"].astype(jnp.float32)
     if cfg.sharded_vocab and tp > 1:
         return _sharded_vocab_ce(h, head, labels, head.shape[-1]), aux
@@ -509,8 +516,13 @@ class HybridTrainer:
         tokens_per_slice = (self.batch // self.dp) * (cfg.seq_len // self.sp) / tp
         aux_w = cfg.moe_aux_weight * tokens_per_slice
 
+        # the model group + config thread the MoE alltoalls through the
+        # selection table; the group is a static trace-time object, so the
+        # choice is baked per compiled step like every engine decision
+        comm = (self.dist.model_group, self.env.config) if self.tp > 1 else None
+
         def scaled_loss(p, t, l):
-            ce, aux = local_loss(p, t, l, cfg, sp, tp)
+            ce, aux = local_loss(p, t, l, cfg, sp, tp, comm=comm)
             return ce / tp + aux_w * aux, ce
 
         return scaled_loss

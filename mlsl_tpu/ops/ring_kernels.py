@@ -172,6 +172,24 @@ def ring_axis(group: ProcessGroup) -> Optional[str]:
     return live[0]
 
 
+def ring_axes2(group: ProcessGroup) -> Optional[Tuple[str, str]]:
+    """The (major, minor) live mesh axis pair a 2D-torus snake ring can ride,
+    or None when the group is not an axis-aligned 2-axis sub-torus. The snake
+    (boustrophedon) Hamiltonian cycle built over this pair alternates minor-
+    axis hops within a row with major-axis hops between rows, so the one ring
+    keeps BOTH axes' ICI links in flight (the PR 10 bidir split then rides
+    each link's two directions on top)."""
+    if group.colors is not None or not group.axes:
+        return None
+    from mlsl_tpu.comm.collectives import _axis_sizes
+
+    sizes = _axis_sizes(group.topology.mesh)
+    live = [a for a in group.axes if sizes[a] > 1]
+    if len(live) != 2:
+        return None
+    return live[0], live[1]
+
+
 def eligible_dense(kind: str, group: ProcessGroup, op=None) -> bool:
     """Engine eligibility for the dense f32/bf16/i32 variant: SUM-reduction
     ring math on a single-live-axis group of tractable size, on a backend
@@ -186,6 +204,34 @@ def eligible_dense(kind: str, group: ProcessGroup, op=None) -> bool:
         return False
     ax = ring_axis(group)
     if ax is None:
+        return False
+    return 1 < int(group.size) <= MAX_GROUP
+
+
+def eligible_dense2d(kind: str, group: ProcessGroup, op=None) -> bool:
+    """Eligibility for the 2D-torus snake-ring variant: the same dense ring
+    math, but over an axis-aligned TWO-live-axis sub-torus (where the 1D ring
+    is ineligible and ring2d's composed phases were the only topology-aware
+    option)."""
+    from mlsl_tpu.types import ReductionType
+
+    if kind not in ("allreduce", "reduce_scatter"):
+        return False
+    if op not in (None, ReductionType.SUM):
+        return False
+    if not available():
+        return False
+    if ring_axes2(group) is None:
+        return False
+    return 1 < int(group.size) <= MAX_GROUP
+
+
+def eligible_allgather(group: ProcessGroup) -> bool:
+    """Eligibility for the all-gather phase kernel (the ZeRO-1 increment
+    exchange): same ring shape constraints, no reduction op to restrict."""
+    if not available():
+        return False
+    if ring_axis(group) is None:
         return False
     return 1 < int(group.size) <= MAX_GROUP
 
@@ -210,6 +256,13 @@ def inline_ok(group: ProcessGroup) -> bool:
             and ring_axis(group) is not None)
 
 
+def inline_ok2d(group: ProcessGroup) -> bool:
+    """inline_ok for the 2D snake ring: compiled-on-TPU over a 2-live-axis
+    sub-torus (same interpreter restriction as the 1D form)."""
+    return (_on_tpu() and not interpret_mode()
+            and ring_axes2(group) is not None)
+
+
 # ---------------------------------------------------------------------------
 # Geometry
 # ---------------------------------------------------------------------------
@@ -223,6 +276,10 @@ def dense_geometry(kind: str, group: ProcessGroup, count: int) -> Tuple[int, int
         mlsl_assert(count % g == 0,
                     "reduce_scatter count %d %% group %d != 0", count, g)
         rc = count // g
+    elif kind == "all_gather":
+        # count is the PER-MEMBER shard (the ZeRO-1 owned slice); the ring
+        # circulates one chunk per member and the output is g * count
+        rc = count
     else:
         rc = -(-count // g)
     chunk = -(-rc // DENSE_UNIT) * DENSE_UNIT
@@ -303,6 +360,12 @@ def static_accounting(mode: str, g: int, slots: int, *, bidir: bool = False):
             for d in range(ndirs):
                 events.append(("free", d, use_h))
 
+    if mode == "all_gather":       # gather-only: the AG phase stands alone
+        for k in range(hops):
+            slot_wait(k)
+            if k >= 1:
+                slot_free(k - 1)   # an AG slot is re-read by the forward
+        return events, total_hops, ndirs
     for t in range(hops):          # phase 1: ring reduce-scatter
         slot_wait(t)
         slot_free(t)               # an RS slot is consumed the hop it arrives
@@ -336,6 +399,62 @@ def _ring_tables(group: ProcessGroup):
     return pos, right, left
 
 
+def _snake_order(row, a: int, b: int):
+    """Reorder one group instance's member row (major-axis-major, length
+    a*b) along the boustrophedon Hamiltonian cycle of the (a, b) torus:
+    even major rows walk the minor axis ascending, odd rows descending, and
+    the final wraparound hop closes the cycle on the major axis. Every
+    minor-axis link inside a row and the major-axis links between rows are
+    ring edges, so the one ring drives both axes' ICI concurrently."""
+    return [row[i * b + (j if i % 2 == 0 else b - 1 - j)]
+            for i in range(a) for j in range(b)]
+
+
+def _ring_tables_2d(group: ProcessGroup):
+    """``_ring_tables`` over the snake cycle of a 2-live-axis sub-torus:
+    the SAME kernel runs unchanged — only the neighbor addressing differs."""
+    from mlsl_tpu.comm import collectives
+
+    axes2 = ring_axes2(group)
+    mlsl_assert(axes2 is not None,
+                "pallas_ring2d needs a 2-live-axis group (got axes=%s)",
+                group.axes)
+    sizes = collectives._axis_sizes(group.topology.mesh)
+    a, b = int(sizes[axes2[0]]), int(sizes[axes2[1]])
+    rows = collectives._axis_groups_tbl(group)
+    w = group.topology.world_size
+    pos = np.zeros((w,), dtype=np.int32)
+    right = np.zeros((w,), dtype=np.int32)
+    left = np.zeros((w,), dtype=np.int32)
+    for row in rows:
+        mlsl_assert(len(row) == a * b,
+                    "pallas_ring2d group instance has %d members, torus is "
+                    "%dx%d", len(row), a, b)
+        cyc = _snake_order(row, a, b)
+        g = len(cyc)
+        for i, p in enumerate(cyc):
+            pos[p] = i
+            right[p] = cyc[(i + 1) % g]
+            left[p] = cyc[(i - 1) % g]
+    return pos, right, left
+
+
+def _snake_perm(group: ProcessGroup) -> np.ndarray:
+    """Ring-slot -> group-position chunk permutation for the snake cycle:
+    the kernel scatters/gathers chunks by RING position, so the wrapper
+    feeds kernel-chunk i = logical chunk ``perm[i]`` (the group position of
+    the member at ring slot i). With that input order, reduce_scatter lands
+    each member its OWN group-position chunk (the lax placement convention)
+    and allreduce undoes the permutation on the way out."""
+    from mlsl_tpu.comm import collectives
+
+    axes2 = ring_axes2(group)
+    sizes = collectives._axis_sizes(group.topology.mesh)
+    a, b = int(sizes[axes2[0]]), int(sizes[axes2[1]])
+    idx = list(range(a * b))
+    return np.asarray(_snake_order(idx, a, b), dtype=np.int32)
+
+
 # ---------------------------------------------------------------------------
 # The kernel
 # ---------------------------------------------------------------------------
@@ -353,7 +472,7 @@ def _quantize_rows(x):
 
 def _ring_kernel_factory(
     *,
-    mode: str,            # 'allreduce' | 'reduce_scatter'
+    mode: str,            # 'allreduce' | 'reduce_scatter' | 'all_gather'
     G: int,
     rows: int,            # block-rows per chunk
     cols: int,            # lanes per row (the quant block, or 128 dense)
@@ -369,6 +488,9 @@ def _ring_kernel_factory(
     hops = G - 1
     total_hops = hops * (2 if mode == "allreduce" else 1)
     ndirs = len(dirs)
+    mlsl_assert(not (mode == "all_gather" and quantized),
+                "the all_gather phase kernel is dense-only (the ZeRO-1 "
+                "increment exchange carries f32)")
 
     def kernel(pos_ref, right_ref, left_ref, x_ref, out_ref, *scr):
         if quantized:
@@ -434,9 +556,11 @@ def _ring_kernel_factory(
                     )
 
         # ---- init: each direction's travelling partial --------------------
+        # (all_gather: x_ref holds only THIS member's shard — chunk index 0)
         pend = []
         for d, (sign, r0, rl) in enumerate(dirs):
-            pend.append(copy_in(dmod(pos - sign), acc, r0, rl, csem.at[d]))
+            idx = 0 if mode == "all_gather" else dmod(pos - sign)
+            pend.append(copy_in(idx, acc, r0, rl, csem.at[d]))
         for c in pend:
             c.wait()
 
@@ -454,8 +578,8 @@ def _ring_kernel_factory(
                       psend.at[d, slot], precv.at[d, slot], dev)
             return (cf,)
 
-        # ---- phase 1: ring reduce-scatter ---------------------------------
-        for t in range(hops):
+        # ---- phase 1: ring reduce-scatter (skipped by the gather-only mode)
+        for t in ([] if mode == "all_gather" else range(hops)):
             slot = t % slots
             if quantized:
                 # quantize on the way out of VMEM: the send buffer holds the
@@ -522,8 +646,9 @@ def _ring_kernel_factory(
             c.wait()
 
         prev_slot = None
+        base = 0 if mode == "all_gather" else hops
         for k in range(hops):
-            h = hops + k
+            h = base + k
             slot = h % slots
             slot_wait(h)
             inflight = []
@@ -672,9 +797,12 @@ def _world_rank_grid(group: ProcessGroup):
     return lambda: _group_rank(GRID_AXES, sizes)
 
 
-def _scalars(group: ProcessGroup, world_rank: Callable):
-    """(pos, right, left) scalar-prefetch operands for this member."""
-    pos_t, right_t, left_t = _ring_tables(group)
+def _scalars(group: ProcessGroup, world_rank: Callable, snake: bool = False):
+    """(pos, right, left) scalar-prefetch operands for this member. ``snake``
+    addresses the boustrophedon cycle of a 2-live-axis sub-torus instead of
+    the single-axis ring."""
+    pos_t, right_t, left_t = (_ring_tables_2d(group) if snake
+                              else _ring_tables(group))
     w = world_rank()
     take = lambda t: jnp.take(jnp.asarray(t), w)[None]
     return take(pos_t), take(right_t), take(left_t)
@@ -690,19 +818,27 @@ def dense_ring_body(
     slots: Optional[int] = None,
     bidir: Optional[bool] = None,
     world_rank: Optional[Callable] = None,
+    snake: bool = False,
 ) -> Callable:
     """-> local body ``(x) -> out`` for the dense (uncompressed) pallas ring,
     with the standard collectives calling convention: x is the squeezed
-    per-member (count,) buffer, out the allreduce result (count,) or the
-    reduce_scatter slice (recv_count,). ``world_rank`` supplies this
-    member's world rank as a traced value — ``lax.axis_index('world')`` by
-    default (the flat-mesh host program); the overlap engine passes the
-    grid-mesh form."""
+    per-member (count,) buffer, out the allreduce result (count,), the
+    reduce_scatter slice (recv_count,), or the gathered (G*count,) buffer
+    for ``kind='all_gather'`` (where x is this member's shard).
+    ``world_rank`` supplies this member's world rank as a traced value —
+    ``lax.axis_index('world')`` by default (the flat-mesh host program); the
+    overlap engine passes the grid-mesh form. ``snake`` rides the 2D-torus
+    boustrophedon cycle (pallas_ring2d) instead of the single-axis ring."""
     from mlsl_tpu.comm.quant_ring import _to_chunks
 
-    mlsl_assert(ring_axis(group) is not None,
-                "pallas_ring needs a single-live-axis group (got axes=%s)",
-                group.axes)
+    if snake:
+        mlsl_assert(ring_axes2(group) is not None,
+                    "pallas_ring2d needs a 2-live-axis group (got axes=%s)",
+                    group.axes)
+    else:
+        mlsl_assert(ring_axis(group) is not None,
+                    "pallas_ring needs a single-live-axis group (got axes=%s)",
+                    group.axes)
     g, rc, chunk = dense_geometry(kind, group, count)
     mlsl_assert(g > 1, "pallas_ring needs a group with >1 member")
     if kind == "reduce_scatter" and recv_count is not None:
@@ -715,13 +851,33 @@ def dense_ring_body(
                       env_slots(slots), env_bidir(bidir), interpret_mode())
     wr = world_rank or _world_rank_flat
 
+    perm = _snake_perm(group) if snake else None
+
     def body(x):
-        pos, right, left = _scalars(group, wr)
+        pos, right, left = _scalars(group, wr, snake)
+        if kind == "all_gather":
+            xc = _to_chunks(x, 1, rc, chunk)        # (1, chunk) own shard
+            out2d = call(pos, right, left, xc.reshape(rows, cols))
+            outc = out2d.reshape(g, chunk)
+            if perm is not None:
+                # gathered chunks land by RING position: row i holds member
+                # perm[i]'s shard — reorder to group-position (lax) order
+                inv = np.argsort(perm).astype(np.int32)
+                outc = jnp.take(outc, jnp.asarray(inv), axis=0)
+            return outc[:, :rc].reshape(-1)
         xc = _to_chunks(x, g, rc, chunk)            # (g, chunk), dtype kept
+        if perm is not None:
+            # snake cycle: feed chunks in ring order (see _snake_perm)
+            xc = jnp.take(xc, jnp.asarray(perm), axis=0)
         out2d = call(pos, right, left, xc.reshape(g * rows, cols))
         if kind == "reduce_scatter":
             return out2d.reshape(-1)[:rc]
-        return out2d.reshape(g, chunk)[:, :rc].reshape(-1)[:count]
+        outc = out2d.reshape(g, chunk)
+        if perm is not None:
+            # undo the ring-order scatter: logical chunk perm[i] sits at row i
+            inv = np.argsort(perm).astype(np.int32)
+            outc = jnp.take(outc, jnp.asarray(inv), axis=0)
+        return outc[:, :rc].reshape(-1)[:count]
 
     return body
 
@@ -841,13 +997,16 @@ def steps(
     recv_count=None,
     slots: Optional[int] = None,
     bidir: Optional[bool] = None,
+    snake: bool = False,
 ) -> Tuple[Callable, List[Callable], Callable]:
     """The compiled-overlap phase form (rhd.steps/ring2d.steps convention):
     ``(prep, phases, finish)`` with ONE phase — the whole fused ring is a
     single kernel launch, which is exactly the point: the overlap scheduler
     interleaves kernels between layers, and Mosaic owns the intra-kernel
     DMA/codec overlap. Bodies run inside the engine's 4-axis grid shard_map,
-    so the world rank comes from the grid axes (TPU-only: ``inline_ok``)."""
+    so the world rank comes from the grid axes (TPU-only: ``inline_ok``).
+    ``kind='all_gather'`` is the ZeRO-1 increment-exchange phase (no
+    reduction op); ``snake`` selects the 2D-torus cycle (pallas_ring2d)."""
     from mlsl_tpu.types import ReductionType
 
     mlsl_assert(op in (None, ReductionType.SUM),
@@ -855,6 +1014,7 @@ def steps(
     body = dense_ring_body(
         kind, group, count, jnp.float32, recv_count=recv_count,
         slots=slots, bidir=bidir, world_rank=_world_rank_grid(group),
+        snake=snake,
     )
 
     def phase(carry):

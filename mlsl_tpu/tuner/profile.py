@@ -43,6 +43,15 @@ KNOB_RANGES = {
     # may carry a measured double-buffer depth for this machine's ICI; an
     # exported MLSL_PALLAS_RING_SLOTS always wins
     "pallas_ring_slots": 2,
+    # latency-class allreduce payload band (ops/rhd_kernels.py): profiles
+    # may carry the measured rhd/ring crossover in bytes for this fabric
+    # (0 = derive from msg_priority_threshold); an exported
+    # MLSL_PALLAS_RHD_MAX_BYTES always wins
+    "pallas_rhd_max_bytes": 0,
+    # fused-alltoall wire codec (ops/a2a_kernels.py): 1 = int8 blockwise,
+    # 0 = dense f32 variant of the same kernel. Carried as 0/1 (the range
+    # table rejects bools); an exported MLSL_PALLAS_A2A_QUANT always wins
+    "pallas_a2a_quant": 0,
     # compiled-overlap staging depth (comm/overlap.py): profiles may carry
     # the measured number of unit-starts a layer's reduce phases spread
     # over; an exported MLSL_OVERLAP_STAGES always wins

@@ -142,13 +142,20 @@ def run_sweep(
             for size_b in sorted(sizes):
                 # elements padded so reduce_scatter counts divide the group
                 elems = max(-(-(size_b // 4) // G) * G, G)
-                kw = dict(op=ReductionType.SUM)
-                if kind == "reduce_scatter":
-                    kw["recv_count"] = elems // G
+                if kind == "alltoall":
+                    # an exchange, not a reduction: the per-destination
+                    # slice rides send_count and there is no op to sweep
+                    kw = dict(send_count=elems // G)
+                    cand_op = None
+                else:
+                    kw = dict(op=ReductionType.SUM)
+                    if kind == "reduce_scatter":
+                        kw["recv_count"] = elems // G
+                    cand_op = ReductionType.SUM
                 args = (buf_for(elems),)
                 measured = {}
-                for algo in algos.candidates(kind, group, ReductionType.SUM):
-                    if algo == "pallas_ring":
+                for algo in algos.candidates(kind, group, cand_op):
+                    if algo.startswith("pallas"):
                         # never time the interpreter (a correctness vehicle
                         # whose simulated DMAs are world gathers — it can
                         # only lose, at enormous sweep wall-time)

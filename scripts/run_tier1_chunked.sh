@@ -60,6 +60,25 @@ select_changed_files() {
             # a DELETED test file is still listed by the diff; feeding it to
             # pytest would record a spurious failure
             tests/test_*.py) [ -f "$f" ] && echo "$f" ;;
+            # the PR 17 kernel family: each ops kernel module is pinned by
+            # its test_pallas_* twin AND by the analysis accounting mirror
+            # sweep/fixtures — name them explicitly so an import-alias
+            # rename in a test file cannot silently drop the pairing
+            mlsl_tpu/ops/rhd_kernels.py)
+                printf '%s\n' tests/test_pallas_rhd.py tests/test_analysis.py
+                stems="$stems rhd_kernels" ;;
+            mlsl_tpu/ops/a2a_kernels.py)
+                printf '%s\n' tests/test_pallas_a2a.py tests/test_analysis.py
+                stems="$stems a2a_kernels" ;;
+            mlsl_tpu/ops/ring_kernels.py)
+                printf '%s\n' tests/test_pallas_ring.py \
+                    tests/test_analysis.py tests/test_overlap_compiled.py
+                stems="$stems ring_kernels" ;;
+            # known-bad analysis fixtures are exercised only by test_analysis
+            tests/fixtures/*) printf '%s\n' tests/test_analysis.py ;;
+            # bench scripts are pinned by the --smoke subprocess tests that
+            # name them (latency_bench -> test_pallas_rhd, etc.)
+            benchmarks/*.py) stems="$stems $(basename "$f" .py)" ;;
             mlsl_tpu/*.py|mlsl_tpu/*/*.py|mlsl_tpu/*/*/*.py)
                 s=$(basename "$f" .py)
                 # a package __init__ is named by its package (tuner, algos)

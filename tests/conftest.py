@@ -122,6 +122,23 @@ def _route_artifacts(tmp_path, monkeypatch):
     monkeypatch.setenv("MLSL_TRACE_DIR", str(tmp_path))
 
 
+def skip_if_loaded(detail: str) -> None:
+    """Comparative-timing deflake contract (KNOWN_FAILURES.md "Known
+    flakes"): a bench smoke's LIVE timing comparison gets best-of-N inside
+    the bench plus ONE whole-bench retry from the test; if it still fails
+    on a box under external load the comparison is unjudgeable — skip
+    loudly with the load recorded. On an idle box this returns and the
+    caller's assertion fails: that is a genuine regression, not the flake.
+    Functional assertions never route through here — they stay hard."""
+    load1 = os.getloadavg()[0]
+    ncpu = os.cpu_count() or 1
+    if load1 > 0.5 * ncpu:
+        pytest.skip(
+            f"skipped:loadavg {load1:.1f} on {ncpu} cpus - comparative "
+            f"timing unjudgeable under external load ({detail})"
+        )
+
+
 def ref_coords(p, data_parts, model_parts):
     """The reference's rank->color math (src/mlsl_impl.hpp:224-240), used as the
     oracle for grid tests."""

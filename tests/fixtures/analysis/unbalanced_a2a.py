@@ -1,0 +1,31 @@
+"""Known-bad fixture: the unbalanced-semaphore fused all-to-all variant.
+
+Starts from the real kernel's statically-balanced hop trace
+(``ops/a2a_kernels.static_accounting`` — the slot_wait/slot_free emission
+of the G-1 shifted-permutation steps) and removes the final ``free``: the
+variant a refactor would produce by copying the ring's all-gather pattern
+(slots freed one hop LATE, because AG slots are re-read) into the a2a
+kernel, where every recv slot is dequantized into the output the step it
+arrives and never re-read — here the late free of the last reused slot
+simply never fires, and the capacity semaphore exits non-zero.
+
+The verifier's accounting replay must reject this trace with MLSL-A130.
+"""
+
+EXPECTED_CODE = "MLSL-A130"
+
+G = 8       # 7 shifted-permutation steps
+SLOTS = 2
+
+
+def build_trace():
+    """-> (events, kwargs for analysis.plan.verify_hop_trace)."""
+    from mlsl_tpu.ops import a2a_kernels as a2a
+
+    events, total_hops, ndirs = a2a.static_accounting(G, SLOTS)
+    bad = list(events)
+    for i in range(len(bad) - 1, -1, -1):
+        if bad[i][0] == "free":
+            del bad[i]  # the forgotten free of the last reused slot
+            break
+    return bad, dict(slots=SLOTS, ndirs=ndirs, total_hops=total_hops)

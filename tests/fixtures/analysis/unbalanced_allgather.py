@@ -1,0 +1,32 @@
+"""Known-bad fixture: the unbalanced-semaphore gather-only ring variant.
+
+The standalone ``kind='all_gather'`` ring mode is the ZeRO-1 increment
+exchange (comm/overlap.py ``_Zero1Unit``'s second wire phase). Its slots
+follow the all-gather re-read rule — freed one hop AFTER use, because the
+forward still reads them — so the natural refactor bug is the opposite of
+the a2a one: treating AG slots like reduce-scatter slots and freeing them
+the hop they arrive. The trace below models the simplest observable form,
+the dropped final shifted free: the capacity semaphore exits non-zero and
+the NEXT ZeRO-1 layer's gather launch on the same core inherits the
+poisoned count.
+
+The verifier's accounting replay must reject this trace with MLSL-A130.
+"""
+
+EXPECTED_CODE = "MLSL-A130"
+
+G = 8
+SLOTS = 2
+
+
+def build_trace():
+    """-> (events, kwargs for analysis.plan.verify_hop_trace)."""
+    from mlsl_tpu.ops import ring_kernels as rk
+
+    events, total_hops, ndirs = rk.static_accounting("all_gather", G, SLOTS)
+    bad = list(events)
+    for i in range(len(bad) - 1, -1, -1):
+        if bad[i][0] == "free":
+            del bad[i]  # the forgotten shifted free (the forward re-read)
+            break
+    return bad, dict(slots=SLOTS, ndirs=ndirs, total_hops=total_hops)

@@ -1,0 +1,30 @@
+"""Known-bad fixture: the unbalanced-semaphore recursive-halving variant.
+
+Starts from the real kernel's statically-balanced hop trace
+(``ops/rhd_kernels.static_accounting`` — the exact slot_wait/slot_free
+emission of the halving/doubling kernel) and removes the final ``free``
+signal: the variant a refactor would produce if it treated the LAST
+doubling round like the earlier ones — its slot has no later producer, so
+the matching free must still fire to drain the capacity semaphore, and
+forgetting it leaves a poisoned count for the next launch on the core.
+
+The verifier's accounting replay must reject this trace with MLSL-A130.
+"""
+
+EXPECTED_CODE = "MLSL-A130"
+
+G = 8       # 2^k world: 2k pure halving+doubling rounds
+SLOTS = 2
+
+
+def build_trace():
+    """-> (events, kwargs for analysis.plan.verify_hop_trace)."""
+    from mlsl_tpu.ops import rhd_kernels as rhd
+
+    events, total_hops, ndirs = rhd.static_accounting(G, SLOTS)
+    bad = list(events)
+    for i in range(len(bad) - 1, -1, -1):
+        if bad[i][0] == "free":
+            del bad[i]  # the forgotten final-round free
+            break
+    return bad, dict(slots=SLOTS, ndirs=ndirs, total_hops=total_hops)

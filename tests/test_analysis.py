@@ -287,7 +287,7 @@ def test_pallas_accounting_balanced_across_grid():
     bidir) the engine can select — the static accounting contract."""
     from mlsl_tpu.ops import ring_kernels as rk
 
-    for mode in ("allreduce", "reduce_scatter"):
+    for mode in ("allreduce", "reduce_scatter", "all_gather"):
         for g in (2, 3, 4, 8, 64):
             for slots in (2, 3, 8):
                 for bidir in (False, True):
@@ -296,6 +296,27 @@ def test_pallas_accounting_balanced_across_grid():
                     rep = plan_mod.verify_hop_trace(
                         ev, slots=slots, ndirs=nd, total_hops=th)
                     assert not rep.diagnostics, (mode, g, slots, bidir)
+
+
+def test_kernel_family_accounting_balanced_across_grid():
+    """The PR 17 kernel family's own mirrors balance for every (G, slots)
+    the engine can select — recursive halving/doubling (non-2^k fold
+    included) and the fused all-to-all."""
+    from mlsl_tpu.ops import a2a_kernels as a2a
+    from mlsl_tpu.ops import rhd_kernels as rhd
+
+    for g in (2, 3, 4, 5, 6, 8, 12, 64):
+        for slots in (2, 3, 8):
+            ev, th, nd = rhd.static_accounting(g, slots)
+            assert th == rhd.rounds(g)
+            rep = plan_mod.verify_hop_trace(ev, slots=slots, ndirs=nd,
+                                            total_hops=th)
+            assert not rep.diagnostics, ("rhd", g, slots)
+            ev, th, nd = a2a.static_accounting(g, slots)
+            assert th == g - 1
+            rep = plan_mod.verify_hop_trace(ev, slots=slots, ndirs=nd,
+                                            total_hops=th)
+            assert not rep.diagnostics, ("a2a", g, slots)
 
 
 # ---------------------------------------------------------------------------
@@ -341,6 +362,33 @@ def test_fixture_unbalanced_ring_pinned():
     from mlsl_tpu.ops import ring_kernels as rk
 
     ev, th, nd = rk.static_accounting("allreduce", fx.G, fx.SLOTS)
+    assert not plan_mod.verify_hop_trace(
+        ev, slots=fx.SLOTS, ndirs=nd, total_hops=th).diagnostics
+
+
+@pytest.mark.parametrize("name", ["unbalanced_rhd", "unbalanced_a2a",
+                                  "unbalanced_allgather"])
+def test_fixture_unbalanced_kernel_family_pinned(name):
+    """One tampered trace per PR 17 kernel mode (rhd, fused a2a, the
+    gather-only ZeRO-1 ring phase), each rejected with its pinned code —
+    and each fixture's healthy base trace accepted, so the fixture breaks
+    a genuinely balanced emission rather than an already-red one."""
+    fx = load_fixture(name)
+    events, kw = fx.build_trace()
+    rep = plan_mod.verify_hop_trace(events, **kw)
+    assert fx.EXPECTED_CODE in rep.codes(), rep.format()
+    if name == "unbalanced_rhd":
+        from mlsl_tpu.ops import rhd_kernels as impl
+
+        ev, th, nd = impl.static_accounting(fx.G, fx.SLOTS)
+    elif name == "unbalanced_a2a":
+        from mlsl_tpu.ops import a2a_kernels as impl
+
+        ev, th, nd = impl.static_accounting(fx.G, fx.SLOTS)
+    else:
+        from mlsl_tpu.ops import ring_kernels as impl
+
+        ev, th, nd = impl.static_accounting("all_gather", fx.G, fx.SLOTS)
     assert not plan_mod.verify_hop_trace(
         ev, slots=fx.SLOTS, ndirs=nd, total_hops=th).diagnostics
 

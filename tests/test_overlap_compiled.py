@@ -265,6 +265,63 @@ def test_standalone_quant_residual_parity(env):
                                        rtol=1e-6, atol=1e-6)
 
 
+def test_zero1_update_parity(env):
+    """The staged ZeRO-1 two-phase update (reduce-scatter -> owned-shard
+    SGD -> all-gather): bit-exact on integer payloads against the direct
+    replicated update ``p - lr * (sum g) / denom`` across divisible, tiny,
+    and ragged (padded) layer counts, every staging depth, every group
+    shape. lr and denom are powers of two, so the float math is exact and
+    any shard placement or phase-boundary bug is a hard mismatch."""
+    counts = [8 * 96, 13, 8, 100]
+    lr, denom = 0.5, 8.0
+    for topo, axes, tag in _grid_groups(env):
+        group = ProcessGroup(topo, axes)
+        w = topo.world_size
+        rngs = [np.random.default_rng(i) for i, _ in enumerate(counts)]
+        params = [r.integers(-40, 40, size=c).astype(np.float32)
+                  for r, c in zip(rngs, counts)]
+        grads = [r.integers(-8, 8, size=(w, c)).astype(np.float32)
+                 for r, c in zip(rngs, counts)]
+        p_bufs = [topo.shard_buffer(np.tile(p, (w, 1)).reshape(
+            *topo.grid_shape, c)) for p, c in zip(params, counts)]
+        g_bufs = [topo.shard_buffer(g.reshape(*topo.grid_shape, c))
+                  for g, c in zip(grads, counts)]
+        for stages in (1, 3):
+            fn, units = overlap.build_zero1_update(
+                group, counts, lr=lr, denom=denom, config=env.config,
+                stages=stages,
+            )
+            # off-chip no kernel is in-graph emittable: lax phases serve
+            assert [u.algo for u in units] == ["lax"] * len(counts)
+            outs = fn(p_bufs, g_bufs)
+            for c, p, g, o in zip(counts, params, grads, outs):
+                want = p - lr * (g.sum(axis=0) / denom)
+                got = np.asarray(o).reshape(w, c)
+                for i in range(w):  # replicated result, every member
+                    assert np.array_equal(got[i], want), (
+                        f"zero1 on {tag} stages={stages} count={c}")
+
+
+def test_zero1_forced_kernel_falls_back_loudly(env):
+    """A forced pallas algorithm that cannot emit in-graph off-chip must
+    degrade the ZeRO-1 plan to the baseline phases (same loud-fallback
+    contract as build_plan), not crash or silently mis-lower."""
+    topo = Topology(8, 1, devices=env.devices)
+    group = ProcessGroup(topo, ("data",))
+    fn, units = overlap.build_zero1_update(
+        group, [256], lr=0.5, denom=8.0, algo="pallas_ring",
+        config=env.config,
+    )
+    assert [u.algo for u in units] == ["lax"]
+    p = np.tile(np.arange(256, dtype=np.float32) % 9, (8, 1))
+    g = np.ones((8, 256), np.float32)
+    (out,) = fn([topo.shard_buffer(p.reshape(*topo.grid_shape, 256))],
+                [topo.shard_buffer(g.reshape(*topo.grid_shape, 256))])
+    want = p[0] - 0.5 * (8.0 / 8.0)
+    np.testing.assert_array_equal(
+        np.asarray(out).reshape(8, 256)[3], want)
+
+
 # ---------------------------------------------------------------------------
 # chaos / precompile / sentinel / config / stats integration
 # ---------------------------------------------------------------------------

@@ -206,6 +206,87 @@ def test_dense_multi_instance_subgroup(rng, env):
                                       _run(base, topo, vals))
 
 
+# -- 2D-torus snake ring (pallas_ring2d) --------------------------------------
+
+
+def test_snake_eligibility_complement(env):
+    """pallas_ring2d covers EXACTLY the groups the 1D ring refuses: two
+    live axes — and refuses the single-axis groups the 1D ring owns, so
+    the two lowerings never shadow each other in the candidate table."""
+    t2 = Topology(4, 2)
+    both = ProcessGroup(t2, ("data", "model"))
+    one = ProcessGroup(t2, ("data",))
+    assert algos.eligible("pallas_ring2d", "allreduce", both)
+    assert not algos.eligible("pallas_ring", "allreduce", both)
+    assert not algos.eligible("pallas_ring2d", "allreduce", one)
+    assert algos.eligible("pallas_ring", "allreduce", one)
+    assert "pallas_ring2d" in algos.candidates("allreduce", both)
+    assert "pallas_ring" not in algos.candidates("allreduce", both)
+
+
+@pytest.mark.parametrize("n", [8 * 640, 5000])
+@pytest.mark.parametrize("kind", ["allreduce", "reduce_scatter"])
+def test_snake_parity_bitexact_int(rng, env, kind, n):
+    """The boustrophedon cycle over the full (4, 2) torus: same kernel,
+    snake neighbor tables — integer sums stay bit-exact vs lax, padded
+    and chunk-aligned counts alike."""
+    topo = Topology(4, 2)
+    g = ProcessGroup(topo, ("data", "model"))
+    kw = {"op": ReductionType.SUM}
+    if kind == "reduce_scatter":
+        n = -(-n // 8) * 8
+        kw["recv_count"] = n // 8
+    vals = _int_vals(rng, topo, n)
+    base = algos.build(kind, g, np.float32, "lax", **kw)
+    fn = algos.build(kind, g, np.float32, "pallas_ring2d", **kw)
+    np.testing.assert_array_equal(_run(fn, topo, vals), _run(base, topo, vals))
+
+
+def test_snake_request_e2e(env):
+    """Forced through the request engine on a full-torus group: describe()
+    names the algo and the result matches the baseline program."""
+    from mlsl_tpu.comm.request import CommDesc, CommRequest
+    from mlsl_tpu.types import DataType, GroupType
+
+    env.config.collective_algo = "pallas_ring2d"
+    env.config.validate()
+    dist = env.create_distribution(4, 2)
+    n = 1024
+    req = CommRequest(
+        CommDesc("allreduce", dist._group(GroupType.GLOBAL), n,
+                 DataType.FLOAT, op=ReductionType.SUM),
+        env.dispatcher, name="snake",
+    )
+    req.setup()
+    assert req.algo == "pallas_ring2d"
+    assert "hops=" in req._span_args["pallas.hop"]
+    buf = dist.topology.shard_buffer(
+        np.tile(np.arange(n, dtype=np.float32) % 7, (8, 1)).reshape(
+            *dist.topology.grid_shape, n))
+    out = np.asarray(req.start(buf).wait())
+    np.testing.assert_array_equal(
+        out.reshape(8, n)[0], (np.arange(n) % 7) * 8.0)
+
+
+def test_all_gather_kernel_parity(rng, env):
+    """The ZeRO-1 gather phase kind, standalone over the flat mesh — the
+    1D ring AND the 2D snake: every member ends with every member's shard
+    in group-position order (the snake path must undo its ring-order
+    permutation)."""
+    for topo, axes, snake in ((Topology(8, 1), ("data",), False),
+                              (Topology(4, 2), ("data", "model"), True)):
+        group = ProcessGroup(topo, axes)
+        for shard in (640, 130):  # chunk-aligned and padded
+            vals = _int_vals(rng, topo, shard)
+            body = rk.dense_ring_body("all_gather", group, shard,
+                                      np.float32, snake=snake)
+            fn = rk.build_flat_program(body, group, "all_gather")
+            out = _run(fn, topo, vals).reshape(8, 8 * shard)
+            want = vals.reshape(8, shard).reshape(-1)
+            for i in range(8):
+                np.testing.assert_array_equal(out[i], want)
+
+
 # -- quantized parity (the EF oracle) ----------------------------------------
 
 

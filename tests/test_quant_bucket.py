@@ -431,7 +431,15 @@ def test_quant_bucket_bench_smoke():
     """Tier-1 wiring for benchmarks/quant_bucket_bench.py: the smoke rows must
     parse, and the ResNet-50-shaped quantized stream (161 tensors) must show
     the coalesced compressed ring beating the per-layer compressed rings on
-    aggregate step comm time on the CPU-mesh proof backend."""
+    aggregate step comm time on the CPU-mesh proof backend.
+
+    The functional assertions (rows parse, stream shape, coalescing engaged)
+    are HARD on every run. The speedup comparison is live timing (best-of-N
+    inside the bench): it gets one whole-bench retry, and a still-failing
+    comparison on a loaded box skips loudly instead of coin-flipping
+    (conftest.skip_if_loaded, KNOWN_FAILURES.md "Known flakes")."""
+    from conftest import skip_if_loaded
+
     repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
     env_vars = dict(
         os.environ,
@@ -439,16 +447,29 @@ def test_quant_bucket_bench_smoke():
         JAX_PLATFORMS="cpu",
         XLA_FLAGS="--xla_force_host_platform_device_count=8",
     )
-    out = subprocess.run(
-        [sys.executable, os.path.join(repo, "benchmarks", "quant_bucket_bench.py"),
-         "--smoke"],
-        capture_output=True, text=True, timeout=540, env=env_vars, cwd=repo,
-    )
-    assert out.returncode == 0, out.stderr[-2000:]
-    rows = [json.loads(l) for l in out.stdout.splitlines() if l.startswith("{")]
-    algbw = [r for r in rows if r["metric"] == "quant_bucket_algbw"]
-    assert len(algbw) >= 2  # smoke sizes x {plain, quant}
-    rn = [r for r in rows if r["metric"] == "quant_bucket_resnet50_stream"]
-    assert len(rn) == 1 and rn[0]["tensors"] >= 160
-    assert rn[0]["bucketed_members"] >= 150  # coalescing actually engaged
-    assert rn[0]["speedup"] > 1.0, rn[0]
+
+    def run():
+        out = subprocess.run(
+            [sys.executable,
+             os.path.join(repo, "benchmarks", "quant_bucket_bench.py"),
+             "--smoke"],
+            capture_output=True, text=True, timeout=540, env=env_vars,
+            cwd=repo,
+        )
+        assert out.returncode == 0, out.stderr[-2000:]
+        rows = [json.loads(l) for l in out.stdout.splitlines()
+                if l.startswith("{")]
+        algbw = [r for r in rows if r["metric"] == "quant_bucket_algbw"]
+        assert len(algbw) >= 2  # smoke sizes x {plain, quant}
+        rn = [r for r in rows
+              if r["metric"] == "quant_bucket_resnet50_stream"]
+        assert len(rn) == 1 and rn[0]["tensors"] >= 160
+        assert rn[0]["bucketed_members"] >= 150  # coalescing engaged
+        return rn[0]
+
+    rn = run()
+    if rn["speedup"] <= 1.0:
+        rn = run()  # one retry: a fresh best-of-N measurement
+    if rn["speedup"] <= 1.0:
+        skip_if_loaded(f"bucketed speedup {rn['speedup']}")
+    assert rn["speedup"] > 1.0, rn

@@ -51,7 +51,7 @@ def test_run_sweep_produces_cells_and_knobs():
     prof = tuner.run_sweep(sizes=TINY_SIZES, iters=2)
     assert prof.fingerprint == sysinfo.topology_fingerprint()
     kinds = {c["kind"] for c in prof.cells}
-    assert kinds == {"allreduce", "reduce_scatter"}
+    assert kinds == {"allreduce", "reduce_scatter", "alltoall"}
     shapes = {tuple(c["shape"]) for c in prof.cells}
     assert (8,) in shapes and (4, 2) in shapes
     for c in prof.cells:
