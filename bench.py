@@ -389,6 +389,12 @@ def main():
     # the record with an explicit backend tag either way.
     hier_vs_flat, hier_backend = _hier_probe_cpu_mesh()
 
+    # Serving-plane row (mlsl_tpu/serve): offered-load tokens/s and TTFT
+    # p50 from benchmarks/serving_bench.py --smoke on the CPU proof mesh,
+    # plus the chaos degraded-not-down verdict — same explicit-tag
+    # contract as the hier/overlap probes.
+    serve_row, serve_backend = _serve_probe_cpu_mesh()
+
     # Achieved TFLOP/s and MFU for the framework step. FLOPs come from XLA's own
     # cost model on the compiled baseline step (identical math to the framework
     # step); peak from the device kind.
@@ -469,6 +475,12 @@ def main():
         "transformer_step_ms": round(tfm_ms, 3) if tfm_ms else None,
         "transformer_mfu_model": (round(tfm_mfu_model, 4)
                                   if tfm_mfu_model else None),
+        "serve_tokens_per_s": (serve_row or {}).get("tokens_per_s"),
+        "serve_ttft_p50_ms": ((serve_row or {}).get("ttft_ms") or {}).get("p50"),
+        "serve_chaos_degraded_not_down": (
+            (serve_row or {}).get("chaos_degraded_not_down")
+        ),
+        "serve_backend": serve_backend,
         "device": device_kind,
     }
     print(json.dumps(result))
@@ -721,6 +733,60 @@ def _hier_probe_cpu_mesh(timeout: float = 900.0):
     except Exception as e:
         reason = repr(e)[:160]
     print(f"bench: hier probe failed ({reason})", file=sys.stderr)
+    return None, f"skipped:{reason}"
+
+
+def _serve_probe_cpu_mesh(timeout: float = 900.0):
+    """-> (serving row dict or None, backend tag — NEVER None). Runs
+    benchmarks/serving_bench.py --smoke on the 8-dev CPU proof mesh and
+    merges its load row with the parity row's chaos verdict. Same
+    explicit-tag contract as the hier probe: a probe that cannot produce
+    numbers records WHY."""
+    import subprocess
+
+    env_vars = dict(
+        os.environ,
+        JAX_PLATFORMS="cpu",
+        MLSL_TPU_PLATFORM="cpu",
+        XLA_FLAGS=(os.environ.get("XLA_FLAGS", "")
+                   + " --xla_force_host_platform_device_count=8").strip(),
+    )
+    for k in ("MLSL_CHAOS", "MLSL_WATCHDOG_TIMEOUT", "MLSL_TRACE",
+              "MLSL_TUNE", "MLSL_TUNE_PROFILE", "MLSL_ALGO",
+              "MLSL_MESH_TIERS"):
+        env_vars.pop(k, None)
+    here = os.path.dirname(os.path.abspath(__file__))
+    reason = "unknown"
+    try:
+        out = subprocess.run(
+            [sys.executable,
+             os.path.join(here, "benchmarks", "serving_bench.py"), "--smoke"],
+            capture_output=True, text=True, timeout=timeout, env=env_vars,
+            cwd=here,
+        )
+        row = parity = None
+        for line in out.stdout.splitlines():
+            try:
+                r = json.loads(line)
+            except ValueError:
+                continue
+            if r.get("metric") == "serving_bench":
+                row = r
+            elif r.get("metric") == "serving_bench_parity":
+                parity = r
+        if row is not None:
+            if parity is not None:
+                row["chaos_degraded_not_down"] = parity.get(
+                    "chaos_degraded_not_down")
+            return row, "cpu-mesh-sim"
+        tail = (out.stderr or "").strip().splitlines()
+        reason = (f"no-row rc={out.returncode}"
+                  + (f" {tail[-1][:120]}" if tail else ""))
+    except subprocess.TimeoutExpired:
+        reason = f"timeout {timeout:.0f}s"
+    except Exception as e:
+        reason = repr(e)[:160]
+    print(f"bench: serve probe failed ({reason})", file=sys.stderr)
     return None, f"skipped:{reason}"
 
 

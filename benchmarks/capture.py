@@ -151,6 +151,11 @@ def main():
              [sys.executable, "benchmarks/grid_collectives.py"], 1200),
             ("transformer",
              [sys.executable, "benchmarks/transformer_bench.py"], 2400),
+            # serving plane (mlsl_tpu/serve): full offered-load grid —
+            # tokens/s, TTFT/TPOT tails, the chaos degraded-not-down row,
+            # and the paged-vs-unpaged parity gate (docs/TUNING.md §21)
+            ("serving",
+             [sys.executable, "benchmarks/serving_bench.py"], 2400),
         ]
 
     record = {

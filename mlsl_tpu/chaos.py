@@ -114,6 +114,18 @@ SITES: Dict[str, str] = {
                       "broadcast (control/plane.py): error/delay/hang "
                       "model a lost notice, a late drain order, and a "
                       "partitioned leader",
+    # Serving-engine faults (serve/engine.py): fired inside the scheduler
+    # loop. admit fires per admission attempt (error = a request the
+    # engine must reject-not-crash); decode fires per decode step on the
+    # in-flight batch — error/delay/hang model a failed, late, and wedged
+    # decode program, the tail-latency quarry the SLA ladder must absorb
+    # (degraded throughput, never lost availability). An error classified
+    # DEVICE_LOSS models replica loss mid-serve.
+    "serve.admit": "admission attempt (serve/engine.py): error = a "
+                   "request the engine must fail closed, not crash on",
+    "serve.decode": "decode step over the in-flight batch "
+                    "(serve/engine.py): error/delay/hang = failed, "
+                    "late, wedged decode; DEVICE_LOSS = replica loss",
 }
 
 KINDS = ("error", "delay", "hang", "bitrot", "silent")

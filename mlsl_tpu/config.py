@@ -55,6 +55,10 @@ _ENV_FIELDS = {
     "MLSL_METRICS_EVERY": "metrics_every",
     "MLSL_STRAGGLER_EVERY": "straggler_every",
     "MLSL_HEARTBEAT_MISSES": "heartbeat_misses",
+    "MLSL_SERVE_MAX_BATCH": "serve_max_batch",
+    "MLSL_SERVE_KV_PAGE_ELEMS": "serve_kv_page_elems",
+    "MLSL_SERVE_KV_CACHE_MB": "serve_kv_cache_mb",
+    "MLSL_SERVE_QUEUE_DEPTH": "serve_queue_depth",
 }
 
 
@@ -199,6 +203,29 @@ class Config:
     feed_depth: int = 2             # MLSL_FEED_DEPTH
     # TRANSIENT source-read retries per batch (supervisor taxonomy, rung 2).
     feed_retries: int = 2           # MLSL_FEED_RETRIES
+
+    # --- serving engine (mlsl_tpu.serve; docs/TUNING.md §21) ---
+    # Decode-slot ceiling for the in-flight continuous batch. New sequences
+    # join at decode-step granularity up to this many slots; the SLA ladder
+    # sheds below it under pressure. Tunable via a tuner profile — an
+    # exported env var always wins (the Config._explicit contract).
+    serve_max_batch: int = 8        # MLSL_SERVE_MAX_BATCH
+    # Tokens per KV page: the paged-cache allocation granularity. Small
+    # pages waste less HBM on short tails but grow the page tables; sized
+    # by the tuner, an exported env always wins.
+    serve_kv_page_elems: int = 16   # MLSL_SERVE_KV_PAGE_ELEMS
+    # HBM budget (MiB) for the paged KV cache (global logical bytes, the
+    # FeedCache accounting contract). Caps total pages; admissions that
+    # cannot get pages are refused or trigger eviction of finished tails.
+    serve_kv_cache_mb: int = 64     # MLSL_SERVE_KV_CACHE_MB
+    # Admission queue depth: requests waiting beyond the in-flight batch.
+    # Over it, submit() rejects 429-style with a retry-after hint instead
+    # of queueing unboundedly (the AsyncLoader backpressure contract).
+    serve_queue_depth: int = 32     # MLSL_SERVE_QUEUE_DEPTH
+    # Store KV pages int8-blockwise (ops/quant_kernels codec) instead of
+    # full width: ~4x more tokens per HBM byte at a bounded dequantize
+    # error; also what SLA ladder rung 2 switches on under pressure.
+    serve_kv_quant: bool = False    # MLSL_SERVE_KV_QUANT
 
     # --- compression ---
     quant_block_elems: int = 256
@@ -575,6 +602,26 @@ class Config:
             "MLSL_FEED_RETRIES must be >= 0 (got %d)", self.feed_retries,
         )
         mlsl_assert(
+            self.serve_max_batch >= 1,
+            "MLSL_SERVE_MAX_BATCH must be >= 1 (got %d)",
+            self.serve_max_batch,
+        )
+        mlsl_assert(
+            self.serve_kv_page_elems >= 1,
+            "MLSL_SERVE_KV_PAGE_ELEMS must be >= 1 (got %d)",
+            self.serve_kv_page_elems,
+        )
+        mlsl_assert(
+            self.serve_kv_cache_mb >= 1,
+            "MLSL_SERVE_KV_CACHE_MB must be >= 1 — a zero-page cache "
+            "cannot admit any sequence (got %d)", self.serve_kv_cache_mb,
+        )
+        mlsl_assert(
+            self.serve_queue_depth >= 1,
+            "MLSL_SERVE_QUEUE_DEPTH must be >= 1 (got %d)",
+            self.serve_queue_depth,
+        )
+        mlsl_assert(
             self.verify_severity in ("error", "warn"),
             "MLSL_VERIFY_SEVERITY must be 'error' or 'warn' (got %r)",
             self.verify_severity,
@@ -701,6 +748,14 @@ class Config:
         c.feed_cache_mb = _env_int("MLSL_FEED_CACHE_MB", c.feed_cache_mb)
         c.feed_depth = _env_int("MLSL_FEED_DEPTH", c.feed_depth)
         c.feed_retries = _env_int("MLSL_FEED_RETRIES", c.feed_retries)
+        c.serve_max_batch = _env_int("MLSL_SERVE_MAX_BATCH", c.serve_max_batch)
+        c.serve_kv_page_elems = _env_int("MLSL_SERVE_KV_PAGE_ELEMS",
+                                         c.serve_kv_page_elems)
+        c.serve_kv_cache_mb = _env_int("MLSL_SERVE_KV_CACHE_MB",
+                                       c.serve_kv_cache_mb)
+        c.serve_queue_depth = _env_int("MLSL_SERVE_QUEUE_DEPTH",
+                                       c.serve_queue_depth)
+        c.serve_kv_quant = _env_bool("MLSL_SERVE_KV_QUANT", c.serve_kv_quant)
         c.overlap_compiled = _env_bool("MLSL_OVERLAP_COMPILED", c.overlap_compiled)
         c.overlap_stages = _env_int("MLSL_OVERLAP_STAGES", c.overlap_stages)
         c.quant_block_elems = _env_int("MLSL_QUANT_BLOCK_ELEMS", c.quant_block_elems)

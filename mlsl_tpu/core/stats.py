@@ -507,6 +507,55 @@ def reset_feed_counters() -> None:
         FEED_COUNTERS[k] = 0 if isinstance(FEED_COUNTERS[k], int) else 0.0
 
 
+# Serving-engine accounting (mlsl_tpu.serve): process-wide like the feed
+# counters — the engine admits requests from caller threads with no Session
+# handle. Admission outcomes, decode progress, KV paging churn, and SLA
+# ladder transitions; Statistics.print_ renders the totals as the SERVE line
+# in mlsl_stats.log, and obs/metrics.sample_families snapshots them onto
+# /metrics as mlsl_serve_* gauges.
+SERVE_COUNTERS: Dict[str, float] = {
+    "admitted": 0,        # requests accepted into the admission queue
+    "rejected": 0,        # 429-style admission rejections (ladder rung 3)
+    "completed": 0,       # sequences that finished (eos or max_tokens)
+    "failed": 0,          # sequences abandoned by a non-retryable fault
+    "prefills": 0,        # prefill programs launched
+    "decode_steps": 0,    # iteration-level decode steps over the batch
+    "tokens_out": 0,      # total generated tokens across all sequences
+    "retries": 0,         # TRANSIENT decode-step retries (rung 2)
+    "kv_pages_alloc": 0,  # KV pages taken off the free-list
+    "kv_pages_freed": 0,  # KV pages returned on retirement
+    "kv_evictions": 0,    # sequences evicted to reclaim pages under pressure
+    "kv_rejects": 0,      # admissions refused for want of KV pages
+    "shed_batch": 0,      # SLA ladder: batch-size sheds (rung 1)
+    "shed_precision": 0,  # SLA ladder: KV-precision sheds (rung 2)
+    "shed_admission": 0,  # SLA ladder: admission-shedding entries (rung 3)
+    "recoveries": 0,      # ladder steps back toward healthy
+}
+
+
+def record_serve(event: str, n: int = 1) -> None:
+    """One serving-engine event (see SERVE_COUNTERS keys)."""
+    SERVE_COUNTERS[event] += n
+
+
+def record_serve_shed(rung: str, detail: str = "") -> None:
+    """One SLA-ladder transition ('batch' / 'precision' / 'admission' /
+    'recovery'): counted, and appended as an immediate SERVE line — the
+    degraded-not-down story must be readable from mlsl_stats.log."""
+    key = "recoveries" if rung == "recovery" else f"shed_{rung}"
+    SERVE_COUNTERS[key] += 1
+    try:
+        with open(stats_path(), "a") as f:
+            f.write(f"{'SERVE':<16} {rung.upper():<10} {detail}\n")
+    except OSError:
+        pass
+
+
+def reset_serve_counters() -> None:
+    for k in SERVE_COUNTERS:
+        SERVE_COUNTERS[k] = 0 if isinstance(SERVE_COUNTERS[k], int) else 0.0
+
+
 # Per-algorithm dispatch accounting (comm/algos): process-wide like the
 # bucket counters — dispatch fires at the request layer with no Session
 # handle. Key = (kind, algorithm name); value = launches. The point: traces
@@ -1020,6 +1069,26 @@ class Statistics:
                 f"drain_decisions {cc['drain_decisions']} "
                 f"drains {cc['drains_executed']} evicted {cc['evicted']}"
             )
+        vc = SERVE_COUNTERS
+        if any(vc.values()):
+            # the serving story: admission vs rejection, decode progress,
+            # KV paging churn, and every SLA shed — one grep ('SERVE')
+            # answers "did this engine stay inside its SLO, and at what cost"
+            lines.append(
+                f"{'SERVE':<16} {'ENGINE':<10} "
+                f"admitted {int(vc['admitted'])} "
+                f"rejected {int(vc['rejected'])} "
+                f"completed {int(vc['completed'])} "
+                f"failed {int(vc['failed'])} "
+                f"tokens {int(vc['tokens_out'])} "
+                f"steps {int(vc['decode_steps'])} "
+                f"retries {int(vc['retries'])} "
+                f"kv {int(vc['kv_pages_alloc'])}a/{int(vc['kv_pages_freed'])}f/"
+                f"{int(vc['kv_evictions'])}e/{int(vc['kv_rejects'])}r "
+                f"sheds {int(vc['shed_batch'])}b/{int(vc['shed_precision'])}p/"
+                f"{int(vc['shed_admission'])}a "
+                f"recoveries {int(vc['recoveries'])}"
+            )
         kc = CHKP_COUNTERS
         if any(kc.values()):
             lines.append(
@@ -1054,6 +1123,10 @@ class Statistics:
                      # members (or this rank was evicted by it)
                      else bool(st.get("dead")) or st.get("evicted")
                      if name == "control"
+                     # serve's healthy vocabulary is 'off'/'healthy': list
+                     # only when the SLA ladder actually shed a rung
+                     else st["state"] not in ("off", "healthy")
+                     if name == "serve"
                      else st.get("trips") or st["state"] != supervisor.CLOSED)
             )
             fb = " ".join(

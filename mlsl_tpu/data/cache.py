@@ -16,6 +16,12 @@ keeps streaming over the wire.
 Budget accounting uses global logical bytes (`.nbytes` over the sharded wire
 arrays); per-device HBM is that divided by the data-parallel degree for
 batch-sharded leaves.
+
+:class:`AdmissionBudget` is the accounting core, factored out so the paged
+KV cache (serve/kv_cache.py) rides the same admit-or-reject contract —
+serving breaks the replay-touches-everything-once assumption above, so the
+KV side adds a free-list and eviction ON TOP of this budget rather than
+changing the feed cache's admission-capped policy.
 """
 
 from __future__ import annotations
@@ -27,16 +33,39 @@ import jax
 from mlsl_tpu.obs import tracer as obs_trace
 
 
-class FeedCache:
+class AdmissionBudget:
+    """Byte-budget admission accounting: admit-or-reject against a fixed
+    budget, with release for allocators that free. The feed cache never
+    releases (admission-capped by design); the paged KV cache does, on
+    sequence retirement and eviction."""
+
+    def __init__(self, budget_bytes: int):
+        self.budget_bytes = int(budget_bytes)
+        self.bytes = 0
+        self.rejects = 0
+
+    def admit(self, nbytes: int) -> bool:
+        """Reserve ``nbytes`` if the budget allows; False = rejected (and
+        counted — a rejected admission is news, a granted one is not)."""
+        if self.bytes + nbytes > self.budget_bytes:
+            self.rejects += 1
+            return False
+        self.bytes += nbytes
+        return True
+
+    def release(self, nbytes: int) -> None:
+        """Return ``nbytes`` to the budget (KV retirement/eviction path)."""
+        self.bytes = max(0, self.bytes - nbytes)
+
+
+class FeedCache(AdmissionBudget):
     """Wire-batch cache keyed by position-in-epoch."""
 
     def __init__(self, budget_mb: float):
-        self.budget_bytes = int(budget_mb * (1 << 20))
+        super().__init__(int(budget_mb * (1 << 20)))
         self._slots: Dict[int, object] = {}
-        self.bytes = 0
         self.hits = 0
         self.misses = 0
-        self.rejects = 0
 
     def __len__(self) -> int:
         return len(self._slots)
@@ -68,12 +97,10 @@ class FeedCache:
         nbytes = sum(
             leaf.nbytes for leaf in jax.tree.leaves(wire_batch)
         )
-        if self.bytes + nbytes > self.budget_bytes:
-            self.rejects += 1
+        if not self.admit(nbytes):
             stats.record_feed_cache("reject")
             return False
         self._slots[key] = wire_batch
-        self.bytes += nbytes
         return True
 
     def complete(self, n: Optional[int]) -> bool:

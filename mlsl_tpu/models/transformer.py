@@ -315,6 +315,200 @@ def local_loss(params, tokens, labels, cfg, sp, tp, comm=None):
     return jnp.sum(ce), aux
 
 
+# -- decode mode (mlsl_tpu.serve): prefill + paged single-token steps ---------
+#
+# The serving engine (serve/engine.py) compiles these bodies as model-axis
+# shard_map programs (dp = sp = 1): a per-sequence prefill, and the batched
+# decode step over the paged KV pool. KV pages shard over 'model' on the
+# heads dim (the wqkv spec); TP output reductions route through the
+# collective engine's selection table (algos.inline_allreduce) when a
+# (model group, config) pair is passed, so the µs-class decode allreduces
+# are pallas_rhd-eligible and breaker degradation to lax stays intact.
+#
+# Bit-exactness contract (tests/test_serve.py): attention math runs in f32
+# over f32-at-rest KV in BOTH paths, and the engine pins the paged decode's
+# gathered-context extent (max_pages * page_elems) to the prefill length, so
+# every reduction has the same extent in both programs — masked-out page
+# slots contribute exact float zeros and the paged step reproduces the
+# unpaged full-context forward bit for bit.
+
+
+def _decode_reduce(x, tp: int, comm):
+    """TP output reduction for the decode path: selection-table routed when
+    a (model group, config) pair is supplied, lax baseline otherwise."""
+    if tp <= 1:
+        return x
+    if comm is not None:
+        from mlsl_tpu.comm import algos
+
+        return algos.inline_allreduce(
+            x, MODEL_AXIS, group=comm[0], config=comm[1]
+        )
+    return lax.psum(x, MODEL_AXIS)
+
+
+def _causal_attn_f32(q, k, v, scale):
+    """Plain causal attention on one sequence (sp=1): (Hl, S, Dh) f32 ->
+    (Hl, S, Dh) f32. The prefill twin of the decode step's masked softmax."""
+    s = jnp.einsum("hsx,htx->hst", q * scale, k)
+    n = q.shape[1]
+    mask = jnp.tril(jnp.ones((n, n), bool))
+    s = jnp.where(mask[None], s, -jnp.inf)
+    return jnp.einsum("hst,htx->hsx", jax.nn.softmax(s, axis=-1), v)
+
+
+def kv_block_quant(x):
+    """Symmetric int8 over the trailing (head_dim) lane dim — the
+    ops/quant_kernels blockwise-ref contract with block = head_dim, applied
+    per (token, head) row. Returns (q int8, scales f32 without the lane
+    dim); dequantize is ``q * scales[..., None]`` (the dequantize oracle
+    tests/test_serve.py pins against)."""
+    amax = jnp.max(jnp.abs(x), axis=-1)
+    scale = jnp.where(amax == 0, 1.0, amax / 127.0).astype(jnp.float32)
+    q = jnp.clip(
+        jnp.round(x / scale[..., None]), -127, 127
+    ).astype(jnp.int8)
+    return q, scale
+
+
+def prefill_local(params, tokens, length, cfg: TransformerConfig, tp: int,
+                  comm=None, dtype=None):
+    """Decode-mode prefill over one sequence (call inside shard_map).
+
+    tokens: (S,) int32, padded past ``length`` with any value — padded
+    positions' K/V are computed but land on the KV cache's reserved garbage
+    page (serve/kv_cache.py) and are masked out of every decode read.
+    Returns (next-token logits (V,) f32 read at position length-1,
+    k, v: (n_blocks, S, Hl, Dh) f32 local head shards).
+    """
+    mlsl_assert(cfg.n_experts == 0, "decode mode serves dense-MLP models")
+    mlsl_assert(not cfg.sharded_vocab,
+                "decode mode serves a replicated LM head")
+    cdt = jnp.dtype(dtype or cfg.dtype)
+    emb = params["embed"]
+    n = tokens.shape[0]
+    h = (emb["tok"][tokens] + emb["pos"][:n]).astype(cdt)
+    scale = 1.0 / float(np.sqrt(cfg.head_dim))
+    ks, vs = [], []
+    for i in range(cfg.n_blocks):
+        lnp = params[f"blk{i}.ln"]
+        ap = params[f"blk{i}.attn"]
+        mp = params[f"blk{i}.mlp"]
+        a = _ln(h.astype(jnp.float32),
+                lnp["ln1_scale"], lnp["ln1_bias"]).astype(cdt)
+        qkv = jnp.einsum("sd,dchx->cshx", a, ap["wqkv"].astype(cdt))
+        q, k, v = (
+            jnp.moveaxis(qkv[c], 1, 0).astype(jnp.float32) for c in range(3)
+        )  # (Hl, S, Dh) f32 — the at-rest KV dtype
+        ks.append(jnp.moveaxis(k, 0, 1))   # (S, Hl, Dh): page layout
+        vs.append(jnp.moveaxis(v, 0, 1))
+        attn = _causal_attn_f32(q, k, v, scale)
+        o = mxu_einsum("hsx,hxd->sd", attn.astype(cdt), ap["wo"].astype(cdt))
+        o = _decode_reduce(o, tp, comm)
+        h = (h.astype(jnp.float32) + o).astype(cdt)
+
+        a = _ln(h.astype(jnp.float32),
+                lnp["ln2_scale"], lnp["ln2_bias"]).astype(cdt)
+        f = jax.nn.gelu(
+            jnp.einsum("sd,df->sf", a, mp["w1"].astype(cdt))
+            + mp["b1"].astype(cdt)
+        )
+        o = mxu_einsum("sf,fd->sd", f, mp["w2"].astype(cdt))
+        o = _decode_reduce(o, tp, comm)
+        h = (h.astype(jnp.float32) + o + mp["b2"]).astype(cdt)
+
+    fin = params["final"]
+    h = _ln(h.astype(jnp.float32), fin["ln_scale"], fin["ln_bias"])
+    last = lax.dynamic_slice_in_dim(h, length - 1, 1, axis=0)[0]
+    logits = last @ fin["head"].astype(jnp.float32)
+    return logits, jnp.stack(ks), jnp.stack(vs)
+
+
+def decode_local(params, tokens, positions, pt, kpool, vpool,
+                 cfg: TransformerConfig, tp: int, comm=None, dtype=None,
+                 kscale=None, vscale=None):
+    """One continuous-batching decode step (call inside shard_map).
+
+    tokens: (B,) int32 the token each slot feeds; positions: (B,) int32 the
+    index that token occupies (its K/V is written there, and it attends over
+    indices <= it); pt: (B, M) int32 page tables (0 = the reserved garbage
+    page — inactive slots carry all-zero tables and positions and their
+    writes land there); kpool/vpool: (n_blocks, Np, page, Hl, Dh) KV pools,
+    int8 with kscale/vscale (n_blocks, Np, page, Hl) for the quantized
+    variant (kv_block_quant codec). Returns (logits (B, V) f32, kpool,
+    vpool[, kscale, vscale]) — the engine donates the pools.
+    """
+    mlsl_assert(cfg.n_experts == 0, "decode mode serves dense-MLP models")
+    mlsl_assert(not cfg.sharded_vocab,
+                "decode mode serves a replicated LM head")
+    cdt = jnp.dtype(dtype or cfg.dtype)
+    quant = kscale is not None
+    page = kpool.shape[2]
+    t_ctx = pt.shape[1] * page
+    emb = params["embed"]
+    h = (emb["tok"][tokens] + emb["pos"][positions]).astype(cdt)  # (B, dm)
+    scale = 1.0 / float(np.sqrt(cfg.head_dim))
+    b = tokens.shape[0]
+    pages_b = jnp.take_along_axis(
+        pt, (positions // page)[:, None], axis=1
+    )[:, 0]                                                       # (B,)
+    offs_b = positions % page
+    mask = jnp.arange(t_ctx)[None, :] <= positions[:, None]       # (B, T)
+    for i in range(cfg.n_blocks):
+        lnp = params[f"blk{i}.ln"]
+        ap = params[f"blk{i}.attn"]
+        mp = params[f"blk{i}.mlp"]
+        a = _ln(h.astype(jnp.float32),
+                lnp["ln1_scale"], lnp["ln1_bias"]).astype(cdt)
+        qkv = jnp.einsum("bd,dchx->bchx", a, ap["wqkv"].astype(cdt))
+        q = qkv[:, 0].astype(jnp.float32)                         # (B, Hl, Dh)
+        knew = qkv[:, 1].astype(jnp.float32)
+        vnew = qkv[:, 2].astype(jnp.float32)
+        if quant:
+            kq, ksc = kv_block_quant(knew)
+            vq, vsc = kv_block_quant(vnew)
+            kpool = kpool.at[i, pages_b, offs_b].set(kq)
+            vpool = vpool.at[i, pages_b, offs_b].set(vq)
+            kscale = kscale.at[i, pages_b, offs_b].set(ksc)
+            vscale = vscale.at[i, pages_b, offs_b].set(vsc)
+            kseq = kpool[i][pt].astype(jnp.float32) \
+                * kscale[i][pt][..., None]
+            vseq = vpool[i][pt].astype(jnp.float32) \
+                * vscale[i][pt][..., None]
+        else:
+            kpool = kpool.at[i, pages_b, offs_b].set(knew)
+            vpool = vpool.at[i, pages_b, offs_b].set(vnew)
+            kseq = kpool[i][pt]                 # (B, M, page, Hl, Dh)
+            vseq = vpool[i][pt]
+        kseq = kseq.reshape(b, t_ctx, *kseq.shape[-2:])           # (B, T, Hl, Dh)
+        vseq = vseq.reshape(b, t_ctx, *vseq.shape[-2:])
+        s = jnp.einsum("bhx,bthx->bht", q * scale, kseq)
+        s = jnp.where(mask[:, None, :], s, -jnp.inf)
+        attn = jnp.einsum(
+            "bht,bthx->bhx", jax.nn.softmax(s, axis=-1), vseq
+        )                                                         # (B, Hl, Dh)
+        o = mxu_einsum("bhx,hxd->bd", attn.astype(cdt), ap["wo"].astype(cdt))
+        o = _decode_reduce(o, tp, comm)
+        h = (h.astype(jnp.float32) + o).astype(cdt)
+
+        a = _ln(h.astype(jnp.float32),
+                lnp["ln2_scale"], lnp["ln2_bias"]).astype(cdt)
+        f = jax.nn.gelu(
+            jnp.einsum("bd,df->bf", a, mp["w1"].astype(cdt))
+            + mp["b1"].astype(cdt)
+        )
+        o = mxu_einsum("bf,fd->bd", f, mp["w2"].astype(cdt))
+        o = _decode_reduce(o, tp, comm)
+        h = (h.astype(jnp.float32) + o + mp["b2"]).astype(cdt)
+
+    fin = params["final"]
+    h = _ln(h.astype(jnp.float32), fin["ln_scale"], fin["ln_bias"])
+    logits = h @ fin["head"].astype(jnp.float32)
+    if quant:
+        return logits, kpool, vpool, kscale, vscale
+    return logits, kpool, vpool
+
+
 class HybridTrainer:
     """dp x sp x tp training with per-layer MLSL gradient sync over data x seq."""
 

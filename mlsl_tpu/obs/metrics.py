@@ -312,6 +312,7 @@ class MetricsRegistry:
             ("analysis", st.ANALYSIS_COUNTERS),
             ("chkp", st.CHKP_COUNTERS),
             ("straggler", st.STRAGGLER_COUNTERS),
+            ("serve", st.SERVE_COUNTERS),
         ):
             for k, v in d.items():
                 self.set(f"mlsl_{fam}_{k}", float(v))

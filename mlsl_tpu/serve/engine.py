@@ -1,0 +1,617 @@
+"""Continuous-batching inference engine on the training stack.
+
+One :class:`InferenceEngine` owns a 1 x tp slice of the mesh (dp = sp = 1 —
+serving replicates across engines, not inside one), the sharded parameter
+tree (the HybridTrainer device_put idiom), the paged KV pools as donated
+device arrays, and three compiled smap programs:
+
+- **prefill** — one padded sequence -> next-token logits + per-layer K/V.
+  Padded to the full context length so there is exactly one compiled shape.
+- **write** — scatter the prefill K/V into the paged pools through the
+  sequence's page table (donation-enabled: the pools update in place in
+  HBM). The int8 variant quantizes in-graph via ``kv_block_quant``.
+- **decode** — one iteration-level step over the whole slot array
+  (``models.transformer.decode_local``): every in-flight sequence advances
+  one token per call, sequences join and retire between calls. Built per
+  compute dtype so the SLA governor's precision shed (bf16) is just a
+  different entry in the program cache — KV at rest stays f32/int8 either
+  way, which is why recovery is numerically clean.
+
+Scheduling runs entirely on the caller's thread (``step()``/``run()``):
+device dispatch from a worker thread is exactly what lint rule A202
+exists to prevent, and serving does not need it — ``submit()`` is the only
+cross-thread entry point and only touches the queue under a lock.
+
+Fault story (chaos sites ``serve.admit`` / ``serve.decode``): admission
+faults fail the one request closed; decode faults go through
+``supervisor.classify`` — TRANSIENT retries with jittered backoff, FATAL
+propagates, anything else force-sheds the SLA ladder and skips the step.
+A chaos ``hang`` is not an exception at all — the step simply takes its
+duration, the TPOT window breaches, and the governor sheds: degraded, not
+down. KV pool donation stays safe under retry because every failure
+injection point precedes the dispatch that consumes the pools.
+"""
+
+from __future__ import annotations
+
+import collections
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Deque, Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from mlsl_tpu import chaos, supervisor
+from mlsl_tpu.comm.collectives import smap
+from mlsl_tpu.comm.mesh import MODEL_AXIS
+from mlsl_tpu.core import stats
+from mlsl_tpu.log import mlsl_assert
+from mlsl_tpu.models import transformer as tfm
+from mlsl_tpu.obs import metrics, tracer as obs_trace
+from mlsl_tpu.obs import straggler as obs_straggler
+from mlsl_tpu.serve import kv_cache as kvc, sla
+
+#: consecutive failed decode steps before the in-flight batch is failed
+#: closed (the engine itself survives and keeps admitting)
+_DECODE_FAIL_CAP = 8
+
+
+@dataclass
+class Request:
+    """One generation request. ``submit()`` returns it immediately;
+    ``result()`` blocks until the scheduler retires it."""
+
+    prompt: np.ndarray
+    max_new_tokens: int
+    id: int = -1
+    route: str = "default"
+    eos_token: Optional[int] = None
+    state: str = "queued"          # queued | active | done | failed
+    tokens: List[int] = field(default_factory=list)
+    error: Optional[BaseException] = None
+    t_submit: float = 0.0
+    ttft_ms: Optional[float] = None
+    _done: threading.Event = field(default_factory=threading.Event, repr=False)
+    _resume: Optional[np.ndarray] = field(default=None, repr=False)
+
+    def done(self) -> bool:
+        return self._done.is_set()
+
+    def result(self, timeout: Optional[float] = None) -> List[int]:
+        """Generated tokens (blocking). Raises the recorded error for a
+        failed request."""
+        mlsl_assert(self._done.wait(timeout), "request %d still in flight",
+                    self.id)
+        if self.state == "failed" and self.error is not None:
+            raise self.error
+        return list(self.tokens)
+
+
+@dataclass
+class _Seq:
+    """Scheduler-internal in-flight sequence state."""
+
+    req: Request
+    seq_id: int
+    slot: int
+    position: int       # next KV write index == current context length
+    last_token: int
+    admitted_at: int    # admission counter: eviction preempts the youngest
+    finished: bool = False
+
+
+class InferenceEngine:
+    """Continuous batching + paged KV + SLA ladder over one model slice."""
+
+    def __init__(self, env, cfg, tp: int = 1, params=None, seed: int = 0,
+                 devices=None, config=None, max_batch: Optional[int] = None,
+                 queue_depth: Optional[int] = None,
+                 tpot_p99_ms: float = 0.0):
+        self.env = env
+        self.cfg = cfg
+        self.tp = int(tp)
+        self.config = config if config is not None else env.config
+        mlsl_assert(cfg.n_heads % self.tp == 0, "heads %d %% tp %d",
+                    cfg.n_heads, self.tp)
+        self.dist = env.create_distribution(1, self.tp, devices=devices)
+        self.mesh = self.dist.topology.mesh
+        self.comm = (self.dist.model_group, self.config) \
+            if self.tp > 1 else None
+
+        self.specs = tfm.param_specs(cfg)
+        if params is None:
+            params = tfm.init_params(jax.random.PRNGKey(seed), cfg)
+        self.params = jax.tree.map(
+            lambda x, s: jax.device_put(x, NamedSharding(self.mesh, s)),
+            params, self.specs, is_leaf=lambda x: isinstance(x, P),
+        )
+
+        self.quant = bool(self.config.serve_kv_quant)
+        self.cache = kvc.PagedKVCache(
+            cfg,
+            page_elems=self.config.serve_kv_page_elems,
+            budget_mb=self.config.serve_kv_cache_mb,
+            max_len=cfg.seq_len,
+            quant=self.quant,
+        )
+        # the bit-exactness pin: gathered decode context extent == prefill
+        # pad length (kv_cache asserts seq_len % page_elems == 0)
+        self.ctx_len = self.cache.ctx_len
+        self.max_batch = int(max_batch if max_batch is not None
+                             else self.config.serve_max_batch)
+        self.governor = sla.SLAGovernor(
+            max_batch=self.max_batch,
+            queue_depth=int(queue_depth if queue_depth is not None
+                            else self.config.serve_queue_depth),
+            tpot_p99_ms=tpot_p99_ms,
+        )
+        sla._set_active(self.governor)
+
+        # KV pools: page 0 is the reserved garbage page (kv_cache.py), so
+        # the page axis is num_pages + 1. Heads shard over 'model'.
+        npg, page = self.cache.num_pages + 1, self.cache.page_elems
+        pool_shape = (cfg.n_blocks, npg, page, cfg.n_heads, cfg.head_dim)
+        self._pool_spec = P(None, None, None, MODEL_AXIS, None)
+        self._scale_spec = P(None, None, None, MODEL_AXIS)
+        kv_dt = jnp.int8 if self.quant else jnp.float32
+        self.kpool = jax.device_put(
+            jnp.zeros(pool_shape, kv_dt),
+            NamedSharding(self.mesh, self._pool_spec))
+        self.vpool = jax.device_put(
+            jnp.zeros(pool_shape, kv_dt),
+            NamedSharding(self.mesh, self._pool_spec))
+        if self.quant:
+            sshape = pool_shape[:-1]
+            self.kscale = jax.device_put(
+                jnp.ones(sshape, jnp.float32),
+                NamedSharding(self.mesh, self._scale_spec))
+            self.vscale = jax.device_put(
+                jnp.ones(sshape, jnp.float32),
+                NamedSharding(self.mesh, self._scale_spec))
+
+        self._build_programs()
+
+        self._lock = threading.Lock()
+        self._pending: Deque[Request] = collections.deque()
+        self._active: Dict[int, _Seq] = {}
+        self._next_req_id = 0
+        self._next_seq_id = 0
+        self._admit_counter = 0
+        self._decode_fails = 0
+        self._t_start: Optional[float] = None
+        self._tokens_total = 0
+
+    # -- compiled programs -------------------------------------------------
+
+    def _build_programs(self) -> None:
+        cfg, tp, comm = self.cfg, self.tp, self.comm
+        kv_spec = P(None, None, MODEL_AXIS, None)
+
+        def prefill_body(params, tokens, length):
+            return tfm.prefill_local(params, tokens, length, cfg, tp,
+                                     comm=comm)
+
+        self._prefill = jax.jit(smap(
+            prefill_body, self.mesh,
+            in_specs=(self.specs, P(), P()),
+            out_specs=(P(), kv_spec, kv_spec),
+            check=False,
+        ))
+
+        page = self.cache.page_elems
+
+        if self.quant:
+            def write_body(kpool, vpool, kscale, vscale, k, v, page_ids):
+                m = page_ids.shape[0]
+                kq, ksc = tfm.kv_block_quant(k)
+                vq, vsc = tfm.kv_block_quant(v)
+                shp = (cfg.n_blocks, m, page) + kq.shape[-2:]
+                kpool = kpool.at[:, page_ids].set(kq.reshape(shp))
+                vpool = vpool.at[:, page_ids].set(vq.reshape(shp))
+                sshp = shp[:-1]
+                kscale = kscale.at[:, page_ids].set(ksc.reshape(sshp))
+                vscale = vscale.at[:, page_ids].set(vsc.reshape(sshp))
+                return kpool, vpool, kscale, vscale
+
+            self._write = jax.jit(smap(
+                write_body, self.mesh,
+                in_specs=(self._pool_spec, self._pool_spec,
+                          self._scale_spec, self._scale_spec,
+                          kv_spec, kv_spec, P()),
+                out_specs=(self._pool_spec, self._pool_spec,
+                           self._scale_spec, self._scale_spec),
+                check=False,
+            ), donate_argnums=(0, 1, 2, 3))
+        else:
+            def write_body(kpool, vpool, k, v, page_ids):
+                m = page_ids.shape[0]
+                shp = (cfg.n_blocks, m, page) + k.shape[-2:]
+                kpool = kpool.at[:, page_ids].set(k.reshape(shp))
+                vpool = vpool.at[:, page_ids].set(v.reshape(shp))
+                return kpool, vpool
+
+            self._write = jax.jit(smap(
+                write_body, self.mesh,
+                in_specs=(self._pool_spec, self._pool_spec,
+                          kv_spec, kv_spec, P()),
+                out_specs=(self._pool_spec, self._pool_spec),
+                check=False,
+            ), donate_argnums=(0, 1))
+
+        self._decode_cache: Dict[str, object] = {}
+
+    def _decode_prog(self, dtype: str):
+        prog = self._decode_cache.get(dtype)
+        if prog is not None:
+            return prog
+        cfg, tp, comm = self.cfg, self.tp, self.comm
+
+        if self.quant:
+            def decode_body(params, tokens, positions, pt,
+                            kpool, vpool, kscale, vscale):
+                return tfm.decode_local(
+                    params, tokens, positions, pt, kpool, vpool, cfg, tp,
+                    comm=comm, dtype=dtype, kscale=kscale, vscale=vscale)
+
+            in_specs = (self.specs, P(), P(), P(), self._pool_spec,
+                        self._pool_spec, self._scale_spec, self._scale_spec)
+            out_specs = (P(), self._pool_spec, self._pool_spec,
+                         self._scale_spec, self._scale_spec)
+            donate = (4, 5, 6, 7)
+        else:
+            def decode_body(params, tokens, positions, pt, kpool, vpool):
+                return tfm.decode_local(
+                    params, tokens, positions, pt, kpool, vpool, cfg, tp,
+                    comm=comm, dtype=dtype)
+
+            in_specs = (self.specs, P(), P(), P(),
+                        self._pool_spec, self._pool_spec)
+            out_specs = (P(), self._pool_spec, self._pool_spec)
+            donate = (4, 5)
+
+        prog = jax.jit(
+            smap(decode_body, self.mesh, in_specs=in_specs,
+                 out_specs=out_specs, check=False),
+            donate_argnums=donate,
+        )
+        self._decode_cache[dtype] = prog
+        return prog
+
+    # -- admission (any thread) --------------------------------------------
+
+    def submit(self, prompt, max_new_tokens: int, route: str = "default",
+               eos_token: Optional[int] = None) -> Request:
+        """Queue a request. Raises :class:`~mlsl_tpu.serve.sla.
+        ServeOverloadError` (429-style, with ``retry_after_s``) when the
+        ladder closed admission or the queue is full — the two rejection
+        reasons are distinct on the metrics plane."""
+        prompt = np.asarray(prompt, np.int32).reshape(-1)
+        mlsl_assert(prompt.size >= 1, "empty prompt")
+        mlsl_assert(max_new_tokens >= 1, "max_new_tokens must be >= 1")
+        mlsl_assert(
+            prompt.size + max_new_tokens <= self.ctx_len,
+            "prompt %d + max_new %d exceeds the context length %d",
+            prompt.size, max_new_tokens, self.ctx_len,
+        )
+        with self._lock:
+            reason = None
+            if not self.governor.admission_open:
+                reason = "shed_admission"
+            elif len(self._pending) >= self.governor.queue_depth:
+                reason = "queue_full"
+            if reason is not None:
+                stats.record_serve("rejected")
+                m = metrics._registry
+                if m is not None:
+                    m.inc("mlsl_serve_rejected_total", 1.0,
+                          route=route, reason=reason)
+                raise sla.ServeOverloadError(
+                    f"admission rejected ({reason}); retry after "
+                    f"{self.governor.retry_after_s}s",
+                    retry_after_s=self.governor.retry_after_s,
+                )
+            req = Request(prompt=prompt, max_new_tokens=int(max_new_tokens),
+                          id=self._next_req_id, route=route,
+                          eos_token=eos_token, t_submit=time.monotonic())
+            self._next_req_id += 1
+            self._pending.append(req)
+            stats.record_serve("admitted")
+            return req
+
+    # -- scheduler (caller thread only) ------------------------------------
+
+    def step(self) -> int:
+        """One scheduler iteration: observe/tick the SLA ladder, admit up
+        to the rung's batch limit, advance every in-flight sequence one
+        token, retire the finished. Returns the number of in-flight
+        sequences after the step."""
+        if self._t_start is None:
+            self._t_start = time.monotonic()
+        sentinel = obs_straggler.get_active()
+        straggler = (sentinel is not None
+                     and sentinel.shed_candidate() is not None)
+        with self._lock:
+            qlen = len(self._pending)
+        self.governor.observe(queue_len=qlen, straggler=straggler)
+        self.governor.tick()
+
+        self._admit()
+        if self._active:
+            self._decode_step()
+        self._retire()
+        self._gauges()
+        return len(self._active)
+
+    def run(self, deadline_s: Optional[float] = None,
+            until_idle: bool = True, max_steps: Optional[int] = None,
+            idle_sleep_s: float = 0.001) -> None:
+        """Drive ``step()`` until idle (default), a deadline, or a step
+        budget — whichever comes first."""
+        t0 = time.monotonic()
+        steps = 0
+        while True:
+            n = self.step()
+            steps += 1
+            with self._lock:
+                idle = n == 0 and not self._pending
+            if until_idle and idle:
+                return
+            if deadline_s is not None \
+                    and time.monotonic() - t0 >= deadline_s:
+                return
+            if max_steps is not None and steps >= max_steps:
+                return
+            if n == 0:
+                time.sleep(idle_sleep_s)
+
+    # -- internals ---------------------------------------------------------
+
+    def _admit(self) -> None:
+        while len(self._active) < self.governor.batch_limit:
+            with self._lock:
+                if not self._pending:
+                    return
+                req = self._pending.popleft()
+            seq_id = self._next_seq_id
+            self._next_seq_id += 1
+            admitted_kv = False
+            try:
+                chaos.inject("serve.admit", req_id=req.id)
+                prefix = req._resume if req._resume is not None \
+                    else req.prompt
+                if not self.cache.admit(seq_id, prefix.size + 1):
+                    # pool backpressure: leave it queued, stop admitting
+                    with self._lock:
+                        self._pending.appendleft(req)
+                    return
+                admitted_kv = True
+                self._prefill_seq(req, seq_id, prefix)
+            except Exception as e:  # fail this one request closed
+                if admitted_kv:
+                    self.cache.release(seq_id)
+                self._active.pop(seq_id, None)
+                req.state = "failed"
+                req.error = e
+                req._done.set()
+                stats.record_serve("failed")
+                m = metrics._registry
+                if m is not None:
+                    m.inc("mlsl_serve_requests_total", 1.0,
+                          route=req.route, outcome="failed")
+
+    def _prefill_seq(self, req: Request, seq_id: int,
+                     prefix: np.ndarray) -> None:
+        n = int(prefix.size)
+        tokens = np.zeros((self.ctx_len,), np.int32)
+        tokens[:n] = prefix
+        tr = obs_trace._tracer
+        t0 = tr.now() if tr is not None else 0
+        logits, k, v = self._prefill(
+            self.params, jnp.asarray(tokens), jnp.int32(n))
+        page_ids = jnp.asarray(
+            np.asarray(self.cache.table_padded(seq_id), np.int32))
+        if self.quant:
+            self.kpool, self.vpool, self.kscale, self.vscale = self._write(
+                self.kpool, self.vpool, self.kscale, self.vscale,
+                k, v, page_ids)
+        else:
+            self.kpool, self.vpool = self._write(
+                self.kpool, self.vpool, k, v, page_ids)
+        tok = int(np.argmax(np.asarray(logits)))
+        if tr is not None:
+            tr.complete("serve.prefill", "serve", t0, seq=seq_id, tokens=n)
+        stats.record_serve("prefills")
+        stats.record_serve("tokens_out")
+        self._tokens_total += 1
+        resumed = req._resume is not None
+        if not resumed:
+            req.ttft_ms = (time.monotonic() - req.t_submit) * 1e3
+            m = metrics._registry
+            if m is not None:
+                m.observe("mlsl_serve_ttft_ms", req.ttft_ms,
+                          route=req.route)
+        req._resume = None
+        req.state = "active"
+        req.tokens.append(tok)
+        seq = _Seq(req=req, seq_id=seq_id, slot=-1, position=n,
+                   last_token=tok, admitted_at=self._admit_counter)
+        self._admit_counter += 1
+        if (req.eos_token is not None and tok == req.eos_token) \
+                or len(req.tokens) >= req.max_new_tokens \
+                or seq.position >= self.ctx_len:
+            seq.finished = True
+        self._active[seq_id] = seq
+
+    def _evict_youngest(self) -> None:
+        """Preempt the youngest in-flight sequence: free its pages, stash
+        prompt + everything generated as the resume prefix, put it back at
+        the FRONT of the queue (it has seniority over never-started work)."""
+        seq = max(self._active.values(), key=lambda s: s.admitted_at)
+        self._active.pop(seq.seq_id)
+        self.cache.release(seq.seq_id, evict=True)
+        req = seq.req
+        req._resume = np.concatenate(
+            [req.prompt, np.asarray(req.tokens, np.int32)])
+        req.state = "queued"
+        with self._lock:
+            self._pending.appendleft(req)
+
+    def _ensure_capacity(self) -> None:
+        """Every live sequence needs pages covering its next KV write; a
+        pool that cannot extend evicts the youngest until it can. The
+        budget invariant (num_pages >= max_pages_per_seq) guarantees this
+        terminates with at least one sequence still running."""
+        for seq in sorted(self._active.values(), key=lambda s: s.admitted_at):
+            while seq.seq_id in self._active \
+                    and not self.cache.extend(seq.seq_id, seq.position + 1):
+                self._evict_youngest()
+
+    def _decode_step(self) -> None:
+        self._ensure_capacity()
+        if not self._active:
+            return
+        live = sorted(self._active.values(), key=lambda s: s.admitted_at)
+        b, mpp = self.max_batch, self.cache.max_pages_per_seq
+        tokens = np.zeros((b,), np.int32)
+        positions = np.zeros((b,), np.int32)
+        pt = np.zeros((b, mpp), np.int32)     # inactive slots: garbage page
+        for i, seq in enumerate(live):
+            seq.slot = i
+            tokens[i] = seq.last_token
+            positions[i] = seq.position
+            pt[i] = self.cache.table_padded(seq.seq_id)
+        dtype = "bfloat16" if self.governor.precision_shed else None
+        prog = self._decode_prog(dtype or self.cfg.dtype)
+        attempt = 0
+        tr = obs_trace._tracer
+        while True:
+            t_step = time.monotonic()
+            t0 = tr.now() if tr is not None else 0
+            try:
+                # a chaos 'hang' here is a slow step, not an exception: it
+                # lands inside the timed window, breaches the TPOT SLO, and
+                # the governor sheds — the degraded-not-down path
+                chaos.inject("serve.decode", inflight=len(live))
+                out = prog(self.params, jnp.asarray(tokens),
+                           jnp.asarray(positions), jnp.asarray(pt),
+                           self.kpool, self.vpool,
+                           *((self.kscale, self.vscale)
+                             if self.quant else ()))
+                break
+            except Exception as e:
+                cls = supervisor.classify(e)
+                if cls is supervisor.ErrorClass.TRANSIENT \
+                        and attempt < self.config.comm_retries:
+                    stats.record_serve("retries")
+                    time.sleep(supervisor.jittered_backoff(
+                        self.config.comm_retry_backoff_s, attempt))
+                    attempt += 1
+                    continue
+                self._decode_fault(e)
+                return
+        if self.quant:
+            logits, self.kpool, self.vpool, self.kscale, self.vscale = out
+        else:
+            logits, self.kpool, self.vpool = out
+        logits = np.asarray(logits)           # blocks until the step is done
+        step_ms = (time.monotonic() - t_step) * 1e3
+        if tr is not None:
+            tr.complete("serve.decode", "serve", t0, inflight=len(live))
+        self._decode_fails = 0
+        if attempt > 0:
+            stats.record_serve("recoveries")
+        self.governor.observe(tpot_ms=step_ms)
+        m = metrics._registry
+        if m is not None:
+            m.observe("mlsl_serve_tpot_ms", step_ms)
+        stats.record_serve("decode_steps")
+        stats.record_serve("tokens_out", len(live))
+        self._tokens_total += len(live)
+        for seq in live:
+            tok = int(np.argmax(logits[seq.slot]))
+            seq.position += 1
+            seq.last_token = tok
+            seq.req.tokens.append(tok)
+            if (seq.req.eos_token is not None
+                    and tok == seq.req.eos_token) \
+                    or len(seq.req.tokens) >= seq.req.max_new_tokens \
+                    or seq.position >= self.ctx_len:
+                seq.finished = True
+
+    def _decode_fault(self, e: BaseException) -> None:
+        cls = supervisor.classify(e)
+        if cls is supervisor.ErrorClass.FATAL:
+            raise e
+        self._decode_fails += 1
+        self.governor.force_shed(f"decode fault: {cls.name}")
+        if self._decode_fails < _DECODE_FAIL_CAP:
+            return
+        # the batch is wedged: fail it closed, keep the engine alive
+        for seq in list(self._active.values()):
+            self._active.pop(seq.seq_id)
+            self.cache.release(seq.seq_id)
+            seq.req.state = "failed"
+            seq.req.error = e
+            seq.req._done.set()
+            stats.record_serve("failed")
+        self._decode_fails = 0
+
+    def _retire(self) -> None:
+        m = metrics._registry
+        for seq in [s for s in self._active.values() if s.finished]:
+            self._active.pop(seq.seq_id)
+            self.cache.release(seq.seq_id)
+            seq.req.state = "done"
+            seq.req._done.set()
+            stats.record_serve("completed")
+            if m is not None:
+                m.inc("mlsl_serve_requests_total", 1.0,
+                      route=seq.req.route, outcome="done")
+
+    def _gauges(self) -> None:
+        m = metrics._registry
+        if m is None:
+            return
+        with self._lock:
+            qlen = len(self._pending)
+        m.set("mlsl_serve_queue_depth", float(qlen))
+        m.set("mlsl_serve_inflight", float(len(self._active)))
+        m.set("mlsl_serve_kv_free_pages", float(self.cache.free_pages))
+        m.set("mlsl_serve_batch_limit", float(self.governor.batch_limit))
+        if self._t_start is not None:
+            dt = time.monotonic() - self._t_start
+            if dt > 0:
+                m.set("mlsl_serve_tokens_per_s", self._tokens_total / dt)
+
+    def close(self) -> None:
+        """Detach the SLA governor from the module registry (tests and
+        multi-engine processes)."""
+        if sla.get_active() is self.governor:
+            sla._set_active(None)
+
+
+def oracle_generate(engine: InferenceEngine, prompt, max_new_tokens: int,
+                    eos_token: Optional[int] = None) -> List[int]:
+    """The UNPAGED oracle: greedy decode by re-running the engine's own
+    compiled prefill over the growing full sequence each step — no KV
+    cache, no pages. The bit-exactness tests pin the paged engine against
+    this (identical program structure, identical reduction extents)."""
+    seq = list(np.asarray(prompt, np.int32).reshape(-1))
+    out: List[int] = []
+    for _ in range(max_new_tokens):
+        tokens = np.zeros((engine.ctx_len,), np.int32)
+        tokens[:len(seq)] = seq
+        logits, _, _ = engine._prefill(
+            engine.params, jnp.asarray(tokens), jnp.int32(len(seq)))
+        tok = int(np.argmax(np.asarray(logits)))
+        out.append(tok)
+        seq.append(tok)
+        if eos_token is not None and tok == eos_token:
+            break
+        if len(seq) >= engine.ctx_len:
+            break
+    return out

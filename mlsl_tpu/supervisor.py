@@ -385,6 +385,12 @@ def status() -> Dict[str, dict]:
     from mlsl_tpu import control as _control
 
     out["control"] = _control.status()
+    # serving engine (mlsl_tpu.serve): the SLA governor's ladder rung, queue
+    # pressure, and shed counts — {"state": "off"} when no engine is live.
+    # Same JSON-serializability contract: this dict IS the /healthz body.
+    from mlsl_tpu import serve as _serve
+
+    out["serve"] = _serve.status()
     return out
 
 

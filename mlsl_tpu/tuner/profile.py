@@ -80,6 +80,23 @@ KNOB_RANGES = {
     # real-failure detection by one MLSL_HEARTBEAT_INTERVAL_S); an exported
     # MLSL_HEARTBEAT_MISSES always wins
     "heartbeat_misses": 1,
+    # serving decode-slot ceiling (serve/engine.py): profiles may carry the
+    # batch benchmarks/serving_bench.py measured to maximize tokens/s while
+    # holding p99 TPOT on this chip; an exported MLSL_SERVE_MAX_BATCH
+    # always wins
+    "serve_max_batch": 1,
+    # KV page granularity in tokens (serve/kv_cache.py): profiles may carry
+    # the page size measured to balance HBM tail waste against page-table
+    # gather cost; an exported MLSL_SERVE_KV_PAGE_ELEMS always wins
+    "serve_kv_page_elems": 1,
+    # paged-KV HBM budget in MiB (serve/kv_cache.py): profiles may carry
+    # the budget measured to fit this chip's free HBM after weights; an
+    # exported MLSL_SERVE_KV_CACHE_MB always wins
+    "serve_kv_cache_mb": 1,
+    # admission queue depth (serve/engine.py): profiles may carry the depth
+    # measured to absorb offered-load bursts without breaching TTFT; an
+    # exported MLSL_SERVE_QUEUE_DEPTH always wins
+    "serve_queue_depth": 1,
 }
 
 #: string-valued knobs -> allowed values: same load-time validation contract

@@ -1,0 +1,13 @@
+#!/usr/bin/env bash
+# One-command serving demo on the 8-device CPU proof mesh: offered-load
+# throughput + TTFT/TPOT tails, then the chaos soak (a hung decode step
+# degrades throughput, never availability), then the parity gate (paged
+# continuous-batched decode bit-exact vs the unpaged full-context oracle).
+# On a real TPU attachment drop JAX_PLATFORMS/XLA_FLAGS.
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+export JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}"
+export XLA_FLAGS="${XLA_FLAGS:---xla_force_host_platform_device_count=8}"
+
+exec python benchmarks/serving_bench.py "$@"

@@ -111,6 +111,13 @@ def _reset_supervisor():
 
     control.reset()
     stats.reset_control_counters()
+    # the serving engine's SLA governor registry is process-wide by design
+    # (supervisor.status() reports it); tests that run an engine must not
+    # leave later tests reading a stale ladder state
+    from mlsl_tpu import serve
+
+    serve.reset()
+    stats.reset_serve_counters()
 
 
 @pytest.fixture(autouse=True)

@@ -1,0 +1,427 @@
+"""Serving engine (mlsl_tpu/serve): paged-KV bit-exactness against the
+unpaged full-context oracle, free-list/eviction invariants under churn, the
+int8 paged codec vs the dequantize oracle, SLA ladder
+escalation/recovery/admission-rejection, chaos soak (degraded, never down),
+knob validation, the serving metric families on the telemetry plane, and
+the serving_bench --smoke wiring (the ``bench_smoke`` marker)."""
+
+import dataclasses
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+from mlsl_tpu import chaos, serve, supervisor
+from mlsl_tpu.core import stats
+from mlsl_tpu.log import MLSLError
+from mlsl_tpu.models.transformer import TransformerConfig, kv_block_quant
+from mlsl_tpu.serve.engine import oracle_generate
+from mlsl_tpu.serve.kv_cache import PagedKVCache
+
+
+@pytest.fixture(autouse=True)
+def _clear_chaos():
+    chaos.clear()
+    yield
+    chaos.clear()
+
+
+def _cfg(**kw):
+    base = dict(vocab=64, d_model=32, n_heads=4, head_dim=8, n_blocks=2,
+                seq_len=64, dtype="float32")
+    base.update(kw)
+    return TransformerConfig(**base)
+
+
+def _prompts(cfg, n, rng_seed=0):
+    rng = np.random.default_rng(rng_seed)
+    return [rng.integers(1, cfg.vocab, size=int(rng.integers(3, 20)))
+            .astype(np.int32) for _ in range(n)]
+
+
+# -- paged decode correctness -------------------------------------------------
+
+
+def test_paged_decode_bitexact_vs_unpaged_oracle(env):
+    """The tentpole acceptance pin: continuous-batched paged decode must
+    reproduce the unpaged full-context forward bit for bit (f32 attention
+    over f32-at-rest KV, equal reduction extents in both programs)."""
+    cfg = _cfg()
+    eng = serve.InferenceEngine(env, cfg, tp=1, seed=0)
+    reqs = [eng.submit(p, 6) for p in _prompts(cfg, 5)]
+    eng.run()
+    for req, p in zip(reqs, _prompts(cfg, 5)):
+        assert req.result(timeout=5) == oracle_generate(eng, p, 6)
+    assert all(r.state == "done" for r in reqs)
+    eng.cache.check()
+    assert len(eng.cache) == 0          # every sequence released its pages
+    eng.close()
+
+
+def test_paged_decode_bitexact_tp2(env):
+    """Same pin with the decode allreduces live on the model axis (routed
+    through the selection table via algos.inline_allreduce)."""
+    cfg = _cfg(n_heads=8)
+    eng = serve.InferenceEngine(env, cfg, tp=2, seed=0)
+    p = np.arange(1, 11, dtype=np.int32)
+    req = eng.submit(p, 5)
+    eng.run()
+    assert req.result(timeout=5) == oracle_generate(eng, p, 5)
+    eng.close()
+
+
+def test_kv_block_quant_matches_dequantize_oracle():
+    """The int8 paged codec is the ops/quant_kernels blockwise-ref contract
+    with block = head_dim: quantize agrees with quantize_blocks_ref row for
+    row, and the dequantize round-trip error is bounded by amax/254 per
+    row (half an int8 step)."""
+    from mlsl_tpu.ops.quant_kernels import (
+        dequantize_blocks_ref, quantize_blocks_ref)
+
+    rng = np.random.default_rng(1)
+    x = rng.normal(size=(16, 4, 8)).astype(np.float32)
+    x[0, 0] = 0.0                        # the amax==0 guard row
+    q, s = kv_block_quant(x)
+    q2, s2 = quantize_blocks_ref(x.reshape(-1, 8))
+    np.testing.assert_array_equal(np.asarray(q).reshape(-1, 8), q2)
+    np.testing.assert_array_equal(np.asarray(s).reshape(-1), s2)
+    deq = np.asarray(dequantize_blocks_ref(q2, s2)).reshape(x.shape)
+    amax = np.abs(x).max(axis=-1, keepdims=True)
+    assert np.all(np.abs(deq - x) <= amax / 254 + 1e-7)
+
+
+def test_int8_paged_decode_within_tolerance(env):
+    """The int8-paged engine's first token is exact vs the f32 oracle
+    (one quantized read cannot flip a well-separated argmax on this model)
+    and the whole greedy stream stays in near-total agreement."""
+    cfg = _cfg()
+    qconfig = dataclasses.replace(env.config, serve_kv_quant=True)
+    eng = serve.InferenceEngine(env, cfg, tp=1, seed=0, config=qconfig)
+    p = np.arange(1, 13, dtype=np.int32)
+    req = eng.submit(p, 8)
+    eng.run()
+    got = req.result(timeout=5)
+    want = oracle_generate(eng, p, 8)
+    assert got[0] == want[0]
+    agree = sum(1 for a, b in zip(got, want) if a == b)
+    assert agree >= len(want) - 1, (got, want)
+    eng.close()
+
+
+# -- paged KV cache invariants ------------------------------------------------
+
+
+def test_kv_cache_free_list_invariants_under_churn():
+    cfg = _cfg()
+    cache = PagedKVCache(cfg, page_elems=16, budget_mb=1, max_len=64)
+    rng = np.random.default_rng(2)
+    live = {}
+    for seq_id in range(200):
+        op = rng.integers(0, 3)
+        if op == 0 or not live:
+            n = int(rng.integers(1, 65))
+            if cache.admit(seq_id, n):
+                live[seq_id] = n
+        elif op == 1:
+            sid = int(rng.choice(list(live)))
+            n = min(live[sid] + int(rng.integers(1, 20)), cache.ctx_len)
+            if cache.extend(sid, n):
+                live[sid] = n
+        else:
+            sid = int(rng.choice(list(live)))
+            cache.release(sid, evict=bool(rng.integers(0, 2)))
+            del live[sid]
+        cache.check()
+    for sid in list(live):
+        cache.release(sid)
+        cache.check()
+    assert cache.free_pages == cache.num_pages
+    assert cache.budget.bytes == 0
+
+
+def test_kv_cache_rejects_and_budget_floor():
+    cfg = _cfg()
+    # budget below one full-context sequence fails loudly at init
+    with pytest.raises(MLSLError):
+        PagedKVCache(cfg, page_elems=16, budget_mb=0.01, max_len=64)
+    # page size must divide the context
+    with pytest.raises(MLSLError):
+        PagedKVCache(cfg, page_elems=24, budget_mb=4, max_len=64)
+    # page_bytes = 2 blocks * 2 (K+V) * 16 * 4 heads * 8 * 4 B = 8 KiB;
+    # 0.04 MB buys 5 pages — one full-context sequence (4) plus one
+    cache = PagedKVCache(cfg, page_elems=16, budget_mb=0.04, max_len=64)
+    assert cache.num_pages >= cache.max_pages_per_seq
+    assert cache.admit(0, 64)            # one full-context sequence fits
+    before = stats.SERVE_COUNTERS["kv_rejects"]
+    assert not cache.admit(1, 64)        # the pool is drained
+    assert stats.SERVE_COUNTERS["kv_rejects"] == before + 1
+    cache.check()
+
+
+def test_engine_eviction_preempts_youngest_and_resumes(env):
+    """Pool exhaustion mid-decode evicts the YOUNGEST sequence (pages
+    freed, counted, kv.evict instant), requeues it with its generated
+    prefix, and the resumed output is still bit-exact vs the oracle."""
+    cfg = _cfg()
+    eng = serve.InferenceEngine(env, cfg, tp=1, seed=0, max_batch=2)
+    # shrink the pool to 5 pages (8 KiB each; one full sequence + 1): two
+    # 2-page sequences collide on their third page and the younger yields
+    eng.cache = PagedKVCache(cfg, page_elems=16, budget_mb=0.04,
+                             max_len=64)
+    assert cache_pages(eng) == 5
+    p1, p2 = (np.arange(1, 31, dtype=np.int32),
+              np.arange(2, 32, dtype=np.int32))
+    r1, r2 = eng.submit(p1, 8), eng.submit(p2, 8)
+    eng.run()
+    assert stats.SERVE_COUNTERS["kv_evictions"] >= 1
+    assert r1.result(timeout=5) == oracle_generate(eng, p1, 8)
+    assert r2.result(timeout=5) == oracle_generate(eng, p2, 8)
+    eng.cache.check()
+    eng.close()
+
+
+def cache_pages(eng):
+    return eng.cache.num_pages
+
+
+# -- SLA ladder ---------------------------------------------------------------
+
+
+def test_sla_ladder_escalates_and_recovers():
+    g = serve.SLAGovernor(max_batch=8, queue_depth=10, breach_ticks=2,
+                          recover_ticks=3)
+    assert g.batch_limit == 8 and g.admission_open
+    g.observe(queue_len=9)               # > 0.75 * 10
+    for _ in range(6):
+        g.tick()
+    assert g.rung == 3                   # climbed the whole ladder
+    assert g.batch_limit == 4 and g.precision_shed
+    assert not g.admission_open
+    assert g.sheds == 3
+    g.observe(queue_len=0)
+    for _ in range(9):
+        g.tick()
+    assert g.rung == 0 and g.admission_open and g.recoveries == 3
+    assert g.status()["state"] == "healthy"
+    # the shed ledger reached the stats plane
+    assert stats.SERVE_COUNTERS["shed_batch"] >= 1
+    assert stats.SERVE_COUNTERS["shed_admission"] >= 1
+    assert stats.SERVE_COUNTERS["recoveries"] >= 3
+
+
+def test_submit_rejections_are_429_style(env):
+    cfg = _cfg()
+    eng = serve.InferenceEngine(env, cfg, tp=1, seed=0, queue_depth=2)
+    eng.submit(np.arange(1, 5), 2)
+    eng.submit(np.arange(1, 5), 2)
+    with pytest.raises(serve.ServeOverloadError) as ei:   # queue full
+        eng.submit(np.arange(1, 5), 2)
+    assert ei.value.retry_after_s > 0
+    eng.governor.force_shed("test")
+    eng.governor.force_shed("test")
+    eng.governor.force_shed("test")                       # -> shed_admission
+    assert not eng.governor.admission_open
+    with pytest.raises(serve.ServeOverloadError):
+        eng.submit(np.arange(1, 3), 1)
+    assert stats.SERVE_COUNTERS["rejected"] == 2
+    # a prompt that cannot fit the context is a caller bug, not a 429
+    with pytest.raises(MLSLError):
+        eng.submit(np.arange(1, 60), 10)
+    eng.close()
+
+
+def test_straggler_candidate_counts_as_pressure(monkeypatch):
+    g = serve.SLAGovernor(max_batch=4, queue_depth=8, breach_ticks=2,
+                          recover_ticks=50)
+    g.observe(straggler=True)
+    g.tick()
+    g.tick()
+    assert g.rung == 1 and "straggler" in g.last_reason
+
+
+# -- chaos soak: degraded, never down -----------------------------------------
+
+
+def test_chaos_admit_fault_fails_one_request_closed(env):
+    cfg = _cfg()
+    eng = serve.InferenceEngine(env, cfg, tp=1, seed=0)
+    chaos.plan("serve.admit", "error", times=1)
+    reqs = [eng.submit(p, 4) for p in _prompts(cfg, 3)]
+    eng.run()
+    states = sorted(r.state for r in reqs)
+    assert states == ["done", "done", "failed"]
+    failed = next(r for r in reqs if r.state == "failed")
+    with pytest.raises(Exception):
+        failed.result(timeout=5)
+    assert stats.SERVE_COUNTERS["failed"] == 1
+    eng.cache.check()                    # no leaked pages from the failure
+    eng.close()
+
+
+def test_chaos_decode_transient_retries_in_place(env):
+    cfg = _cfg()
+    eng = serve.InferenceEngine(env, cfg, tp=1, seed=0)
+    chaos.plan("serve.decode", "error", exc=OSError, times=2)
+    p = np.arange(1, 9, dtype=np.int32)
+    req = eng.submit(p, 4)
+    eng.run()
+    assert req.result(timeout=5) == oracle_generate(eng, p, 4)
+    assert stats.SERVE_COUNTERS["retries"] >= 1
+    assert serve.status()["state"] == "healthy"   # retry != shed
+    eng.close()
+
+
+def test_chaos_decode_loss_sheds_engine_survives(env):
+    """A classified non-transient decode fault force-sheds the ladder and
+    skips the step; the engine keeps scheduling and the queue drains."""
+    from mlsl_tpu.log import MLSLDeviceLossError
+
+    cfg = _cfg()
+    eng = serve.InferenceEngine(env, cfg, tp=1, seed=0)
+    chaos.plan("serve.decode", "error", exc=MLSLDeviceLossError, times=2)
+    reqs = [eng.submit(p, 4) for p in _prompts(cfg, 3)]
+    eng.run()
+    assert all(r.state == "done" for r in reqs)
+    assert eng.governor.sheds >= 1
+    assert stats.SERVE_COUNTERS["shed_batch"] >= 1
+    eng.close()
+
+
+def test_chaos_decode_hang_breaches_tpot_and_sheds(env):
+    """A hang is not an exception: the step is just slow, the TPOT window
+    breaches the SLO, and the governor sheds — degraded, not down."""
+    cfg = _cfg()
+    eng = serve.InferenceEngine(env, cfg, tp=1, seed=0, tpot_p99_ms=30.0)
+    eng.governor.breach_ticks = 1
+    # the p99 window needs >= 8 TPOT samples before it will judge; 12
+    # decode steps with hangs landing mid-stream guarantee a breach tick
+    chaos.plan("serve.decode", "hang", seconds=0.12, after=4, times=3)
+    reqs = [eng.submit(p, 12) for p in _prompts(cfg, 4)]
+    eng.run()
+    assert all(r.state == "done" for r in reqs)
+    assert eng.governor.sheds >= 1
+    assert not eng._pending and not eng._active   # the queue drained
+    eng.close()
+
+
+# -- knobs --------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("field,bad", [
+    ("serve_max_batch", 0),
+    ("serve_kv_page_elems", 0),
+    ("serve_kv_cache_mb", 0),
+    ("serve_queue_depth", -1),
+])
+def test_serve_knob_validation(field, bad):
+    from mlsl_tpu.config import Config
+
+    with pytest.raises(MLSLError):
+        Config(**{field: bad}).validate()
+    Config().validate()                  # defaults stay valid
+
+
+def test_serve_knobs_in_tuner_ranges():
+    from mlsl_tpu.tuner.profile import KNOB_RANGES
+
+    for k in ("serve_max_batch", "serve_kv_page_elems",
+              "serve_kv_cache_mb", "serve_queue_depth"):
+        assert k in KNOB_RANGES
+
+
+# -- observability ------------------------------------------------------------
+
+
+def test_serve_metric_families_and_healthz(env):
+    from mlsl_tpu.obs import metrics as obs_metrics
+
+    reg = obs_metrics.enable(every=1)
+    try:
+        cfg = _cfg()
+        eng = serve.InferenceEngine(env, cfg, tp=1, seed=0)
+        req = eng.submit(np.arange(1, 9), 3, route="short")
+        eng.run()
+        assert req.state == "done"
+        reg.sample_families()
+        text = reg.to_prometheus()
+        for name in ("mlsl_serve_admitted", "mlsl_serve_completed",
+                     "mlsl_serve_tokens_out", "mlsl_serve_decode_steps",
+                     "mlsl_serve_queue_depth", "mlsl_serve_kv_free_pages",
+                     "mlsl_serve_ttft_ms", "mlsl_serve_requests_total"):
+            assert name in text, name
+        # the governor rides /healthz through supervisor.status()
+        st = supervisor.status()
+        assert st["serve"]["state"] == "healthy"
+        assert json.dumps(st)            # stays JSON-serializable
+        eng.close()
+        assert serve.status() == {"state": "off"}
+    finally:
+        obs_metrics.disable()
+
+
+def test_serve_spans_on_timeline(env):
+    from mlsl_tpu.obs import tracer as obs_trace
+
+    tr = obs_trace.enable()
+    try:
+        cfg = _cfg()
+        eng = serve.InferenceEngine(env, cfg, tp=1, seed=0)
+        eng.submit(np.arange(1, 9), 3)
+        eng.run()
+        names = {e[1] for e in tr.snapshot()}
+        assert "serve.prefill" in names and "serve.decode" in names
+        eng.close()
+    finally:
+        obs_trace.disable()
+
+
+def test_serve_stats_line(env, tmp_path, monkeypatch):
+    monkeypatch.setenv("MLSL_STATS_DIR", str(tmp_path))
+    cfg = _cfg()
+    eng = serve.InferenceEngine(env, cfg, tp=1, seed=0)
+    eng.submit(np.arange(1, 6), 2)
+    eng.run()
+    eng.governor.force_shed("stats-line probe")
+    text = env.create_session().get_stats().print_()
+    line = next(l for l in text.splitlines() if l.startswith("SERVE"))
+    assert "admitted 1" in line and "completed 1" in line
+    shed_log = (tmp_path / "mlsl_stats.log").read_text()
+    assert "BATCH" in shed_log           # the immediate shed line
+    eng.close()
+
+
+# -- bench wiring -------------------------------------------------------------
+
+
+@pytest.mark.bench_smoke
+def test_serving_bench_smoke():
+    """Tier-1 wiring for benchmarks/serving_bench.py: the smoke rows must
+    parse, the paged engine must be bit-exact vs the unpaged oracle, and
+    the chaos soak must come back degraded-not-down (exit 0 gates all)."""
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env_vars = dict(
+        os.environ,
+        JAX_PLATFORMS="cpu",
+        XLA_FLAGS="--xla_force_host_platform_device_count=8",
+    )
+    env_vars.pop("MLSL_CHAOS", None)     # the bench arms its own plan
+    out = subprocess.run(
+        [sys.executable,
+         os.path.join(repo, "benchmarks", "serving_bench.py"), "--smoke"],
+        capture_output=True, text=True, timeout=540, env=env_vars, cwd=repo,
+    )
+    assert out.returncode == 0, out.stderr[-2000:]
+    rows = [json.loads(l) for l in out.stdout.splitlines()
+            if l.startswith("{")]
+    load = next(r for r in rows if r["metric"] == "serving_bench")
+    assert load["completed"] + load["rejected"] == load["requests"]
+    assert load["tokens_per_s"] and load["ttft_ms"]["p50"] is not None
+    parity = next(r for r in rows if r["metric"] == "serving_bench_parity")
+    assert parity["paged_bitexact_vs_unpaged"] is True
+    chaos_row = next(r for r in rows
+                     if r["metric"] == "serving_bench_chaos")
+    assert chaos_row["unhandled"] == 0
+    assert chaos_row["degraded_not_down"] is True
