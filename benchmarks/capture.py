@@ -156,6 +156,11 @@ def main():
             # and the paged-vs-unpaged parity gate (docs/TUNING.md §21)
             ("serving",
              [sys.executable, "benchmarks/serving_bench.py"], 2400),
+            # codec lab (mlsl_tpu.codecs): full wire-bytes x codec x size
+            # grid + the calibrated-vs-uniform-int8 acceptance row on the
+            # ResNet-50-shaped stream (docs/TUNING.md §22)
+            ("codec_lab",
+             [sys.executable, "benchmarks/codec_lab_bench.py"], 1200),
         ]
 
     record = {

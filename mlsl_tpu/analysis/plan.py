@@ -25,6 +25,12 @@ collective-ordering deadlock model):
   block boundaries, and (A114, the two-tier analog of A113) hier-routed
   compressed requests whose DCN-tier quant blocks would straddle the
   intra-slice shard boundary.
+- **A115/A116** registry-codec wire geometry (mlsl_tpu.codecs, the
+  A110-A114 siblings): per-chunk VQ index-table/codebook alignment — the
+  index count must tile the chunk at the declared vector dim and the wire
+  codebook must match k x dim (A115) — and prune mask coverage — the
+  bit-packed mask must span the whole chunk with the keep-count inside it,
+  or the rank-order decode gather desynchronizes (A116).
 - **A121** the EF snapshot/rewind machinery's static preconditions on every
   retry/degrade path (degrade geometry covers every chunk program).
 - **A120/A122** compiled-overlap donation hazards (``verify_overlap_plan``):
@@ -347,13 +353,24 @@ def _check_issue_order(rep: Report, cfg, back) -> None:
 
 def _expected_err_len(req, cfg) -> Optional[List[int]]:
     """Per-chunk expected error-feedback length for a compressed request, or
-    None when the wire family owns its own layout (top-k, custom codec)."""
+    None when the wire family owns its own layout (top-k, user dlopen
+    codec — registry codecs DO declare theirs: g x chunk entry residual)."""
     d = req.desc
     if d.compression != CompressionType.QUANTIZATION:
         return None
+    if req.algo.startswith("codec:"):
+        # registry codec on the compressed-ring transport (comm/codec.py):
+        # entry EF, one residual row per hop — err_len = g * chunk
+        g = 1 if d.group.is_self else d.group.size
+        rs = d.kind == "reduce_scatter"
+        return [g * (n // g if rs else -(-n // g)) for n in _chunk_counts(req)]
     if req.algo not in ("quant_ring", "pallas_ring", "hier"):
         return None
-    block = getattr(cfg, "quant_block_elems", 256)
+    # effective block: a desc-level override or a calibrated int8 cell may
+    # widen it per-request (comm/request.py setup) — the session block is
+    # only the fallback
+    block = (getattr(req, "_eff_quant_block", 0)
+             or getattr(cfg, "quant_block_elems", 256))
     out = []
     for n in _chunk_counts(req):
         if req.algo == "pallas_ring":
@@ -416,7 +433,7 @@ def _check_request(rep: Report, req, cfg, anchor: str) -> None:
                         rep.add("MLSL-A112",
                                 f"err_len {a} != quant-ring geometry {e} on "
                                 f"'{req.name or req.uid}' (block="
-                                f"{getattr(cfg, 'quant_block_elems', '?')})",
+                                f"{getattr(req, '_eff_quant_block', 0) or getattr(cfg, 'quant_block_elems', '?')})",
                                 anchor)
             else:
                 rep.add("MLSL-A112",
@@ -448,6 +465,59 @@ def _check_request(rep: Report, req, cfg, anchor: str) -> None:
                         f"cover chunk count {n} on "
                         f"'{req.name or req.uid}': the tail of the payload "
                         "would never cross the DCN", anchor)
+    geoms = getattr(req, "_codec_geoms", None)
+    if compressed and geoms is not None:
+        # -- A115/A116 (the A110-A114 siblings for registry codecs): each
+        # chunk's pinned wire geometry must be self-consistent — a tampered
+        # VQ index table or codebook no longer covers the chunk (A115), a
+        # prune mask shorter than the chunk silently drops tail gradients
+        # and desynchronizes the rank-decode (A116)
+        for gm in geoms:
+            name = str(gm.get("codec", ""))
+            chunk = int(gm.get("chunk", 0))
+            if name == "vq":
+                dim = int(gm.get("dim", 0) or 0)
+                k = int(gm.get("k", 0) or 0)
+                idx = int(gm.get("idx_elems", -1))
+                cbe = int(gm.get("codebook_elems", -1))
+                want_idx = -(-chunk // dim) if dim > 0 else -1
+                if dim <= 0 or idx != want_idx:
+                    rep.add("MLSL-A115",
+                            f"VQ index table of '{req.name or req.uid}' "
+                            f"carries {idx} indices for a {chunk}-elem chunk "
+                            f"at dim={dim} (expected {want_idx}): decode "
+                            "would mis-tile the vectors", anchor)
+                elif cbe != k * dim:
+                    rep.add("MLSL-A115",
+                            f"VQ codebook of '{req.name or req.uid}' "
+                            f"carries {cbe} elems for k={k} x dim={dim}: "
+                            "the wire codebook and the index range "
+                            "disagree", anchor)
+                elif int(gm.get("wire_len", -1)) != idx + 4 * cbe + 4:
+                    rep.add("MLSL-A115",
+                            f"VQ wire length {gm.get('wire_len')} of "
+                            f"'{req.name or req.uid}' != indices {idx} + "
+                            f"codebook {4 * cbe} + scale 4 bytes", anchor)
+            elif name in ("prune", "topk"):
+                k = int(gm.get("k", 0) or 0)
+                mask_len = int(gm.get("mask_len", -1))
+                if mask_len != chunk:
+                    rep.add("MLSL-A116",
+                            f"prune mask of '{req.name or req.uid}' covers "
+                            f"{mask_len} elems of a {chunk}-elem chunk: the "
+                            "tail would silently drop from every round",
+                            anchor)
+                elif not 0 < k <= chunk:
+                    rep.add("MLSL-A116",
+                            f"prune keep-count {k} of "
+                            f"'{req.name or req.uid}' is outside the "
+                            f"{chunk}-elem chunk", anchor)
+                elif int(gm.get("wire_len", -1)) != -(-mask_len // 8) + 4 * k:
+                    rep.add("MLSL-A116",
+                            f"prune wire length {gm.get('wire_len')} of "
+                            f"'{req.name or req.uid}' != packed mask "
+                            f"{-(-mask_len // 8)} + {4 * k} value bytes: "
+                            "the rank-decode gather desynchronizes", anchor)
     if req.algo in ("pallas_ring", "pallas_ring2d"):
         # the 2D snake ring runs the identical kernel program over the
         # snake-ordered neighbour tables, so the 1D accounting mirror IS

@@ -1,0 +1,429 @@
+"""Codec lab: the pluggable gradient-compression registry (ROADMAP #4).
+
+One declared contract subsumes every compressed wire the comm layer speaks:
+
+  - ``encode(x) -> wire``: f32 ``(n,)`` chunk -> self-contained uint8 wire
+    image (indices, masks, scales, codebooks — everything decode needs).
+  - ``decode(wire, n) -> x_hat``: inverse; always f32 ``(n,)``.
+  - ``wire_dtype`` / ``wire_len(n)``: the on-wire element type and count, the
+    honest byte accounting behind per-codec wire stats and the tuner's
+    bandwidth model.
+  - ``geometry(n)``: a static dict the analysis verifier pins (A115/A116
+    siblings of the quant-geometry codes) — codebook/index alignment for VQ,
+    mask-length == chunk for pruning.
+  - ``aggregate(a, b)`` (optional): THC-class compressed-domain sum — two
+    wire images in, one wire image out, no dequantize on the hop (the ring
+    folds partials through it; arXiv:2302.08545).
+  - ``hier_aggregate(xq, ...)`` (optional override): the two-tier DCN hop.
+    The base implementation is generic (encode, gather wires, fold through
+    ``aggregate`` when present else decode-and-sum), which makes EVERY
+    registered codec DCN-eligible; int8/topk override it with the seed's
+    bit-exact shared-scale / shared-mask forms.
+
+Error feedback is owned by the transport (comm/codec.py entry EF), not the
+codec: a codec is a pure ``encode``/``decode`` pair and the residual
+``x - decode(encode(x))`` carries to the next round with the same
+snapshot/rewind and degrade-flush contracts as the seed int8 path.
+
+The registry also hosts the convergence guardrail for calibrated
+assignments (tuner/calibrate.py): requests running a calibrated non-int8
+codec register here; the sentinel's loss z-score screen feeds
+``guard_note`` and a sustained breach demotes every registered set to int8
+— one DEGRADE-ladder rung with an exactly-once EF flush, pinned bit-exact
+like every other fallback.
+"""
+
+from __future__ import annotations
+
+import threading
+import weakref
+from typing import Callable, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from mlsl_tpu.log import mlsl_assert
+
+__all__ = [
+    "Codec", "register", "get", "names", "configure", "assigned",
+    "guard_register", "guard_unregister", "guard_note", "guard_reset",
+    "guard_status", "status",
+]
+
+
+def _bytes_of_f32(x: jax.Array) -> jax.Array:
+    """f32 (...,) -> uint8 (...*4,) little-endian byte image."""
+    return lax.bitcast_convert_type(x.astype(jnp.float32), jnp.uint8).reshape(-1)
+
+
+def _f32_of_bytes(w: jax.Array, n: int) -> jax.Array:
+    """uint8 (4n,) byte image -> f32 (n,)."""
+    return lax.bitcast_convert_type(w.reshape(n, 4), jnp.float32)
+
+
+class Codec:
+    """Base contract. Subclasses set ``name`` and implement encode/decode;
+    everything else has a generic default. Instances are immutable after
+    construction (they are cached and shared across requests)."""
+
+    name: str = "?"
+    wire_dtype: str = "uint8"
+    #: True when decode(encode(x)) == x bitwise for every finite f32 input
+    #: (the registry's exact-sum parity class; f32 and ratio-1 prune)
+    lossless: bool = False
+
+    #: optional compressed-domain pairwise sum (THC hook); None = the
+    #: transport decodes-and-adds each hop and EF absorbs the difference
+    aggregate: Optional[Callable] = None
+
+    def __init__(self) -> None:
+        self._custom = None
+
+    # -- identity ----------------------------------------------------------
+
+    def knob_key(self) -> Tuple:
+        """Hashable identity of this configured instance (cache key)."""
+        return (self.name,)
+
+    # -- wire --------------------------------------------------------------
+
+    def encode(self, x: jax.Array) -> jax.Array:
+        raise NotImplementedError
+
+    def decode(self, wire: jax.Array, n: int) -> jax.Array:
+        raise NotImplementedError
+
+    def wire_len(self, n: int) -> int:
+        """Wire elements (uint8 bytes) for an n-element f32 chunk."""
+        raise NotImplementedError
+
+    def wire_bytes(self, n: int) -> int:
+        return self.wire_len(n)  # uint8 wire: elements == bytes
+
+    def geometry(self, n: int) -> dict:
+        """Static geometry the verifier pins (analysis/plan.py A115/A116)."""
+        return {"codec": self.name, "chunk": int(n),
+                "wire_len": int(self.wire_len(n))}
+
+    # -- hier DCN hop ------------------------------------------------------
+
+    def hier_aggregate(self, xq: jax.Array, *, axis, inter, t: int):
+        """One inter-slice hop of the two-tier lowering: compress the local
+        (slen,) shard, exchange wires across the t slice-peers, return the
+        reduced shard and the entry EF residual. Generic form; codecs with
+        a cheaper shared-statistics exchange override it."""
+        n = xq.shape[0]
+        w = self.encode(xq)
+        xhat = self.decode(w, n)
+        new_err = xq - xhat
+        if t == 1:
+            return xhat, new_err
+        # mlsl-lint: disable=A201 -- the DCN-hop wire exchange runs INSIDE
+        # the hier collective program (comm/algos/hier.py dcn_hop); the
+        # engine routed here, there is no outer collective to defer to
+        gathered = lax.all_gather(w, axis, axis_index_groups=inter)
+        if self.aggregate is not None:
+            acc = gathered[0]
+            for i in range(1, t):  # t is static: unrolled compressed fold
+                acc = self.aggregate(acc, gathered[i])
+            red = self.decode(acc, n)
+        else:
+            red = self.decode(gathered[0], n)
+            for i in range(1, t):
+                red = red + self.decode(gathered[i], n)
+        return red, new_err
+
+    # -- transport adapter -------------------------------------------------
+
+    def as_custom(self):
+        """Wrap as a comm.codec.CustomCodec so build_custom_collective
+        supplies the full ring/EF/degrade/chaos machinery. Cached per
+        instance: the CustomCodec program cache must persist."""
+        if self._custom is None:
+            from mlsl_tpu.comm.codec import CustomCodec
+
+            self._custom = CustomCodec(
+                compress=self.encode,
+                decompress=self.decode,
+                reduce=self.aggregate,
+                name=f"registry:{self.name}",
+            )
+        return self._custom
+
+
+# -- registry ----------------------------------------------------------------
+
+_REGISTRY: Dict[str, type] = {}
+_INSTANCES: Dict[Tuple, Codec] = {}
+_ILOCK = threading.Lock()
+
+
+def register(cls):
+    """Class decorator: add a Codec subclass to the registry by its name."""
+    mlsl_assert(
+        isinstance(cls.name, str) and cls.name not in ("", "?"),
+        "codec class %s must set a name", cls,
+    )
+    _REGISTRY[cls.name] = cls
+    return cls
+
+
+def names() -> Tuple[str, ...]:
+    _ensure_builtin()
+    return tuple(sorted(_REGISTRY))
+
+
+def get(name: str, **knobs) -> Codec:
+    """Cached codec instance for (name, knobs); default knobs when omitted."""
+    _ensure_builtin()
+    mlsl_assert(
+        name in _REGISTRY,
+        "unknown codec %r (registry: %s)", name, ", ".join(sorted(_REGISTRY)),
+    )
+    probe = _REGISTRY[name](**knobs)
+    key = probe.knob_key()
+    with _ILOCK:
+        inst = _INSTANCES.get(key)
+        if inst is None:
+            inst = _INSTANCES[key] = probe
+    return inst
+
+
+def configure(name: str, config=None, cell: Optional[dict] = None) -> Codec:
+    """Codec instance with knobs resolved from a calibration cell (first)
+    then the session Config (MLSL_* knobs), then codec defaults."""
+    cell = cell or {}
+    params = cell.get("params", {}) or {}
+
+    def pick(key, cfg_attr, default):
+        if key in params:
+            return params[key]
+        if config is not None and cfg_attr:
+            return getattr(config, cfg_attr, default)
+        return default
+
+    if name == "int8":
+        block = cell.get("block") or pick("block", "quant_block_elems", 256)
+        return get("int8", block=int(block))
+    if name == "vq":
+        import numpy as np
+
+        cb = params.get("codebook")
+        return get(
+            "vq",
+            dim=int(pick("vq_dim", "vq_dim", 4)),
+            k=int(pick("vq_codebook", "vq_codebook", 16)),
+            codebook=np.asarray(cb, dtype=np.float32) if cb is not None else None,
+        )
+    if name == "prune":
+        return get("prune", ratio=float(pick("ratio", "prune_ratio", 0.05)))
+    if name == "topk":
+        return get("topk", ratio=float(pick("ratio", "topk_ratio", 0.01)))
+    return get(name)
+
+
+def _ensure_builtin() -> None:
+    # import-cycle-free lazy registration of the shipped members; Python's
+    # module cache makes repeat calls free
+    from mlsl_tpu.codecs import prune, vq  # noqa: F401  (register on import)
+
+
+# -- built-in members: the seed trio behind the contract ---------------------
+
+
+@register
+class Int8Codec(Codec):
+    """Blockwise int8 (the seed default): per-block max-abs scale, RNE round
+    (ops/quant_kernels.py reference semantics). Wire = int8 payload bytes ++
+    f32 scale bytes. The hier hop overrides with the seed's shared-scale
+    integer-sum exchange — the THC special case the registry generalizes."""
+
+    name = "int8"
+
+    def __init__(self, block: int = 256) -> None:
+        super().__init__()
+        mlsl_assert(block >= 1, "int8 codec block must be >= 1 (got %r)", block)
+        self.block = int(block)
+
+    def knob_key(self):
+        return ("int8", self.block)
+
+    def _nb(self, n: int) -> int:
+        return -(-n // self.block)
+
+    def wire_len(self, n: int) -> int:
+        return self._nb(n) * self.block + 4 * self._nb(n)
+
+    def geometry(self, n: int) -> dict:
+        g = super().geometry(n)
+        g.update(block=self.block, n_blocks=self._nb(n))
+        return g
+
+    def encode(self, x: jax.Array) -> jax.Array:
+        from mlsl_tpu.ops.quant_kernels import quantize_blocks_ref
+
+        n = x.shape[0]
+        nb = self._nb(n)
+        x2 = jnp.pad(x.astype(jnp.float32), (0, nb * self.block - n))
+        q, s = quantize_blocks_ref(x2.reshape(nb, self.block))
+        return jnp.concatenate(
+            [lax.bitcast_convert_type(q, jnp.uint8).reshape(-1), _bytes_of_f32(s)]
+        )
+
+    def decode(self, wire: jax.Array, n: int) -> jax.Array:
+        from mlsl_tpu.ops.quant_kernels import dequantize_blocks_ref
+
+        nb = self._nb(n)
+        body = nb * self.block
+        q = lax.bitcast_convert_type(wire[:body], jnp.int8)
+        s = _f32_of_bytes(wire[body:body + 4 * nb], nb)
+        return dequantize_blocks_ref(q.reshape(nb, self.block), s).reshape(-1)[:n]
+
+    def hier_aggregate(self, xq, *, axis, inter, t):
+        from mlsl_tpu.comm.algos import hier
+
+        return hier._block_quant_shared(xq, self.block, axis, inter, t)
+
+
+@register
+class F32Codec(Codec):
+    """Identity byte-image codec: the dense wire expressed in registry terms.
+    Lossless, and its ``aggregate`` is an exact compressed-domain f32 add —
+    the simplest THC member, and the contract the dlopen ``reduce_sum_fn``
+    of a user CustomCodec plugs into."""
+
+    name = "f32"
+    lossless = True
+
+    def knob_key(self):
+        return ("f32",)
+
+    def wire_len(self, n: int) -> int:
+        return 4 * n
+
+    def encode(self, x: jax.Array) -> jax.Array:
+        return _bytes_of_f32(x)
+
+    def decode(self, wire: jax.Array, n: int) -> jax.Array:
+        return _f32_of_bytes(wire, n)
+
+    def aggregate(self, a: jax.Array, b: jax.Array) -> jax.Array:
+        n = a.shape[0] // 4
+        return _bytes_of_f32(_f32_of_bytes(a, n) + _f32_of_bytes(b, n))
+
+    def hier_aggregate(self, xq, *, axis, inter, t):
+        from mlsl_tpu.comm.algos import hier
+
+        red = hier._inter_sum(xq, axis, inter) if t > 1 else xq
+        return red, jnp.zeros_like(xq)  # dense hop: residual fully drained
+
+
+# -- assignment resolution ---------------------------------------------------
+
+
+def assigned(config, req_name: str) -> Tuple[str, Optional[dict], str]:
+    """Resolve the codec for a QUANTIZATION-compressed request.
+
+    Precedence (docs/TUNING.md §22): explicit ``MLSL_CODEC`` env >
+    calibrated per-set assignment (``config.codec_assignment``, written by
+    tuner/calibrate.py under ``MLSL_TUNE_CODEC=1``) > programmatic
+    ``config.codec`` > the seed default int8. Returns
+    ``(name, cell_or_None, source)`` where source is one of
+    env/calibrated/config/default."""
+    if config is None:
+        return "int8", None, "default"
+    forced = getattr(config, "codec", "") or ""
+    explicit = getattr(config, "_explicit", ()) or ()
+    if forced and "codec" in explicit:
+        return forced, None, "env"
+    asn = getattr(config, "codec_assignment", None) or {}
+    cell = asn.get(req_name)
+    if isinstance(cell, dict) and cell.get("codec"):
+        return str(cell["codec"]), cell, "calibrated"
+    if forced:
+        return forced, None, "config"
+    return "int8", None, "default"
+
+
+# -- convergence guardrail (sentinel loss z-score -> int8 demotion) ----------
+
+_GLOCK = threading.Lock()
+_GUARDED: Dict[int, "weakref.ReferenceType"] = {}
+_BREACH_STREAK = 0
+
+
+def guard_register(req) -> None:
+    """Register a live request running a CALIBRATED non-int8 codec; the
+    sentinel's loss screen can demote it (weakref: a dropped request
+    unregisters itself)."""
+    with _GLOCK:
+        _GUARDED[id(req)] = weakref.ref(req)
+
+
+def guard_unregister(req) -> None:
+    with _GLOCK:
+        _GUARDED.pop(id(req), None)
+
+
+def guard_active() -> bool:
+    with _GLOCK:
+        return any(w() is not None for w in _GUARDED.values())
+
+
+def guard_note(loss_outlier: bool, *, window: int = 3, step: int = -1) -> bool:
+    """One screened step's verdict from the sentinel gate. A healthy step
+    resets the streak; ``window`` consecutive loss z-score breaches while a
+    calibrated codec is live demote every guarded set to int8. Returns True
+    when a demotion fired this call."""
+    global _BREACH_STREAK
+    with _GLOCK:
+        live = [r for r in (w() for w in _GUARDED.values()) if r is not None]
+        if not live:
+            _GUARDED.clear()
+            _BREACH_STREAK = 0
+            return False
+        if not loss_outlier:
+            _BREACH_STREAK = 0
+            return False
+        _BREACH_STREAK += 1
+        from mlsl_tpu.core import stats as stats_mod
+
+        stats_mod.record_codec("guard_breaches")
+        if _BREACH_STREAK < max(1, int(window)):
+            return False
+        _GUARDED.clear()
+        _BREACH_STREAK = 0
+    reason = f"sentinel loss z-score breach x{window} (step {step})"
+    for req in live:
+        req.demote_codec(reason)
+    return True
+
+
+def guard_reset() -> None:
+    """Test/lifecycle hook: forget guarded requests and the breach streak."""
+    global _BREACH_STREAK
+    with _GLOCK:
+        _GUARDED.clear()
+        _BREACH_STREAK = 0
+
+
+def guard_status() -> dict:
+    with _GLOCK:
+        live = [r for r in (w() for w in _GUARDED.values()) if r is not None]
+        return {
+            "guarded": sorted(getattr(r, "name", "?") for r in live),
+            "breach_streak": _BREACH_STREAK,
+        }
+
+
+def status() -> dict:
+    """JSON-serializable section for supervisor.status() / /healthz."""
+    from mlsl_tpu.core import stats as stats_mod
+
+    out = {"registered": list(names())}
+    out.update(guard_status())
+    out["counters"] = dict(stats_mod.CODEC_COUNTERS)
+    out["wire_bytes"] = dict(stats_mod.CODEC_WIRE_BYTES)
+    out["demotions"] = list(stats_mod.CODEC_DEMOTIONS)
+    return out

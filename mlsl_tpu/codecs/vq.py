@@ -1,0 +1,134 @@
+"""GradiVeQ-style vector-quantization codec (arXiv:1811.03617).
+
+Gradients are sliced into ``dim``-element vectors; each vector is assigned
+to its nearest row of a ``k``-row codebook; the wire carries one index byte
+per vector plus the codebook and a global scale, so decode is fully
+self-contained (no side-channel state, and a hop peer needs nothing but the
+wire image). Compression for dim=4, k<=256 is ~16x vs the f32 wire at
+``n + 16*k + 4`` bytes per n-element chunk.
+
+The codebook is learned OFFLINE by tuner/calibrate.py from a short gradient
+sample (deterministic Lloyd iterations over max-abs-normalized vectors) and
+rides in the calibration cell; an uncalibrated instance uses a fixed
+deterministic default so the codec is usable standalone. Lossy in general —
+entry error feedback (comm/codec.py) carries the residual — but exact
+whenever the normalized input vectors are codebook rows and the scale is a
+power of two, which is how the parity tests pin it.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from mlsl_tpu.codecs import Codec, _bytes_of_f32, _f32_of_bytes, register
+from mlsl_tpu.log import mlsl_assert
+
+
+def default_codebook(k: int, dim: int) -> np.ndarray:
+    """Deterministic starter codebook: a fixed-seed Gaussian cloud scaled to
+    unit max-abs (inputs are normalized to max|x| == 1 before assignment),
+    with row 0 pinned to the zero vector so sparse gradients round-trip
+    their zero blocks exactly."""
+    rng = np.random.default_rng(0)
+    cb = rng.standard_normal((k, dim)).astype(np.float32)
+    cb /= max(1e-12, np.max(np.abs(cb)))
+    cb[0] = 0.0
+    return cb
+
+
+@register
+class VQCodec(Codec):
+    """Per-block VQ indices + codebook on the wire."""
+
+    name = "vq"
+
+    def __init__(self, dim: int = 4, k: int = 16,
+                 codebook: Optional[np.ndarray] = None) -> None:
+        super().__init__()
+        mlsl_assert(1 <= dim <= 64, "vq dim must be in [1, 64] (got %r)", dim)
+        mlsl_assert(2 <= k <= 256, "vq codebook size must be in [2, 256] "
+                    "(one index byte per vector; got %r)", k)
+        self.dim = int(dim)
+        self.k = int(k)
+        cb = default_codebook(self.k, self.dim) if codebook is None else (
+            np.asarray(codebook, dtype=np.float32))
+        mlsl_assert(cb.shape == (self.k, self.dim),
+                    "vq codebook shape %r != (k=%d, dim=%d)",
+                    cb.shape, self.k, self.dim)
+        self.codebook = cb
+        self._cb_digest = hash(cb.tobytes())
+
+    def knob_key(self):
+        return ("vq", self.dim, self.k, self._cb_digest)
+
+    # -- geometry ----------------------------------------------------------
+
+    def _nvec(self, n: int) -> int:
+        return -(-n // self.dim)
+
+    def wire_len(self, n: int) -> int:
+        # index byte per vector ++ f32 codebook image ++ f32 scale
+        return self._nvec(n) + 4 * self.k * self.dim + 4
+
+    def geometry(self, n: int) -> dict:
+        g = super().geometry(n)
+        g.update(dim=self.dim, k=self.k, idx_elems=self._nvec(n),
+                 codebook_elems=self.k * self.dim)
+        return g
+
+    # -- wire --------------------------------------------------------------
+
+    def encode(self, x: jax.Array) -> jax.Array:
+        n = x.shape[0]
+        nv = self._nvec(n)
+        xf = jnp.pad(x.astype(jnp.float32), (0, nv * self.dim - n))
+        amax = jnp.max(jnp.abs(xf))
+        scale = jnp.where(amax == 0, 1.0, amax).astype(jnp.float32)
+        vecs = (xf / scale).reshape(nv, self.dim)
+        cb = jnp.asarray(self.codebook)
+        # nearest codebook row by squared distance; argmin ties break low,
+        # matching the numpy oracle in the tests
+        d2 = jnp.sum((vecs[:, None, :] - cb[None, :, :]) ** 2, axis=-1)
+        idx = jnp.argmin(d2, axis=1).astype(jnp.uint8)
+        return jnp.concatenate([
+            idx,
+            _bytes_of_f32(cb.reshape(-1)),
+            _bytes_of_f32(scale.reshape(1)),
+        ])
+
+    def decode(self, wire: jax.Array, n: int) -> jax.Array:
+        nv = self._nvec(n)
+        cb_elems = self.k * self.dim
+        idx = lax.convert_element_type(wire[:nv], jnp.int32)
+        cb = _f32_of_bytes(wire[nv:nv + 4 * cb_elems], cb_elems)
+        cb = cb.reshape(self.k, self.dim)
+        scale = _f32_of_bytes(wire[nv + 4 * cb_elems:nv + 4 * cb_elems + 4], 1)[0]
+        return (cb[idx] * scale).reshape(-1)[:n]
+
+
+def learn_codebook(sample: np.ndarray, k: int, dim: int,
+                   iters: int = 8) -> np.ndarray:
+    """Deterministic Lloyd iterations over max-abs-normalized sample vectors
+    (the calibration-time codebook fit; pure numpy, no RNG beyond the fixed
+    default_codebook init). ``sample`` is any f32 array; it is flattened,
+    padded to the vector grid, and normalized per the encode contract."""
+    flat = np.asarray(sample, dtype=np.float32).reshape(-1)
+    nv = -(-flat.size // dim)
+    flat = np.pad(flat, (0, nv * dim - flat.size))
+    amax = float(np.max(np.abs(flat))) if flat.size else 0.0
+    vecs = (flat / (amax if amax > 0 else 1.0)).reshape(nv, dim)
+    cb = default_codebook(k, dim).copy()
+    for _ in range(max(1, int(iters))):
+        d2 = ((vecs[:, None, :] - cb[None, :, :]) ** 2).sum(axis=-1)
+        idx = np.argmin(d2, axis=1)
+        for j in range(k):
+            hit = vecs[idx == j]
+            if hit.size:
+                cb[j] = hit.mean(axis=0)
+    cb[0] = 0.0  # keep the zero row: sparse blocks stay exact
+    return cb.astype(np.float32)

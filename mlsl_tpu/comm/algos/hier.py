@@ -50,8 +50,10 @@ from mlsl_tpu.comm.mesh import ProcessGroup, world_tier_ids
 from mlsl_tpu.comm.collectives import _axis_sizes
 from mlsl_tpu.log import mlsl_assert
 
-#: DCN-tier codecs (the ICI tier is always f32 — its phases are exact)
-DCN_CODECS = ("int8", "f32", "topk")
+#: DCN-tier codecs (the ICI tier is always f32 — its phases are exact).
+#: int8/f32/topk keep their hand-written bit-exact hops; the rest route
+#: through the registry's ``Codec.hier_aggregate`` (mlsl_tpu.codecs).
+DCN_CODECS = ("int8", "f32", "topk", "vq", "prune")
 DEFAULT_DCN_CODEC = "int8"
 
 
@@ -393,6 +395,14 @@ def quant_steps(
     codec = dcn_codec(codec)
     if t == 1:
         codec = "f32"  # nothing crosses the DCN; never quantize on ICI
+    reg = None
+    if codec not in ("int8", "topk", "f32"):
+        # registry-routed DCN codec: resolve the instance once, outside the
+        # traced phases; knobs come from the process env (MLSL_VQ_*,
+        # MLSL_PRUNE_RATIO) since quant_steps has no session Config in hand.
+        from mlsl_tpu import codecs as codecs_mod
+        from mlsl_tpu.config import Config
+        reg = codecs_mod.configure(codec, Config.from_env())
 
     def prep(x, mypos, err):
         xp = x.astype(jnp.float32)
@@ -418,6 +428,9 @@ def quant_steps(
             red, new_err = _block_quant_shared(xq, block, axis, inter, t)
         elif codec == "topk":
             red, new_err = _topk_shared(xq, topk_ratio, axis, inter, t)
+        elif reg is not None:  # registry codec: wire exchange + aggregate
+            red, new_err = reg.hier_aggregate(xq, axis=axis, inter=inter,
+                                              t=t)
         else:  # f32: exact hop, residual fully delivered and reset
             red = _inter_sum(xq, axis, inter, t)
             new_err = jnp.zeros_like(xq)
@@ -483,6 +496,9 @@ def dcn_wire_bytes(count: int, tiers: Tuple[int, int], codec: str,
         per = slen * 1 + 4 * (slen // block)  # q + the shared-scale pmax
     elif codec == "topk":
         per = slen * 4  # dense psum carries the masked shard (sim mesh)
+    elif codec not in ("f32", "none"):  # "none" = hier_bench's uncompressed
+        from mlsl_tpu import codecs as codecs_mod
+        per = codecs_mod.configure(codec).wire_len(slen)  # encoded shard
     else:
         per = slen * 4
     return int(2 * (t - 1) / t * per)

@@ -18,6 +18,14 @@ Two TPU-native plug-in forms, registered via ``Environment.set_quantization_para
    programs, CPU mesh), not peak ICI bandwidth — the reference's codec is likewise
    host CPU code running in the endpoint servers.
 
+Since the codec-lab PR this transport ALSO carries the registry codecs
+(``mlsl_tpu.codecs``): a registered ``Codec`` wraps itself into a
+:class:`CustomCodec` via ``Codec.as_custom()`` (compress=encode,
+decompress=decode, reduce=the optional compressed-domain ``aggregate``), so
+vq/prune/f32 ride the same compressed-ring programs, entry error feedback,
+and chaos-roundtrip wrapper as a user dlopen codec — one wire family, three
+front doors (registry name, Python callables, shared library).
+
 Error feedback is functional and framework-owned in both forms: the residual
 ``err' = (x + err) - decompress(compress(x + err))`` is carried per request
 (CommRequest._err), matching quant_quantize's per-buffer diff semantics

@@ -15,6 +15,12 @@ returned functionally: callers carry it between iterations
 Ring index math: rank p's travelling partial starts at chunk (p-1) mod G; after G-1
 hops it has accumulated all ranks' contributions for chunk p (MPI reduce-scatter
 placement). The all-gather phase then circulates each rank's owned chunk.
+
+Registry note (mlsl_tpu.codecs): this module stays the int8 seed wire — the
+codec lab's ``Int8Codec`` wraps ``quantize_blocks_ref``/``dequantize_blocks_ref``
+behind the declared encode/decode/geometry contract (and ``hier._block_quant_shared``
+behind its DCN-hop hook), so a calibrated per-set block lands here through the
+same programs; non-int8 registry codecs route through comm/codec.py instead.
 """
 
 from __future__ import annotations

@@ -84,6 +84,13 @@ class CommDesc:
     recv_offsets: Optional[tuple] = None
     pairs: Optional[tuple] = None  # sendrecv: ((src, dst), ...) member indices
     compression: CompressionType = CompressionType.NONE
+    # registry codec pin (mlsl_tpu.codecs) for QUANTIZATION wires: '' = let
+    # setup() resolve by request name (explicit MLSL_CODEC > calibrated
+    # assignment > config.codec > int8); set by bucketing (members share one
+    # codec) and by the guardrail demotion (pin to int8)
+    codec: str = ""
+    # per-set quant block override (0 = config.quant_block_elems)
+    quant_block: int = 0
 
     def payload_bytes(self) -> int:
         return self.count * dtype_size(self.data_type)
@@ -150,6 +157,20 @@ class CommRequest:
         # extra dispatch-span attribution (e.g. the pallas_ring 'pallas.hop'
         # wire plan), precomputed at setup so the hot path pays one **splat
         self._span_args: dict = {}
+        # codec-lab state (mlsl_tpu.codecs): per-chunk registry geometry for
+        # the verifier (A115/A116), the per-start wire accounting tuple
+        # (codec label, compressed image bytes), the demotion latch, and the
+        # pending exactly-once EF flush a demotion leaves for the next
+        # successful dispatch
+        self._codec_geoms: Optional[List[dict]] = None
+        self._wire_rec: Optional[tuple] = None
+        self._codec_demoted = False
+        self._pending_flush: Optional[tuple] = None
+        self.codec_name = ""      # resolved registry name ("" until setup)
+        self.codec_source = ""    # env/calibrated/config/desc/demoted/...
+        # effective int8 block (desc override > calibration cell > config):
+        # the A112 geometry check must model THIS, not the session block
+        self._eff_quant_block = 0
         # per-Start hot-path constants (VERDICT r4 item 3: keep the host
         # dispatch floor low — no per-dispatch string building / re-derivation)
         self._trace_name = f"mlsl:{desc.kind}:{name or self.uid}"
@@ -173,11 +194,15 @@ class CommRequest:
                 d.kind, d.op,
             )
             _check_recv_count(d)
+            ratio = self.dispatcher.config.topk_ratio
             self._quant_fn, self._err_len = sparse.build_sparse_collective(
-                d.kind, d.group, d.count, self.dispatcher.config.topk_ratio
+                d.kind, d.group, d.count, ratio
             )
             self._chunk_slices = [slice(None)]
             self.algo = "topk"
+            # per-codec wire accounting: the sparse image is k (value, index)
+            # pairs of one full payload (core/stats CODEC_WIRE_BYTES)
+            self._wire_rec = ("topk", 8 * max(1, int(d.count * ratio)))
             # ladder: the sparse wire rides the codec subsystem's breaker;
             # its residual is already in the logical layout ('flat')
             self._breaker = supervisor.breaker("quant")
@@ -196,8 +221,70 @@ class CommRequest:
                 d.op,
             )
             _check_recv_count(d)
-            codec = getattr(self.dispatcher.config, "custom_codec", None)
+            from mlsl_tpu import codecs as codecs_mod
+
+            cfg = self.dispatcher.config
+            codec = getattr(cfg, "custom_codec", None)
+            # registry resolution (mlsl_tpu.codecs.assigned): a user-plugged
+            # CustomCodec wins outright (the dlopen contract predates the
+            # registry); then an explicit desc pin (bucketing / demotion),
+            # then MLSL_CODEC / the calibrated per-set assignment
+            self._codec_geoms = None
+            # setup() re-entry (calibration re-route at commit, guardrail
+            # demotion): drop every stale program/geometry; residual state is
+            # either virgin (pre-start) or was consumed by the caller
+            # (demote_codec's exactly-once flush capture)
+            self._quant_fn = None
+            self._quant_fns = None
+            self._err_lens = None
+            self._err = None
+            self._errs = None
+            self._degrade_fns = None
+            self._wire_rec = None
+            self._span_args = {}
+            reg_name, reg_cell, reg_src = "int8", None, "default"
+            if codec is None:
+                if self._codec_demoted:
+                    reg_name, reg_src = "int8", "demoted"
+                elif d.codec:
+                    reg_name, reg_src = d.codec, "desc"
+                else:
+                    reg_name, reg_cell, reg_src = codecs_mod.assigned(
+                        cfg, self.name
+                    )
+            # resolved identity for bucketing partitions / introspection
+            self.codec_name = "custom" if codec is not None else reg_name
+            self.codec_source = "custom" if codec is not None else reg_src
+            block = int(
+                d.quant_block or (reg_cell or {}).get("block", 0)
+                or cfg.quant_block_elems
+            )
+            self._eff_quant_block = block
+            if codec is None and reg_name == "topk":
+                # registry route into the seed sparsifier: same wire, same
+                # flat residual layout, ratio from the calibration cell
+                from mlsl_tpu.comm import sparse
+
+                ratio = float(
+                    (reg_cell or {}).get("params", {}).get("ratio", 0)
+                    or cfg.topk_ratio
+                )
+                self._quant_fn, self._err_len = sparse.build_sparse_collective(
+                    d.kind, d.group, d.count, ratio
+                )
+                self._chunk_slices = [slice(None)]
+                self.algo = "topk"
+                self._breaker = supervisor.breaker("quant")
+                self._degrade_subsys = "quant"
+                self._err_layout = "flat"
+                self._degrade_geoms = [(d.count, self._err_len)]
+                self._wire_rec = ("topk", 8 * max(1, int(d.count * ratio)))
+                if reg_src == "calibrated":
+                    codecs_mod.guard_register(self)
+                self.is_setup = True
+                return
             self.algo = "custom_codec" if codec is not None else "quant_ring"
+            reg_codec = None
             if codec is not None:
                 # user-pluggable codec (reference dlopen contract,
                 # quant/quant.c:96-133): compressed ring wire, framework-owned
@@ -208,11 +295,22 @@ class CommRequest:
                     return codec_mod.build_custom_collective(
                         d.kind, d.group, n, codec
                     )
+            elif reg_name != "int8":
+                # registry codec ('vq'/'prune'/'f32'/plugins) on the SAME
+                # compressed-ring transport as the dlopen contract: entry EF,
+                # per-hop encode, compressed-domain aggregate when declared
+                from mlsl_tpu.comm import codec as codec_mod
+
+                reg_codec = codecs_mod.configure(reg_name, cfg, reg_cell)
+                wrapped = reg_codec.as_custom()
+                self.algo = f"codec:{reg_name}"
+
+                def build(n):
+                    return codec_mod.build_custom_collective(
+                        d.kind, d.group, n, wrapped
+                    )
             else:
                 from mlsl_tpu.comm import quant_ring
-
-                cfg = self.dispatcher.config
-                block = cfg.quant_block_elems
                 # hop-engine selection through the PR 4 table: a forced or
                 # tuned 'pallas_ring' routes the SAME compressed wire family
                 # through the fused kernel (identical entry error feedback,
@@ -292,6 +390,38 @@ class CommRequest:
                 )
             else:
                 self._err_layout = "ring"  # quant_ring AND custom_codec
+            # codec-lab accounting: per-chunk registry geometry (the
+            # verifier's A115/A116 anchor — what the programs were ACTUALLY
+            # built from) and the per-start wire-byte record. Wire bytes are
+            # the compressed image of one full payload — the codec-comparable
+            # signal, not per-hop wire traffic (which varies by ring shape).
+            g_sz = 1 if d.group.is_self else d.group.size
+            rs = d.kind == "reduce_scatter"
+            if reg_codec is not None:
+                self._codec_geoms = []
+                for n, el in self._degrade_geoms:
+                    hop = n // g_sz if rs else -(-n // g_sz)
+                    geom = reg_codec.geometry(hop)
+                    geom["err_len"] = int(el)
+                    geom["hops"] = g_sz
+                    self._codec_geoms.append(geom)
+                self._wire_rec = (reg_name, sum(
+                    reg_codec.wire_len(n) for n, _ in self._degrade_geoms
+                ))
+            elif codec is not None:
+                self._wire_rec = ("custom", _custom_wire_bytes(
+                    codec, self._degrade_geoms
+                ))
+            else:
+                int8_image = codecs_mod.get("int8", block=block)
+                self._wire_rec = ("int8", sum(
+                    int8_image.wire_len(n) for n, _ in self._degrade_geoms
+                ))
+            if reg_src == "calibrated" and reg_name != "int8":
+                # calibrated non-int8 assignment: place this request under
+                # the sentinel-fed convergence guardrail (demotes to int8 on
+                # a sustained loss z-score breach)
+                codecs_mod.guard_register(self)
             self.is_setup = True
             return
         if d.kind == "barrier":
@@ -533,6 +663,10 @@ class CommRequest:
             tr.instant("submit", "req", track=self._trace_name,
                        req=self.name or self.uid, epoch=self._epoch,
                        bytes=self._payload)
+        if self._wire_rec is not None:
+            # per-codec wire accounting (one dict upsert, like the ALGO
+            # dispatch line): compressed image bytes of this round's payload
+            stats_mod.record_codec_wire(*self._wire_rec)
         self.dispatcher.submit(self, buf)
         return self
 
@@ -672,9 +806,15 @@ class CommRequest:
             buf = topo0.adopt_buffer(buf)
         stats_mod.record_degrade(self._degrade_subsys or "?", "fallback")
         if self._quant_fn is not None or self._quant_fns is not None:
+            pf = self._pending_flush
+            if pf is not None:
+                # a breaker degrade racing a codec demotion: the demoted
+                # codec's captured residual still rides this round
+                buf = pf[0](buf, *pf[1])
             flush, plain = self._degrade_programs()
             out = plain(flush(buf, *self._take_residuals()))
             self._results = [out]
+            self._pending_flush = None
             stats_mod.record_algo_dispatch(d.kind, "degraded-plain")
             return
         # dense engine path: tuned/forced algorithm -> the 'lax' baseline
@@ -769,6 +909,42 @@ class CommRequest:
         self._err = None
         return [err]
 
+    def demote_codec(self, reason: str = "") -> None:
+        """Convergence-guardrail demotion (mlsl_tpu.codecs.guard_note): pin
+        this request's compressed wire to the int8 seed codec. One
+        DEGRADE-ladder rung: the demoted codec's EF residual is captured
+        through the SAME flush program the breaker fallback uses and folded
+        into the next successful dispatch exactly once; from then on the
+        programs are bit-for-bit the plain int8 quant_ring build (the
+        pinned-fallback contract every other rung honors)."""
+        from mlsl_tpu import codecs as codecs_mod
+
+        with self._dlock:
+            if (
+                self._codec_demoted
+                or self.desc.compression != CompressionType.QUANTIZATION
+                or (self._quant_fn is None and self._quant_fns is None)
+            ):
+                return
+            label = self.algo
+            # capture the OLD geometry's flush before setup() rebuilds:
+            # residuals are consumed here (reset to virgin) and delivered by
+            # whichever dispatch next succeeds (_dispatch_inner)
+            flush, _ = self._degrade_programs()
+            self._pending_flush = (flush, self._take_residuals())
+            self._codec_demoted = True
+            self._ef_snapshot = (None, None)
+            self.setup()
+        codecs_mod.guard_unregister(self)
+        stats_mod.record_codec_demotion(
+            self.name or str(self.uid), label, reason or "guardrail"
+        )
+        log_warning(
+            "codec guardrail: %s demoted %s -> int8 (%s); residual flushes "
+            "with the next round", self.name or self.uid, label,
+            reason or "guardrail",
+        )
+
     def _dispatch_inner(self, buf: jax.Array) -> None:
         # per-algorithm launch attribution (ALGO line in mlsl_stats.log);
         # one dict upsert — stays under the per-layer dispatch-floor budget
@@ -783,6 +959,14 @@ class CommRequest:
         ):
             buf = topo0.adopt_buffer(buf)
         if self._quant_fn is not None or self._quant_fns is not None:
+            pf = self._pending_flush
+            if pf is not None:
+                # demotion's exactly-once EF flush: fold the demoted codec's
+                # captured residual into this round's payload. Cleared only
+                # after the dispatch succeeds — a transient failure replays
+                # against the ORIGINAL buffer, so the residual lands in
+                # exactly one delivered round, never zero, never two.
+                buf = pf[0](buf, *pf[1])
             topo = self.desc.group.topology
             if self._quant_fns is not None:
                 if self._errs is None:
@@ -796,6 +980,7 @@ class CommRequest:
                 for i, (fn, sl) in enumerate(zip(self._quant_fns, self._chunk_slices)):
                     out, self._errs[i] = fn(buf[..., sl], self._errs[i])
                     self._results.append(out)
+                self._pending_flush = None
                 return
             if self._err is None:
                 self._err = topo.shard_buffer(
@@ -803,6 +988,7 @@ class CommRequest:
                 )
             out, self._err = self._quant_fn(buf, self._err)
             self._results = [out]
+            self._pending_flush = None
             return
         if self._single_full:
             self._results = [self._fns[0](buf)]
@@ -1070,6 +1256,22 @@ def _unwrap_chaos(fn):
     faults target, and a 'hang' would wedge Commit where no watchdog is
     armed."""
     return getattr(fn, "_mlsl_inner", fn)
+
+
+def _custom_wire_bytes(codec, geoms) -> int:
+    """Compressed-image bytes of one full payload under a user CustomCodec,
+    via shape-only tracing of its compress fn (0 when untraceable — the
+    stats row then reads 'custom: 0' rather than lying)."""
+    total = 0
+    for n, _ in geoms:
+        try:
+            out = jax.eval_shape(
+                codec.compress, jax.ShapeDtypeStruct((n,), jnp.float32)
+            )
+            total += int(np.prod(out.shape)) * np.dtype(out.dtype).itemsize
+        except Exception:
+            return 0
+    return total
 
 
 def _check_recv_count(d: CommDesc) -> None:

@@ -13,6 +13,13 @@ above it each member's pair circulates the ring with O(k) peak wire state per ra
 Bytes per member: 8k vs 4n dense — a win for k << n (the typical top-k regime is
 k/n ~ 1%). Exactness contract: the result equals the sum of every member's
 top-k-sparsified contribution, identical across both formats.
+
+Registry note (mlsl_tpu.codecs): ``TopKCodec`` exposes this wire behind the
+codec-lab contract — a calibrated cell or ``MLSL_CODEC=topk`` routes a
+QUANTIZATION-compressed request here with the ratio from the cell, and the
+generalized ``PruneCodec`` (bit-packed mask + kept values, EF residual
+carry) is this module's importance-weighted successor on the registry's
+compressed-ring transport.
 """
 
 from __future__ import annotations

@@ -33,6 +33,11 @@ def _env_bool(name: str, default: bool) -> bool:
     return v.strip().lower() not in ("0", "false", "no", "off")
 
 
+# Registry codec names (mlsl_tpu.codecs), mirrored statically: validate()
+# must stay importable without jax, and the registry re-asserts membership
+# at every get() so the mirror cannot drift silently past dispatch.
+_CODEC_NAMES = ("f32", "int8", "prune", "topk", "vq")
+
 # env var -> Config field, for the explicit-override bookkeeping in from_env
 # (auto_config must never clobber a knob the user exported)
 _ENV_FIELDS = {
@@ -45,6 +50,12 @@ _ENV_FIELDS = {
     "MLSL_NUM_SERVERS": "num_servers",
     "MLSL_QUANT_BLOCK_ELEMS": "quant_block_elems",
     "MLSL_HIER_DCN_CODEC": "hier_dcn_codec",
+    "MLSL_CODEC": "codec",
+    "MLSL_CODEC_NSR_BUDGET": "codec_nsr_budget",
+    "MLSL_CODEC_GUARD_BREACHES": "codec_guard_breaches",
+    "MLSL_VQ_DIM": "vq_dim",
+    "MLSL_VQ_CODEBOOK": "vq_codebook",
+    "MLSL_PRUNE_RATIO": "prune_ratio",
     "MLSL_PALLAS_RING_SLOTS": "pallas_ring_slots",
     "MLSL_PALLAS_RHD_MAX_BYTES": "pallas_rhd_max_bytes",
     "MLSL_OVERLAP_STAGES": "overlap_stages",
@@ -233,6 +244,39 @@ class Config:
     # user-pluggable codec (comm/codec.py CustomCodec), registered through
     # Environment.set_quantization_params; None = built-in Pallas int8 kernels
     custom_codec: object = None
+
+    # --- codec lab (mlsl_tpu.codecs; docs/TUNING.md §22) ---
+    # Registry codec for every QUANTIZATION-compressed gradient wire:
+    # '' = the seed int8 path; any mlsl_tpu.codecs name ('vq', 'prune',
+    # 'topk', 'f32') routes through the registry transport. An EXPORTED
+    # MLSL_CODEC beats a calibrated per-set assignment (the _explicit
+    # contract); a programmatic value is the default the calibration
+    # overrides per set.
+    codec: str = ""                 # MLSL_CODEC
+    # Run the codec calibration pass at Session.commit (tuner/calibrate.py):
+    # measure per-set norm spectra + quantization noise-to-signal, solve
+    # codec x block per ParameterSet against codec_nsr_budget, persist the
+    # assignment into the topology-keyed tuned profile, and re-route the
+    # live gradient requests to the solved codecs.
+    tune_codec: bool = False        # MLSL_TUNE_CODEC
+    # Per-set codec assignment (request name -> calibration cell dict):
+    # written by the calibration pass or loaded from a tuned profile at
+    # init. Never set from env.
+    codec_assignment: dict = dataclasses.field(default_factory=dict)
+    # Calibration convergence budget: max per-set quantization-noise-to-
+    # signal power ratio a solved codec may incur; sets where no cheaper
+    # codec fits the budget stay int8.
+    codec_nsr_budget: float = 0.02  # MLSL_CODEC_NSR_BUDGET
+    # Consecutive sentinel loss z-score breaches (while a calibrated codec
+    # is live) before the guardrail demotes every calibrated set to int8.
+    codec_guard_breaches: int = 3   # MLSL_CODEC_GUARD_BREACHES
+    # VQ codec shape: elements per vector and codebook rows (<= 256: one
+    # index byte per vector on the wire). Tunable via a tuner profile.
+    vq_dim: int = 4                 # MLSL_VQ_DIM
+    vq_codebook: int = 16           # MLSL_VQ_CODEBOOK
+    # Pruning codec keep ratio (importance-weighted masks); the calibrated
+    # per-set ratio overrides this uniform default.
+    prune_ratio: float = 0.05       # MLSL_PRUNE_RATIO
 
     # --- robustness tier (chaos layer + watchdog + checkpoint retry) ---
     # Request watchdog: wait() on an async request raises MLSLTimeoutError
@@ -501,9 +545,41 @@ class Config:
                 self.mesh_tiers,
             )
         mlsl_assert(
-            self.hier_dcn_codec in ("int8", "f32", "topk"),
-            "MLSL_HIER_DCN_CODEC must be 'int8', 'f32' or 'topk' (got %r)",
-            self.hier_dcn_codec,
+            self.hier_dcn_codec in _CODEC_NAMES,
+            "MLSL_HIER_DCN_CODEC must be one of %s (got %r)",
+            "/".join(_CODEC_NAMES), self.hier_dcn_codec,
+        )
+        mlsl_assert(
+            self.codec in ("",) + _CODEC_NAMES,
+            "MLSL_CODEC must be '' or one of %s (got %r)",
+            "/".join(_CODEC_NAMES), self.codec,
+        )
+        mlsl_assert(
+            isinstance(self.codec_assignment, dict),
+            "codec_assignment must be a dict of request name -> calibration "
+            "cell (got %r)", type(self.codec_assignment).__name__,
+        )
+        mlsl_assert(
+            self.codec_nsr_budget > 0.0,
+            "MLSL_CODEC_NSR_BUDGET must be > 0 (got %r)", self.codec_nsr_budget,
+        )
+        mlsl_assert(
+            self.codec_guard_breaches >= 1,
+            "MLSL_CODEC_GUARD_BREACHES must be >= 1 (got %d)",
+            self.codec_guard_breaches,
+        )
+        mlsl_assert(
+            1 <= self.vq_dim <= 64,
+            "MLSL_VQ_DIM must be in [1, 64] (got %d)", self.vq_dim,
+        )
+        mlsl_assert(
+            2 <= self.vq_codebook <= 256,
+            "MLSL_VQ_CODEBOOK must be in [2, 256] (one index byte per "
+            "vector; got %d)", self.vq_codebook,
+        )
+        mlsl_assert(
+            0.0 < self.prune_ratio <= 1.0,
+            "MLSL_PRUNE_RATIO must be in (0, 1] (got %r)", self.prune_ratio,
         )
         mlsl_assert(
             self.pallas_interpret in ("", "0", "1"),
@@ -776,6 +852,17 @@ class Config:
         c.pallas_interpret = os.environ.get("MLSL_PALLAS_INTERPRET",
                                             c.pallas_interpret).strip()
         c.topk_ratio = _env_float("MLSL_TOPK_RATIO", c.topk_ratio)
+        c.codec = os.environ.get("MLSL_CODEC", c.codec).strip().lower()
+        c.tune_codec = _env_bool("MLSL_TUNE_CODEC", c.tune_codec)
+        c.codec_nsr_budget = _env_float(
+            "MLSL_CODEC_NSR_BUDGET", c.codec_nsr_budget
+        )
+        c.codec_guard_breaches = _env_int(
+            "MLSL_CODEC_GUARD_BREACHES", c.codec_guard_breaches
+        )
+        c.vq_dim = _env_int("MLSL_VQ_DIM", c.vq_dim)
+        c.vq_codebook = _env_int("MLSL_VQ_CODEBOOK", c.vq_codebook)
+        c.prune_ratio = _env_float("MLSL_PRUNE_RATIO", c.prune_ratio)
         c.watchdog_timeout_s = _env_float("MLSL_WATCHDOG_TIMEOUT", c.watchdog_timeout_s)
         c.comm_retries = _env_int("MLSL_COMM_RETRIES", c.comm_retries)
         c.comm_retry_backoff_s = _env_float(

@@ -74,13 +74,18 @@ class GradBucket:
     """
 
     def __init__(self, members: List, env, kind: str = "allreduce",
-                 compression: CompressionType = CompressionType.NONE):
+                 compression: CompressionType = CompressionType.NONE,
+                 codec: str = ""):
         from mlsl_tpu.types import dtype_size
 
         # members in START order (reverse creation = backward pass order)
         self.members = members
         self.kind = kind
         self.compression = CompressionType(compression)
+        # registry codec the members resolved to (mlsl_tpu.codecs) — pinned
+        # into the coalesced desc so the bucket rides the members' wire; a
+        # user custom codec routes via config, not the desc pin
+        self.codec = codec if codec not in ("", "custom") else ""
         quant = self.compression == CompressionType.QUANTIZATION
         # which ParameterSet round flag / fallback request this bucket drives
         self.round_attr = (
@@ -166,7 +171,7 @@ class GradBucket:
             desc = CommDesc(
                 "allreduce", group, total, ps0.data_type,
                 compute_type=ComputeType.PARAM_GRAD, op=ReductionType.SUM,
-                compression=self.compression,
+                compression=self.compression, codec=self.codec,
             )
         elif kind == "reduce_scatter":
             # member m's buffer is G chunks of counts[m]; chunk r of the
@@ -176,7 +181,7 @@ class GradBucket:
                 "reduce_scatter", group, total * g, ps0.data_type,
                 compute_type=ComputeType.PARAM_GRAD, op=ReductionType.SUM,
                 recv_count=total,
-                compression=self.compression,
+                compression=self.compression, codec=self.codec,
             )
 
             def rs_pack(*xs):
@@ -576,16 +581,21 @@ def build_buckets(session, bucket_mb: int) -> int:
     from mlsl_tpu.comm.collectives import _group_key
     from mlsl_tpu.types import dtype_size
 
-    plain: dict = {}   # (group key, dtype, compression) -> [ps] creation order
+    # (group key, dtype, compression, codec) -> [ps] creation order: the
+    # codec component keeps mixed-codec buckets split — each registry codec
+    # owns its residual layout and wire geometry, so a vq set never shares a
+    # coalesced ring with an int8 neighbor
+    plain: dict = {}
     du: dict = {}
     du_inc: dict = {}  # (group key, dtype) -> [ps]: the increment all_gather
-    # is ALWAYS uncompressed, so it coalesces across compression types — only
-    # the gradient phase partitions by compression
+    # is ALWAYS uncompressed, so it coalesces across compression types AND
+    # codecs — only the gradient phase partitions by them
     for op in session.operations:
         for ps in op.parameter_sets:
             if not ps.need_comm:
                 continue
-            key = (_group_key(ps.dist.grad_group), ps.data_type, ps.compression)
+            key = (_group_key(ps.dist.grad_group), ps.data_type,
+                   ps.compression, ps.codec_name)
             if (
                 not ps.distributed_update
                 and ps.compression in _BUCKETABLE
@@ -601,7 +611,7 @@ def build_buckets(session, bucket_mb: int) -> int:
 
     cfg = session.env.config
 
-    def form(pss, kind, attr, compression=CompressionType.NONE):
+    def form(pss, kind, attr, compression=CompressionType.NONE, codec=""):
         nonlocal n_buckets
         if not pss:
             return
@@ -631,18 +641,19 @@ def build_buckets(session, bucket_mb: int) -> int:
         size_of = lambda ps: ps.owned_kernel_count * ps.kernel_size * esize * mult
         for members in pack_by_size(pss, limit_eff, size_of):
             bucket = GradBucket(
-                members, session.env, kind=kind, compression=compression
+                members, session.env, kind=kind, compression=compression,
+                codec=codec,
             )
             for ps in members:
                 setattr(ps, attr, bucket)
             n_buckets += 1
 
-    for (_, _, comp), pss in plain.items():
-        form(pss, "allreduce", "bucket", compression=comp)
-    for (_, _, comp), pss in du.items():
+    for (_, _, comp, cname), pss in plain.items():
+        form(pss, "allreduce", "bucket", compression=comp, codec=cname)
+    for (_, _, comp, cname), pss in du.items():
         if comp in _BUCKETABLE:
             form([ps for ps in pss if ps.bucket is None],
-                 "reduce_scatter", "bucket", compression=comp)
+                 "reduce_scatter", "bucket", compression=comp, codec=cname)
     for pss in du_inc.values():
         form([ps for ps in pss if ps.inc_bucket is None],
              "allgather", "inc_bucket")

@@ -153,6 +153,15 @@ class ParameterSet:
     def is_distributed_update(self) -> bool:
         return self.distributed_update
 
+    @property
+    def codec_name(self) -> str:
+        """The grad collective's resolved registry codec (mlsl_tpu.codecs):
+        'int8' for the seed wire, 'vq'/'prune'/... when calibration or
+        MLSL_CODEC assigned one, '' when this set needs no comm. Bucketing
+        partitions on it — mixed-codec buckets stay split (each codec owns
+        its residual layout and wire geometry)."""
+        return self.grad_req.codec_name if self.grad_req is not None else ""
+
     # -- gradient sync (reference src/mlsl_impl.cpp:446-539) ---------------
 
     def start_gradient_comm(self, grad_buf) -> None:

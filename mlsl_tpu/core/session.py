@@ -299,6 +299,14 @@ class Session:
                 act.init_peer_connection()
         self._committed = True
         cfg = self.env.config
+        if cfg is not None and getattr(cfg, "tune_codec", False):
+            # MLSL_TUNE_CODEC=1: measure per-set gradient sensitivity and
+            # assign codec x block against the convergence (NSR) budget —
+            # BEFORE buckets form, so they partition on the calibrated
+            # codecs (tuner/calibrate.py; docs/TUNING.md §22)
+            from mlsl_tpu.tuner.calibrate import calibrate_session
+
+            calibrate_session(self)
         if cfg is not None and cfg.grad_bucket_mb > 0:
             from mlsl_tpu.core.bucketing import build_buckets
 

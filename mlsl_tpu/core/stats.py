@@ -208,6 +208,11 @@ def record_degrade(subsystem: str, event: str, detail: str = "") -> None:
         DEGRADE_COUNTERS["breaker_resets"] += 1
     elif event == "recover":
         DEGRADE_COUNTERS["recoveries"] += 1
+    elif event == "codec_demote":
+        # guardrail demotion (mlsl_tpu.codecs): counted in its own family
+        # (CODEC_COUNTERS, via record_codec_demotion) — here it only joins
+        # the event deque + DEGRADE file line, not the fallback counter
+        pass
     else:  # fallback: one dispatch served by the degraded path
         DEGRADE_FALLBACKS[subsystem] = DEGRADE_FALLBACKS.get(subsystem, 0) + 1
     DEGRADE_EVENTS.append(
@@ -220,7 +225,7 @@ def record_degrade(subsystem: str, event: str, detail: str = "") -> None:
         name = f"breaker.{event}" if event != "fallback" else "degrade.fallback"
         obs._tracer.instant(name, "degrade", subsystem=subsystem,
                             detail=detail or None)
-    if event in ("trip", "probe", "reset", "recover"):
+    if event in ("trip", "probe", "reset", "recover", "codec_demote"):
         try:
             with open(stats_path(), "a") as f:
                 f.write(
@@ -258,6 +263,51 @@ def record_sentinel(event: str) -> None:
 def reset_sentinel_counters() -> None:
     for k in SENTINEL_COUNTERS:
         SENTINEL_COUNTERS[k] = 0
+
+
+# Codec-lab accounting (mlsl_tpu.codecs): per-codec wire bytes (compressed
+# image of each started round's payload — the codec-comparable bandwidth
+# signal) and the calibration/guardrail event counters. Process-wide like the
+# degrade counters: the guardrail fires from the sentinel with no Session
+# handle. Demotions additionally keep a bounded attribution list (which
+# request, which codec, why) — the post-mortem answer to "who turned my VQ
+# off", mirrored into supervisor.status()["codecs"].
+CODEC_WIRE_BYTES: Dict[str, int] = {}
+CODEC_COUNTERS: Dict[str, int] = {
+    "calibrations": 0,     # calibration passes run (Session.commit)
+    "assignments": 0,      # ParameterSets routed to a calibrated codec
+    "guard_breaches": 0,   # sentinel loss z-score breaches while guarded
+    "demotions": 0,        # guardrail demotions to int8
+}
+CODEC_DEMOTIONS: List[str] = []
+_CODEC_DEMOTIONS_MAX = 64
+
+
+def record_codec(event: str) -> None:
+    """One codec-lab event: a key of CODEC_COUNTERS."""
+    CODEC_COUNTERS[event] += 1
+
+
+def record_codec_wire(codec: str, nbytes: int) -> None:
+    """One started compressed round: ``nbytes`` of wire image under
+    ``codec`` (called from CommRequest.start — one dict upsert)."""
+    CODEC_WIRE_BYTES[codec] = CODEC_WIRE_BYTES.get(codec, 0) + int(nbytes)
+
+
+def record_codec_demotion(request: str, codec: str, reason: str) -> None:
+    """Guardrail demotion attribution: bump the counter, keep the bounded
+    attribution row, and cut the DEGRADE ladder line (codec_demote)."""
+    CODEC_COUNTERS["demotions"] += 1
+    if len(CODEC_DEMOTIONS) < _CODEC_DEMOTIONS_MAX:
+        CODEC_DEMOTIONS.append(f"{request}: {codec} -> int8 ({reason})")
+    record_degrade("quant", "codec_demote", f"{request} {codec}->int8 {reason}")
+
+
+def reset_codec_counters() -> None:
+    for k in CODEC_COUNTERS:
+        CODEC_COUNTERS[k] = 0
+    CODEC_WIRE_BYTES.clear()
+    CODEC_DEMOTIONS.clear()
 
 
 # Elastic-mesh accounting (mlsl_tpu.elastic): device losses routed to the
@@ -1097,6 +1147,25 @@ class Statistics:
                 f"value_checks {kc['value_checks']} "
                 f"value_syncs {kc['value_syncs']}"
             )
+        xc = CODEC_COUNTERS
+        if any(xc.values()) or CODEC_WIRE_BYTES:
+            # the codec-lab story: which codecs carried how many compressed
+            # bytes, whether a calibration ran, and every guardrail demotion
+            # — one grep ('CODEC') answers "what was on the wire, and did
+            # the autotuner's choice survive the sentinel"
+            wire = " ".join(
+                f"{name}={n}" for name, n in sorted(CODEC_WIRE_BYTES.items())
+            )
+            lines.append(
+                f"{'CODEC':<16} {'LAB':<8} "
+                f"calibrations {xc['calibrations']} "
+                f"assignments {xc['assignments']} "
+                f"breaches {xc['guard_breaches']} "
+                f"demotions {xc['demotions']}"
+                + (f" wire_bytes {wire}" if wire else "")
+            )
+            for row in CODEC_DEMOTIONS:
+                lines.append(f"{'CODEC':<16} {'DEMOTE':<8} {row}")
         dc = DEGRADE_COUNTERS
         if any(dc.values()) or DEGRADE_FALLBACKS:
             # the ladder summary: every trip/probe/reset, retry, degraded

@@ -17,7 +17,7 @@ samples per series, the tracer's deque(maxlen) discipline: a week-long run
 keeps the trailing window, not an unbounded log). The sampler
 (:func:`sample_families`) snapshots every existing ``core/stats`` counter
 family (BUCKET/ALGO/FEED/SENTINEL/DEGRADE/OVERLAP/ELASTIC/ANALYSIS/CHKP/
-STRAGGLER) into gauges, so one registry covers the whole stack; the trainer
+STRAGGLER/CODEC) into gauges, so one registry covers the whole stack; the trainer
 feeds per-step scalars on the ``MLSL_METRICS_EVERY`` cadence
 (models/train.py) and the request layer feeds per-request latency on every
 completed wait (comm/request.py).
@@ -313,6 +313,7 @@ class MetricsRegistry:
             ("chkp", st.CHKP_COUNTERS),
             ("straggler", st.STRAGGLER_COUNTERS),
             ("serve", st.SERVE_COUNTERS),
+            ("codec", st.CODEC_COUNTERS),
         ):
             for k, v in d.items():
                 self.set(f"mlsl_{fam}_{k}", float(v))
@@ -320,6 +321,8 @@ class MetricsRegistry:
             self.set("mlsl_algo_dispatches", float(n), kind=kind, algo=algo)
         for subsystem, n in list(st.DEGRADE_FALLBACKS.items()):
             self.set("mlsl_degrade_fallback", float(n), subsystem=subsystem)
+        for codec, n in list(st.CODEC_WIRE_BYTES.items()):
+            self.set("mlsl_codec_wire_bytes", float(n), codec=codec)
 
     def sample(self, ts: Optional[float] = None) -> List[dict]:
         """One sampler tick: append a timestamped sample to every live

@@ -133,7 +133,7 @@ class Sentinel:
 
     def __init__(self, mesh, gate: str = "", every: int = 0,
                  spike: float = 10.0, zmax: float = 8.0, warmup: int = 5,
-                 block: int = 4096):
+                 block: int = 4096, codec_guard_window: int = 3):
         self.mesh = mesh
         self.gate_response = gate
         self.every = int(every)
@@ -141,6 +141,9 @@ class Sentinel:
         self.zmax = float(zmax)
         self.warmup = int(warmup)
         self.block = int(block)
+        # consecutive loss-outlier screens before a calibrated codec demotes
+        # to int8 (MLSL_CODEC_GUARD_BREACHES; mlsl_tpu.codecs.guard_note)
+        self.codec_guard_window = int(codec_guard_window)
         # EMA state for the history-armed screens (healthy steps only)
         self._n = 0
         self._ema_norm: Optional[float] = None
@@ -164,6 +167,7 @@ class Sentinel:
             zmax=config.sentinel_zmax,
             warmup=config.sentinel_warmup,
             block=config.sentinel_block,
+            codec_guard_window=getattr(config, "codec_guard_breaches", 3),
         )
 
     @property
@@ -287,6 +291,19 @@ class Sentinel:
                         grad_norm=round(norm, 6) if math.isfinite(norm)
                         else None,
                         fired=reason)
+        # codec-lab online guardrail (mlsl_tpu.codecs): the loss z-score
+        # screen doubles as the convergence monitor for calibrated codecs —
+        # sustained outliers demote the guarded sets to int8. Healthy
+        # screens reset the streak; spike/nonfinite firings are hardware-
+        # attributable and neither advance nor reset it.
+        loss_outlier = reason is not None and reason.startswith("loss outlier")
+        if reason is None or loss_outlier:
+            from mlsl_tpu import codecs as codecs_mod
+
+            if codecs_mod.guard_active():
+                codecs_mod.guard_note(loss_outlier,
+                                      window=self.codec_guard_window,
+                                      step=step)
         if reason is None:
             self._observe(norm, lv)
             return True

@@ -391,6 +391,12 @@ def status() -> Dict[str, dict]:
     from mlsl_tpu import serve as _serve
 
     out["serve"] = _serve.status()
+    # codec lab (mlsl_tpu.codecs): registered codecs, the guardrail's
+    # breach streak and guarded sets, per-codec wire bytes, and the
+    # demotion attribution trail — same JSON-serializability contract.
+    from mlsl_tpu import codecs as _codecs
+
+    out["codecs"] = _codecs.status()
     return out
 
 

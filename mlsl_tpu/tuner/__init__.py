@@ -50,6 +50,11 @@ def apply_knobs(config, profile: TunedProfile) -> None:
     for name in TUNABLE_KNOBS:
         if name in profile.knobs and name not in explicit:
             setattr(config, name, profile.knobs[name])
+    # codec-lab calibration table (tuner/calibrate.py): per-request codec
+    # assignment rides the same precedence — an exported MLSL_CODEC pins
+    # every set to one codec and the calibrated table stays unapplied
+    if profile.codecs and "codec" not in explicit:
+        config.codec_assignment = dict(profile.codecs)
 
 
 def init_profile(config, devices=None) -> None:
@@ -83,6 +88,20 @@ def init_profile(config, devices=None) -> None:
                  len(profile.cells))
         config.tuned_profile = profile
     elif config.tune_profile:
+        import os
+
+        if not os.path.exists(config.tune_profile) and getattr(
+            config, "tune_codec", False
+        ):
+            # MLSL_TUNE_CODEC=1 pointed at a not-yet-written profile: codec
+            # calibration CREATES it at Session.commit (tuner/calibrate.py),
+            # so a missing file is the expected first-run state, not the
+            # fail-at-init operator error the plain load path reports
+            log_info(
+                "tuner: profile %s absent; codec calibration will write it "
+                "at commit", config.tune_profile,
+            )
+            return
         profile = load_profile(config.tune_profile)  # MLSLError on bad file
         # fingerprint the ACTIVE world, not the physical machine: every
         # re-init re-checks here — including FaultTolerantLoop recovery

@@ -1,0 +1,232 @@
+"""Convergence-aware codec calibration (MLSL_TUNE_CODEC=1).
+
+The codec lab's measurement half (docs/TUNING.md §22): at Session.commit,
+BEFORE gradient buckets form, replay a short deterministic gradient sample
+through every registry codec's encode/decode round-trip per ParameterSet and
+measure its quantization-noise-to-signal ratio (NSR — noise power over
+signal power) plus the layer's norm spectrum. The solver then picks, per
+set, the cheapest (fewest wire bytes) codec x block cell whose NSR stays
+under the convergence budget ``MLSL_CODEC_NSR_BUDGET`` — int8 at the
+session block is always a candidate, so a set never calibrates WORSE than
+the seed wire. The assignment persists into the topology-keyed tuned
+profile (tuner/profile.py ``codecs`` section) and applies to the live
+session by re-running each affected request's setup().
+
+Precedence stays the codec-lab contract (codecs.assigned): an exported
+MLSL_CODEC pins every set and calibration writes the profile WITHOUT
+touching the live assignment; the sentinel's loss z-score screen guards the
+calibrated sets online and demotes a mis-calibrated one back to int8
+(CommRequest.demote_codec — one DEGRADE-ladder rung, exactly-once EF
+flush).
+
+The gradient sample is synthetic but layer-shaped: per-set deterministic
+(seeded by the request name, stable across processes so every rank solves
+the same table), scaled by 1/sqrt(kernel_size) with a heavy sparse tail —
+the magnitude mixture pruning-style codecs are sensitive to. A calibration
+run measures sensitivity, not loss: the online guardrail owns convergence.
+"""
+
+from __future__ import annotations
+
+import zlib
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from mlsl_tpu.log import log_info, log_warning
+from mlsl_tpu.tuner.profile import TunedProfile, default_profile_path, load_profile
+
+#: cap on the per-set sample length: NSR converges well before this, and
+#: calibrating a billion-element set must not dominate commit time
+SAMPLE_CAP = 65536
+
+#: int8 block palette the solver searches (the session block is always
+#: included on top of these)
+INT8_BLOCKS = (128, 256, 512)
+
+#: prune keep-ratio palette
+PRUNE_RATIOS = (0.01, 0.05, 0.1, 0.25)
+
+#: VQ vector-dimension palette (codebook size rides MLSL_VQ_CODEBOOK)
+VQ_DIMS = (4, 8)
+
+
+#: element count above which the surrogate models a wide conv/embedding
+#: layer: mostly-dead ReLU backprop -> 90% exact zeros (the regime where
+#: importance-weighted pruning beats the dense int8 wire)
+WIDE_LAYER_ELEMS = 16384
+
+
+def gradient_sample(name: str, n: int, kernel_size: int = 1) -> np.ndarray:
+    """Deterministic layer-shaped gradient surrogate: dense Gaussian body at
+    the 1/sqrt(fan) scale + a sparse heavy tail (1% of entries, 8x scale) +
+    ReLU-style exact zeros (half the entries; 90% for wide layers — dead
+    units backprop nothing). Seeded by the request name via crc32 —
+    identical on every process, so distributed ranks derive identical
+    assignments without a collective."""
+    m = min(int(n), SAMPLE_CAP)
+    rng = np.random.default_rng(zlib.crc32(name.encode("utf-8")) & 0xFFFFFFFF)
+    scale = 1.0 / float(np.sqrt(max(1, kernel_size)))
+    x = rng.normal(0.0, scale, size=m).astype(np.float32)
+    spikes = rng.random(m) < 0.01
+    x[spikes] *= 8.0
+    sparsity = 0.9 if n >= WIDE_LAYER_ELEMS else 0.5
+    x[rng.random(m) < sparsity] = 0.0
+    return x
+
+
+def norm_spectrum(x: np.ndarray) -> dict:
+    """The per-layer norm statistics the profile records next to the NSR:
+    enough for an operator to audit WHY a cell picked its codec."""
+    ax = np.abs(x)
+    return {
+        "l2": float(np.linalg.norm(x)),
+        "linf": float(ax.max(initial=0.0)),
+        "mean_abs": float(ax.mean()) if x.size else 0.0,
+        # tail mass: fraction of the l1 norm carried by the top 1% — the
+        # signal pruning-class codecs feed on
+        "top1pct_mass": float(
+            np.sort(ax)[::-1][: max(1, x.size // 100)].sum() / max(ax.sum(), 1e-30)
+        ),
+    }
+
+
+def measure_nsr(codec, x: np.ndarray) -> float:
+    """Noise-to-signal power of one encode/decode round trip on the sample."""
+    import jax.numpy as jnp
+
+    n = int(x.shape[0])
+    xhat = np.asarray(codec.decode(codec.encode(jnp.asarray(x)), n))
+    sig = float(np.sum(np.square(x, dtype=np.float64)))
+    if sig == 0.0:
+        return 0.0
+    noise = float(np.sum(np.square((xhat - x).astype(np.float64))))
+    return noise / sig
+
+
+def candidate_cells(config, name: str, n: int, x: np.ndarray) -> List[dict]:
+    """The per-set search space: every cell carries the measured NSR and the
+    full-payload wire bytes the solver ranks on."""
+    from mlsl_tpu import codecs as codecs_mod
+    from mlsl_tpu.codecs import vq as vq_mod
+
+    cells: List[dict] = []
+
+    def add(codec_name: str, codec, block: int = 0, params: Optional[dict] = None):
+        cells.append({
+            "codec": codec_name,
+            "block": int(block),
+            "params": params or {},
+            "nsr": measure_nsr(codec, x),
+            "wire_bytes": int(codec.wire_len(n)),
+        })
+
+    session_block = int(getattr(config, "quant_block_elems", 256) or 256)
+    for block in sorted({*INT8_BLOCKS, session_block}):
+        add("int8", codecs_mod.get("int8", block=block), block=block)
+    for ratio in PRUNE_RATIOS:
+        add("prune", codecs_mod.get("prune", ratio=ratio),
+            params={"ratio": float(ratio)})
+    k = int(getattr(config, "vq_codebook", 16) or 16)
+    for dim in VQ_DIMS:
+        cb = vq_mod.learn_codebook(x, k=k, dim=dim)
+        add("vq", codecs_mod.get("vq", dim=dim, k=k, codebook=cb),
+            params={"vq_dim": int(dim), "vq_codebook": k,
+                    "codebook": cb.tolist()})
+    return cells
+
+
+def solve(cells: List[dict], budget: float) -> Optional[dict]:
+    """Cheapest cell whose NSR meets the budget; int8 breaks wire-byte ties
+    (the seed wire is the proven rung). None when nothing fits — the caller
+    keeps the uncalibrated default rather than assigning a breach."""
+    fits = [c for c in cells if c["nsr"] <= budget]
+    if not fits:
+        return None
+    return min(fits, key=lambda c: (c["wire_bytes"], c["codec"] != "int8"))
+
+
+def calibrate_session(session) -> Dict[str, dict]:
+    """Session.commit hook (MLSL_TUNE_CODEC=1): measure -> solve -> persist
+    -> apply. Returns the assignment table (request name -> cell)."""
+    from mlsl_tpu.core import stats as stats_mod
+    from mlsl_tpu.types import CompressionType
+
+    cfg = session.env.config
+    budget = float(getattr(cfg, "codec_nsr_budget", 0.02))
+    table: Dict[str, dict] = {}
+    targets: List[Tuple[str, object]] = []
+    for op in session.operations:
+        for ps in op.parameter_sets:
+            req = ps.grad_req
+            if (
+                req is None
+                or req.desc.compression != CompressionType.QUANTIZATION
+            ):
+                continue
+            n = int(req.desc.count)
+            x = gradient_sample(req.name, n, ps.kernel_size)
+            cell = solve(candidate_cells(cfg, req.name, n, x), budget)
+            if cell is None:
+                log_warning(
+                    "codec calibration: no codec meets NSR budget %.4g for "
+                    "%s; keeping the uncalibrated default", budget, req.name,
+                )
+                continue
+            table[req.name] = dict(cell, spectrum=norm_spectrum(x))
+            targets.append((req.name, req))
+    stats_mod.record_codec("calibrations")
+    if not table:
+        return table
+
+    _persist(cfg, table)
+
+    explicit = getattr(cfg, "_explicit", ()) or ()
+    if "codec" in explicit:
+        # an exported MLSL_CODEC wins over calibration (docs/TUNING.md §22):
+        # the profile above still records the measurement for later runs
+        log_info(
+            "codec calibration: %d cell(s) measured but MLSL_CODEC=%s is "
+            "exported — live assignment unchanged", len(table), cfg.codec,
+        )
+        return table
+    cfg.codec_assignment = dict(table)
+    for name, req in targets:
+        if name in table:
+            req.setup()  # re-route onto the calibrated codec
+            stats_mod.record_codec("assignments")
+    log_info(
+        "codec calibration: %d set(s) assigned under NSR budget %.4g (%s)",
+        len(table), budget,
+        ", ".join(f"{k}->{v['codec']}" for k, v in sorted(table.items())),
+    )
+    return table
+
+
+def _persist(cfg, table: Dict[str, dict]) -> None:
+    """Merge the assignment into the topology-keyed tuned profile (create it
+    when absent, reject-and-rewrite when stale) — atomic save, same file the
+    algorithm sweep owns. Cells keep their measurements (NSR, spectrum,
+    codebook): profiles are audit documents (docs/TUNING.md §10)."""
+    from mlsl_tpu import sysinfo
+    from mlsl_tpu.log import MLSLError
+
+    path = getattr(cfg, "tune_profile", "") or default_profile_path()
+    fp = sysinfo.topology_fingerprint()
+    profile = None
+    try:
+        profile = load_profile(path)
+    except MLSLError:
+        profile = None  # absent or unreadable: start a fresh document
+    if profile is not None and not profile.matches(fp):
+        log_warning(
+            "codec calibration: existing profile %s was measured on a "
+            "different topology; rewriting its codec table for this one",
+            path,
+        )
+        profile = None
+    if profile is None:
+        profile = TunedProfile(fingerprint=fp)
+    profile.codecs = dict(profile.codecs or {}, **table)
+    profile.save(path)
+    log_info("codec calibration: %d cell(s) -> %s", len(table), path)

@@ -80,6 +80,12 @@ KNOB_RANGES = {
     # real-failure detection by one MLSL_HEARTBEAT_INTERVAL_S); an exported
     # MLSL_HEARTBEAT_MISSES always wins
     "heartbeat_misses": 1,
+    # codec-lab knobs (mlsl_tpu.codecs; docs/TUNING.md §22): calibration
+    # may carry whole-run codec parameters alongside the per-set assignment
+    # table; exported MLSL_VQ_* / MLSL_PRUNE_RATIO always win
+    "vq_dim": 1,
+    "vq_codebook": 2,
+    "prune_ratio": 1e-4,
     # serving decode-slot ceiling (serve/engine.py): profiles may carry the
     # batch benchmarks/serving_bench.py measured to maximize tokens/s while
     # holding p99 TPOT on this chip; an exported MLSL_SERVE_MAX_BATCH
@@ -104,8 +110,9 @@ KNOB_RANGES = {
 KNOB_CHOICES = {
     # DCN-tier codec for the 'hier' lowering (comm/algos/hier.py): profiles
     # tuned on a two-tier mesh may carry the codec that measured best on
-    # its DCN; an exported MLSL_HIER_DCN_CODEC always wins
-    "hier_dcn_codec": ("int8", "f32", "topk"),
+    # its DCN; an exported MLSL_HIER_DCN_CODEC always wins. Registry codecs
+    # (mlsl_tpu.codecs) are legal DCN members since the codec-lab PR.
+    "hier_dcn_codec": ("int8", "f32", "topk", "vq", "prune"),
 }
 
 
@@ -124,6 +131,12 @@ class TunedProfile:
     cells: List[dict] = dataclasses.field(default_factory=list)
     knobs: dict = dataclasses.field(default_factory=dict)
     created: str = ""
+    # codec-lab calibration table (tuner/calibrate.py; docs/TUNING.md §22):
+    # request name -> {"codec": registry name, "block": int8 block or 0,
+    # "params": codec knobs, "nsr": measured noise-to-signal, "wire_bytes":
+    # per-round compressed image}. Absent in pre-codec-lab profiles — the
+    # loader tolerates a missing section (older files keep loading).
+    codecs: dict = dataclasses.field(default_factory=dict)
 
     # -- selection ---------------------------------------------------------
 
@@ -161,13 +174,16 @@ class TunedProfile:
     # -- persistence -------------------------------------------------------
 
     def to_doc(self) -> dict:
-        return {
+        doc = {
             "version": PROFILE_VERSION,
             "fingerprint": self.fingerprint,
             "created": self.created,
             "cells": self.cells,
             "knobs": self.knobs,
         }
+        if self.codecs:
+            doc["codecs"] = self.codecs
+        return doc
 
     def save(self, path: str) -> str:
         tmp = f"{path}.tmp.{os.getpid()}"
@@ -242,9 +258,28 @@ def load_profile(path: str) -> TunedProfile:
                 f"MLSL_TUNE_PROFILE file {path} has invalid knob "
                 f"{name}={v!r} (expected one of {', '.join(allowed)})"
             )
+    codec_cells = doc.get("codecs", {}) or {}
+    if not isinstance(codec_cells, dict) or not all(
+        isinstance(k, str) and isinstance(v, dict) and isinstance(v.get("codec"), str)
+        for k, v in codec_cells.items()
+    ):
+        raise MLSLError(
+            f"MLSL_TUNE_PROFILE file {path} has a malformed codecs table "
+            f"(expected request name -> {{'codec': name, ...}})"
+        )
+    from mlsl_tpu import codecs as codecs_mod
+
+    for rname, cell in codec_cells.items():
+        if cell["codec"] not in codecs_mod.names():
+            raise MLSLError(
+                f"MLSL_TUNE_PROFILE file {path} assigns unknown codec "
+                f"{cell['codec']!r} to {rname!r} "
+                f"(registry: {', '.join(codecs_mod.names())})"
+            )
     return TunedProfile(
         fingerprint=doc["fingerprint"],
         cells=cells,
         knobs=knobs,
         created=str(doc.get("created", "")),
+        codecs=codec_cells,
     )

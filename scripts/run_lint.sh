@@ -27,4 +27,20 @@ else
     echo "run_lint: ruff not installed; skipping (custom AST rules still run)" >&2
 fi
 
-python -m mlsl_tpu.analysis --lint "$@"
+# the analysis CLI records its ANALYSIS stats line via core/stats, which
+# defaults to CWD — route the gate's own telemetry to scratch so the
+# droppings check below never trips on the linter itself
+MLSL_STATS_DIR="${MLSL_STATS_DIR:-$(mktemp -d)}" \
+    python -m mlsl_tpu.analysis --lint "$@"
+
+# warn on gitignored droppings at the repo root (stats logs, tuned profiles,
+# trace dumps): ignored files never fail CI, so a tool writing to CWD
+# instead of MLSL_STATS_DIR goes unnoticed until the droppings ship in a
+# tarball. Warning only — local scratch at the root is legal, just loud.
+droppings=$(git status --porcelain --ignored=matching 2>/dev/null \
+    | awk '$1 == "!!" && $2 !~ /\// { print $2 }') || droppings=""
+if [ -n "$droppings" ]; then
+    echo "run_lint: WARNING: gitignored droppings at the repo root" \
+         "(route them via MLSL_STATS_DIR / MLSL_TRACE_DIR):" >&2
+    printf '  %s\n' $droppings >&2
+fi

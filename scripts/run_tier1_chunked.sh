@@ -74,6 +74,13 @@ select_changed_files() {
                 printf '%s\n' tests/test_pallas_ring.py \
                     tests/test_analysis.py tests/test_overlap_compiled.py
                 stems="$stems ring_kernels" ;;
+            # the codec lab: registry members and the calibration autotuner
+            # are pinned by test_codec_lab AND the A115/A116 geometry sweep
+            # in test_analysis — name the twins explicitly so an import
+            # alias in a test file cannot silently drop the pairing
+            mlsl_tpu/codecs/*.py|mlsl_tpu/tuner/calibrate.py)
+                printf '%s\n' tests/test_codec_lab.py tests/test_analysis.py
+                stems="$stems codecs" ;;
             # known-bad analysis fixtures are exercised only by test_analysis
             tests/fixtures/*) printf '%s\n' tests/test_analysis.py ;;
             # bench scripts are pinned by the --smoke subprocess tests that

@@ -118,6 +118,13 @@ def _reset_supervisor():
 
     serve.reset()
     stats.reset_serve_counters()
+    # the codec guardrail registry is process-wide by design (the sentinel
+    # gate feeds it without a Session handle); a test that arms it must not
+    # leave later tests' requests demotable by a stale breach streak
+    from mlsl_tpu import codecs
+
+    codecs.guard_reset()
+    stats.reset_codec_counters()
 
 
 @pytest.fixture(autouse=True)

@@ -1,0 +1,38 @@
+"""Known-bad fixture: a prune wire whose bit-mask stops short of the chunk.
+
+Builds a real prune-routed gradient request (importance-weighted pruning on
+the registry's compressed-ring transport), then shortens the pinned
+mask-length — the geometry an encoder that packed ceil(k/8) instead of
+ceil(n/8) mask bytes would declare. The decoder's rank = cumsum(mask)
+gather then reads past the value payload for every element beyond the
+short mask, and the chunk's tail silently drops from every round.
+
+The plan verifier must reject this geometry with MLSL-A116.
+"""
+
+EXPECTED_CODE = "MLSL-A116"
+
+from mlsl_tpu.types import CompressionType, OpType
+
+
+def build(env):
+    """-> session: committed with a healthy prune route, then tampered."""
+    env.config.codec = "prune"
+
+    n = len(env.devices)
+    dist = env.create_distribution(n, 1)
+    s = env.create_session()
+    s.set_global_minibatch_size(max(8, n))
+    r = s.create_operation_reg_info(OpType.CC)
+    r.set_name("prop")
+    r.add_output(4, 4)
+    r.add_parameter_set(2048, 1,
+                        compression_type=CompressionType.QUANTIZATION)
+    op = s.get_operation(s.add_operation(r, dist))
+    s.commit()
+
+    req = op.parameter_sets[0].grad_req
+    assert req.algo == "codec:prune", "fixture precondition: prune route"
+    # the mask stops one byte-row (8 elements) short of the chunk
+    req._codec_geoms[0]["mask_len"] -= 8
+    return s

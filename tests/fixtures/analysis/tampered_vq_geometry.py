@@ -1,0 +1,38 @@
+"""Known-bad fixture: a VQ wire whose index table mis-tiles the chunk.
+
+Builds a real vq-routed gradient request (MLSL_CODEC-style programmatic
+assignment onto the registry's compressed-ring transport), then shrinks the
+pinned per-chunk index count — the geometry a codec whose encoder padded to
+the wrong vector dimension would declare. Decode would tile the codebook
+vectors against the wrong grid, scattering every element after the first
+misaligned vector to the wrong parameter.
+
+The plan verifier must reject this geometry with MLSL-A115.
+"""
+
+EXPECTED_CODE = "MLSL-A115"
+
+from mlsl_tpu.types import CompressionType, OpType
+
+
+def build(env):
+    """-> session: committed with a healthy vq route, then tampered."""
+    env.config.codec = "vq"
+
+    n = len(env.devices)
+    dist = env.create_distribution(n, 1)
+    s = env.create_session()
+    s.set_global_minibatch_size(max(8, n))
+    r = s.create_operation_reg_info(OpType.CC)
+    r.set_name("vqop")
+    r.add_output(4, 4)
+    r.add_parameter_set(2048, 1,
+                        compression_type=CompressionType.QUANTIZATION)
+    op = s.get_operation(s.add_operation(r, dist))
+    s.commit()
+
+    req = op.parameter_sets[0].grad_req
+    assert req.algo == "codec:vq", "fixture precondition: vq route"
+    # one vector's worth of indices vanishes from the pinned geometry
+    req._codec_geoms[0]["idx_elems"] -= 1
+    return s

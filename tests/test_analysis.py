@@ -400,6 +400,31 @@ def test_fixture_straddling_bucket_pinned(env):
     assert fx.EXPECTED_CODE in rep.codes(), rep.format()
 
 
+@pytest.mark.parametrize("name", ["tampered_vq_geometry", "short_prune_mask"])
+def test_fixture_codec_geometry_pinned(env, name):
+    """Codec-lab wire geometry (A115/A116): each tampered registry-codec
+    request rejected with its pinned code — and the untampered session is
+    green (the fixture breaks a healthy commit)."""
+    fx = load_fixture(name)
+    s = fx.build(env)
+    rep = plan_mod.verify_session(s)
+    assert fx.EXPECTED_CODE in rep.codes(), rep.format()
+    assert any(d.severity == "error" and d.code == fx.EXPECTED_CODE
+               for d in rep.diagnostics)
+
+
+@pytest.mark.parametrize("codec", ["vq", "prune", "f32"])
+def test_verify_green_on_codec_session(env, codec):
+    """The positive half: a healthy registry-codec session adds zero
+    verifier errors (the no-false-positive contract of the A11x family)."""
+    env.config.codec = codec
+    dist = env.create_distribution(8, 1)
+    s = _build_net(env, dist, n_ops=1,
+                   compression=CompressionType.QUANTIZATION)
+    rep = plan_mod.verify_session(s)
+    assert not rep.errors, rep.format()
+
+
 # ---------------------------------------------------------------------------
 # Targeted verifier checks (tampered real objects)
 # ---------------------------------------------------------------------------
