@@ -1,9 +1,10 @@
-"""Static analysis for MLSL: the commit-time collective-plan verifier and
-the project concurrency linter.
+"""Static analysis for MLSL: the commit-time collective-plan verifier, the
+project concurrency linter, the lockset/lock-order analyzer, the protocol
+model checker, and the runtime lock witness.
 
-Two passes over one structured-diagnostic format (stable ``MLSL-Axxx``
-codes, ``error``/``warn`` severity, ``file:line`` or ``graph:<node>``
-anchors — see ``diagnostics.CODES`` for the full table):
+Five passes over one structured-diagnostic format (stable ``MLSL-Axxx``
+codes, ``error``/``warn`` severity, ``file:line``, ``graph:<node>`` or
+``model:<name>`` anchors — see ``diagnostics.CODES`` for the full table):
 
 - ``analysis.plan`` walks a committed Session's collective plan (armed by
   ``MLSL_VERIFY=1`` at ``Session.commit``, or explicitly via
@@ -17,6 +18,18 @@ anchors — see ``diagnostics.CODES`` for the full table):
   collective embeds, thread-reachable device dispatch, stats-counter
   discipline, chaos-wrapper symmetry, taxonomy-swallowing excepts, and
   wall-clock retry math.
+- ``analysis.locks`` (A21x, same gate as the linter) analyzes the whole
+  package as one program: lock inventory, may-hold-while-acquiring order
+  cycles, locks held across blocking ops, unlocked thread-shared globals,
+  Condition.wait predicate loops, unjoined daemon threads.
+- ``analysis.protocol`` (A15x, run at ``Session.commit`` next to the plan
+  verifier) exhaustively explores declarative mirrors of the control-plane
+  membership/drain and elastic shrink/grow protocols: deadlock-freedom, no
+  dual coordinator, no lost drain-ack.
+- ``analysis.witness`` is the dynamic half (``MLSL_LOCK_WITNESS=1``, armed
+  by scripts/run_soak.sh): instrumented locks record acquisition-order
+  edges, cycles, and over-budget holds at runtime, confirming or refuting
+  the static A21x story.
 
 The last verdict of each pass is surfaced as the ``analysis`` key of
 ``supervisor.status()``.

@@ -2,13 +2,18 @@
 
 Usage::
 
-    python -m mlsl_tpu.analysis                 # lint the installed package
+    python -m mlsl_tpu.analysis                 # lint + lock analysis
     python -m mlsl_tpu.analysis --lint --root . # lint an arbitrary tree
     python -m mlsl_tpu.analysis --graph         # build + verify a demo graph
+    python -m mlsl_tpu.analysis --concurrency   # lock analyzer + protocol
+                                                # model checker only
     python -m mlsl_tpu.analysis --json          # machine-readable findings
 
 Exits nonzero when any error-severity finding survives — wire it as a
-pre-commit hook (scripts/run_lint.sh runs it after ruff).
+pre-commit hook (scripts/run_lint.sh runs it after ruff). ``--concurrency``
+is stricter: it exits nonzero on *any* finding, warnings included, because
+its consumers (run_lint.sh --concurrency, CI concurrency jobs) treat an
+unproven interleaving as a failure.
 """
 
 from __future__ import annotations
@@ -81,6 +86,10 @@ def main(argv: Optional[List[str]] = None) -> int:
     p.add_argument("--graph", action="store_true",
                    help="build a representative demo graph and run the "
                         "commit-time plan verifier over it")
+    p.add_argument("--concurrency", action="store_true",
+                   help="run the lock-order analyzer and the protocol model "
+                        "checker only (exit 1 on ANY finding, warnings "
+                        "included)")
     p.add_argument("--root", default=None,
                    help="lint root (default: the installed mlsl_tpu package)")
     p.add_argument("--json", action="store_true",
@@ -97,12 +106,26 @@ def main(argv: Optional[List[str]] = None) -> int:
         return 0
 
     reports: List[Report] = []
-    if args.lint or not args.graph:
-        from mlsl_tpu.analysis import lint
+    if args.lint or not (args.graph or args.concurrency):
+        from mlsl_tpu.analysis import lint, locks
 
         rep = lint.lint_tree(args.root)
         record(rep)
         reports.append(rep)
+        # the lint gate includes the whole-package lockset/lock-order pass:
+        # the commit bar is 0 errors across BOTH
+        lrep = locks.analyze_tree(args.root)
+        record(lrep)
+        reports.append(lrep)
+    if args.concurrency:
+        from mlsl_tpu.analysis import locks, protocol
+
+        lrep = locks.analyze_tree(args.root)
+        record(lrep)
+        reports.append(lrep)
+        prep = protocol.check_protocols()
+        record(prep)
+        reports.append(prep)
     if args.graph:
         reports.append(_demo_graph_report())
 
@@ -115,6 +138,8 @@ def main(argv: Optional[List[str]] = None) -> int:
         print(rep.summary(), file=sys.stderr)
         if rep.errors:
             rc = 1
+        elif args.concurrency and rep.diagnostics:
+            rc = 1  # --concurrency: warnings fail too
     return rc
 
 

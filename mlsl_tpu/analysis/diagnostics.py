@@ -60,6 +60,17 @@ CODES: Dict[str, Tuple[str, str]] = {
                          "shard element exactly once (gap or overlap)"),
     "MLSL-A141": (ERROR, "elastic reshard target geometry disagrees with "
                          "the survivor world (padded/shard mismatch)"),
+    # -- protocol model checker (A15x): exhaustive interleaving exploration
+    # -- of the control-plane/elastic state-machine mirrors ------------------
+    "MLSL-A150": (ERROR, "reachable deadlock: a protocol state with no "
+                         "enabled transition that is not a completed run"),
+    "MLSL-A151": (ERROR, "protocol invariant violated (dual coordinator: "
+                         "two live ranks hold committed leadership at the "
+                         "same epoch)"),
+    "MLSL-A152": (ERROR, "lost drain-ack: a completed run where a live "
+                         "rank's preemption drain was never acknowledged"),
+    "MLSL-A153": (WARN,  "protocol exploration truncated at the state/"
+                         "depth bound (verdict covers the prefix only)"),
     # -- AST linter (A2xx): project concurrency/idiom rules -----------------
     "MLSL-A200": (ERROR, "unparseable source file (syntax error: no rule "
                          "can run)"),
@@ -77,6 +88,18 @@ CODES: Dict[str, Tuple[str, str]] = {
                          "(use time.monotonic)"),
     "MLSL-A207": (ERROR, "metrics-registry series internals mutated outside "
                          "the obs/metrics record/observe/sample paths"),
+    # -- lockset/lock-order analyzer (A21x): whole-package may-hold-while-
+    # -- calling analysis over every Lock/RLock/Condition ---------------------
+    "MLSL-A210": (ERROR, "lock-order cycle in the may-hold-while-acquiring "
+                         "graph (opposite-order acquisition deadlock)"),
+    "MLSL-A211": (ERROR, "lock held across a blocking operation (dispatch, "
+                         "no-timeout join/get/put/wait, sleep, socket I/O)"),
+    "MLSL-A212": (ERROR, "module-level mutable state written from a thread "
+                         "target with no lock held (cross-thread race)"),
+    "MLSL-A213": (ERROR, "Condition.wait outside a while loop (spurious "
+                         "wakeup runs the body on a stale predicate)"),
+    "MLSL-A214": (WARN,  "daemon thread never joined in its module (dies "
+                         "mid-critical-section at interpreter exit)"),
 }
 
 
@@ -146,9 +169,13 @@ class Report:
 
 # -- last-verdict state (supervisor.status / dashboards) ----------------------
 
-#: most recent verdict per pass kind: {'plan': {...}, 'lint': {...}}. Written
-#: by record(); surfaced as the 'analysis' key of supervisor.status().
+#: most recent verdict per pass kind: {'plan': {...}, 'lint': {...},
+#: 'locks': {...}, 'protocol': {...}}. Written by record(); surfaced as the
+#: 'analysis' key of supervisor.status().
 _last: Dict[str, dict] = {}
+
+#: every pass kind status() reports (a pass that never ran says so)
+KINDS = ("plan", "lint", "locks", "protocol")
 
 
 def record(report: Report, duration_s: float = 0.0) -> None:
@@ -194,7 +221,7 @@ def status() -> dict:
     """Last verify/lint verdicts, for ``supervisor.status()`` ('analysis'
     key). A pass that never ran reports ``{"verdict": "never_ran"}``."""
     out = {}
-    for kind in ("plan", "lint"):
+    for kind in KINDS:
         out[kind] = dict(_last.get(kind, {"verdict": "never_ran"}))
     return out
 

@@ -689,6 +689,11 @@ class CommRequest:
             t0 = tr.now() if tr is not None else 0
             try:
                 with jax.profiler.TraceAnnotation(self._trace_name):
+                    # retry-in-place under _dlock IS the dispatch/restart
+                    # serialization contract: the only other takers are this
+                    # request's own wait()/test()/restart, which must see the
+                    # ladder's outcome before touching round state
+                    # mlsl-lint: disable=A211 -- deliberate hold across the retry ladder
                     self._dispatch_ladder(buf)
             except Exception as e:
                 if tr is not None:

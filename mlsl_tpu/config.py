@@ -372,6 +372,17 @@ class Config:
     # commits anyway (both record the verdict in supervisor.status()['analysis']
     # and the ANALYSIS stats line).
     verify_severity: str = "error"      # MLSL_VERIFY_SEVERITY
+    # Runtime lock witness (analysis/witness.py; docs/TUNING.md §23): kept
+    # here for discoverability/printing only, like chaos_spec — the witness
+    # reads the env at lock *creation* time (subsystems build their locks at
+    # import/__init__, before any Config exists), so arming mid-run has no
+    # effect. MLSL_LOCK_WITNESS=1 routes the named locks of the threaded
+    # subsystems through an instrumented wrapper that records acquisition-
+    # order edges, cycles, and over-budget holds.
+    lock_witness: bool = False          # MLSL_LOCK_WITNESS
+    # Hold-time budget: a release after more than this many ms is reported
+    # as an over-budget hold (the runtime shadow of static rule A211).
+    lock_witness_budget_ms: float = 250.0   # MLSL_LOCK_WITNESS_BUDGET_MS
     # Fault-injection spec; parsed by mlsl_tpu.chaos
     # (site:kind[=v][@after][xN][%p], comma-separated). Kept here for
     # discoverability/printing only.
@@ -703,6 +714,11 @@ class Config:
             self.verify_severity,
         )
         mlsl_assert(
+            self.lock_witness_budget_ms > 0,
+            "MLSL_LOCK_WITNESS_BUDGET_MS must be > 0 (got %s)",
+            self.lock_witness_budget_ms,
+        )
+        mlsl_assert(
             self.metrics_every >= 1,
             "MLSL_METRICS_EVERY must be >= 1 (got %d)", self.metrics_every,
         )
@@ -937,6 +953,10 @@ class Config:
         c.verify_severity = os.environ.get(
             "MLSL_VERIFY_SEVERITY", c.verify_severity
         ).strip().lower() or c.verify_severity
+        c.lock_witness = _env_bool("MLSL_LOCK_WITNESS", c.lock_witness)
+        c.lock_witness_budget_ms = _env_float(
+            "MLSL_LOCK_WITNESS_BUDGET_MS", c.lock_witness_budget_ms
+        )
         c.chaos_spec = os.environ.get("MLSL_CHAOS", c.chaos_spec)
         c.trace = _env_bool("MLSL_TRACE", c.trace)
         c.trace_dir = os.environ.get("MLSL_TRACE_DIR", c.trace_dir)

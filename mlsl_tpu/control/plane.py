@@ -51,6 +51,7 @@ from collections import deque
 from typing import Dict, List, Optional, Sequence, Tuple
 
 from mlsl_tpu import chaos
+from mlsl_tpu.analysis import witness
 from mlsl_tpu.control import channel
 from mlsl_tpu.log import MLSLDeviceLossError, log_info, log_warning
 
@@ -125,7 +126,7 @@ class ControlPlane:
         self.grace_s = max(0.0, float(grace_s))
         self.notice_file = notice_file or ""
 
-        self._lock = threading.Lock()
+        self._lock = witness.named_lock("control.plane")
         self.epoch = 0
         self.alive = set(range(self.world))
         self._last_seen: Dict[int, float] = {}
@@ -260,6 +261,12 @@ class ControlPlane:
         site) degrades to latency, not to a lost drain."""
         with self._lock:
             if self._notice_out is None and self.rank not in self._drained:
+                # "ts" is display-only forensics (who noticed first, in
+                # human time, across hosts). Liveness NEVER reads it: all
+                # miss/grace accounting compares the receiver's OWN
+                # time.monotonic() stamps (_on_heartbeat/_detect_misses), so
+                # an NTP step on either host cannot fabricate or mask a
+                # death (tests/test_pod.py::test_ntp_step_does_not_kill)
                 self._notice_out = {
                     "t": "notice", "rank": self.rank, "reason": str(reason),
                     "ts": time.time(),
@@ -420,6 +427,10 @@ class ControlPlane:
             # windows must see each observation once, or duplicates would
             # skew the very medians the pod feed exists to widen
             samples, self._step_samples = self._step_samples, []
+            # "ts" is display-only (log correlation across hosts); the
+            # receiver stamps its own monotonic arrival time and liveness
+            # compares monotonic-vs-monotonic only — sender wall-clock is
+            # untrusted by contract (NTP steps, skewed hosts)
             frame = {
                 "t": "hb", "rank": self.rank, "epoch": self.epoch,
                 "step": self._local_step, "status": self._pushed_status,

@@ -318,8 +318,15 @@ class Session:
             # verifier may reject (mlsl_tpu/analysis/plan.py; severity
             # behavior under MLSL_VERIFY_SEVERITY)
             from mlsl_tpu.analysis.plan import run_commit_verify
+            from mlsl_tpu.analysis.protocol import run_commit_protocol_check
 
             run_commit_verify(self)
+            # same gate, second pass: exhaustively explore the control-plane
+            # membership/drain and elastic shrink/grow protocol models
+            # (deadlock-freedom, no dual coordinator, no lost drain-ack) —
+            # memoized process-wide, so repeated commits pay once
+            # (mlsl_tpu/analysis/protocol.py, A15x)
+            run_commit_protocol_check(self)
         if cfg is not None and cfg.precompile:
             self.precompile_collectives()
         self.stats.initialize()

@@ -484,6 +484,38 @@ def reset_control_counters() -> None:
         CONTROL_COUNTERS[k] = 0
 
 
+# Runtime lock-witness accounting (mlsl_tpu.analysis.witness,
+# MLSL_LOCK_WITNESS=1): the dynamic half of the A21x concurrency suite.
+# Acquisitions are the hot path (every witnessed critical section) and only
+# bump the counter; edges/cycles/over-budget holds are cold findings and
+# append an immediate LOCKWITNESS line — a witnessed order cycle must be
+# readable from mlsl_stats.log next to the CONTROL story it would deadlock.
+LOCKWITNESS_COUNTERS: Dict[str, int] = {
+    "acquisitions": 0,       # witnessed acquisitions (hot: counter only)
+    "edges_observed": 0,     # distinct acquisition-order edges seen
+    "cycles_detected": 0,    # runtime lock-order cycles (potential deadlock)
+    "over_budget_holds": 0,  # holds past MLSL_LOCK_WITNESS_BUDGET_MS
+}
+
+_LOCKWITNESS_HOT = ("acquisitions",)
+
+
+def record_lock_witness(event: str, detail: str = "") -> None:
+    """One lock-witness event (see LOCKWITNESS_COUNTERS keys)."""
+    LOCKWITNESS_COUNTERS[event] += 1
+    if event not in _LOCKWITNESS_HOT:
+        try:
+            with open(stats_path(), "a") as f:
+                f.write(f"{'LOCKWITNESS':<16} {event.upper():<16} {detail}\n")
+        except OSError:
+            pass
+
+
+def reset_lock_witness_counters() -> None:
+    for k in LOCKWITNESS_COUNTERS:
+        LOCKWITNESS_COUNTERS[k] = 0
+
+
 def record_comm_retry(phase: str, request: str, error: BaseException,
                       attempt: int, delay_s: float) -> None:
     """One rung-2 retry of a transient dispatch/wait failure (called by

@@ -58,6 +58,7 @@ from typing import Optional, Sequence, Tuple
 import numpy as np
 import jax
 
+from mlsl_tpu.analysis import witness
 from mlsl_tpu.log import (
     MLSLDeviceLossError,
     MLSLError,
@@ -76,6 +77,12 @@ from mlsl_tpu.log import (
 
 _active: Optional[Tuple] = None
 
+#: serializes registry *writes* (coordinator thread vs. a main-thread
+#: reset/rebuild); reads stay lock-free — a torn read is impossible for a
+#: single tuple-or-None rebind, and active_devices() is on the
+#: Environment.init path
+_registry_lock = witness.named_lock("elastic.registry")
+
 #: last reshard/admission verdict, for supervisor.status()['elastic'] and
 #: post-mortems (which world-size transition, which verdict, at which step)
 _last_reshard: Optional[dict] = None
@@ -90,7 +97,8 @@ def active_devices() -> Optional[Tuple]:
 
 def _set_active(devices: Optional[Sequence]) -> None:
     global _active
-    _active = tuple(devices) if devices is not None else None
+    with _registry_lock:
+        _active = tuple(devices) if devices is not None else None
 
 
 def reset() -> None:

@@ -314,6 +314,7 @@ class MetricsRegistry:
             ("straggler", st.STRAGGLER_COUNTERS),
             ("serve", st.SERVE_COUNTERS),
             ("codec", st.CODEC_COUNTERS),
+            ("lockwitness", st.LOCKWITNESS_COUNTERS),
         ):
             for k, v in d.items():
                 self.set(f"mlsl_{fam}_{k}", float(v))

@@ -46,6 +46,7 @@ import numpy as np
 from jax.sharding import NamedSharding, PartitionSpec as P
 
 from mlsl_tpu import chaos, supervisor
+from mlsl_tpu.analysis import witness
 from mlsl_tpu.comm.collectives import smap
 from mlsl_tpu.comm.mesh import MODEL_AXIS
 from mlsl_tpu.core import stats
@@ -175,7 +176,7 @@ class InferenceEngine:
 
         self._build_programs()
 
-        self._lock = threading.Lock()
+        self._lock = witness.named_lock("serve.engine")
         self._pending: Deque[Request] = collections.deque()
         self._active: Dict[int, _Seq] = {}
         self._next_req_id = 0

@@ -45,10 +45,10 @@ import collections
 import enum
 import os
 import random
-import threading
 import time
 from typing import Deque, Dict, Optional
 
+from mlsl_tpu.analysis import witness
 from mlsl_tpu.log import (
     MLSLCorruptionError,
     MLSLDeviceLossError,
@@ -178,7 +178,7 @@ class CircuitBreaker:
         self._opened_at = 0.0
         self._trips = 0
         self._last_error: Optional[str] = None
-        self._lock = threading.Lock()
+        self._lock = witness.named_lock(f"supervisor.breaker.{name}")
 
     # -- hot-path query ----------------------------------------------------
 
@@ -290,7 +290,7 @@ class CircuitBreaker:
 # -- registry ----------------------------------------------------------------
 
 _breakers: Dict[str, CircuitBreaker] = {}
-_registry_lock = threading.Lock()
+_registry_lock = witness.named_lock("supervisor.registry")
 
 
 def breaker(name: str) -> CircuitBreaker:

@@ -5,8 +5,11 @@
 # "Static analysis"). Exits nonzero on any error-severity finding, so it
 # doubles as a pre-commit hook.
 #
-#   scripts/run_lint.sh          # check
-#   scripts/run_lint.sh --fix    # let ruff autofix, then re-check custom rules
+#   scripts/run_lint.sh                # check (lint + lock analyzer)
+#   scripts/run_lint.sh --fix          # let ruff autofix, then re-check
+#   scripts/run_lint.sh --concurrency  # lock analyzer + protocol model
+#                                      # checker only; exits nonzero on ANY
+#                                      # finding, warnings included
 set -euo pipefail
 cd "$(dirname "$0")/.."
 
@@ -14,6 +17,12 @@ RUFF_ARGS=(check)
 if [ "${1:-}" = "--fix" ]; then
     RUFF_ARGS+=(--fix)
     shift
+fi
+
+if [ "${1:-}" = "--concurrency" ]; then
+    shift
+    exec env MLSL_STATS_DIR="${MLSL_STATS_DIR:-$(mktemp -d)}" \
+        python -m mlsl_tpu.analysis --concurrency "$@"
 fi
 
 if command -v ruff >/dev/null 2>&1; then

@@ -21,5 +21,12 @@
 # via tests/test_pod.py — also runnable alone with scripts/run_pod_sim.sh.
 set -euo pipefail
 cd "$(dirname "$0")/.."
-exec env JAX_PLATFORMS=cpu python -m pytest tests/test_soak.py tests/test_pod.py \
+# The soak runs with the lock witness armed by default (analysis/witness.py,
+# docs/TUNING.md §23): every lock the threaded subsystems create is
+# instrumented, so a lock-order cycle or over-budget hold that only shows
+# under chaos load is recorded (LOCKWITNESS counters + MLSL_LOCK_WITNESS_SINK
+# JSONL) instead of being a one-in-a-thousand hang. Opt out with
+# MLSL_LOCK_WITNESS=0.
+exec env JAX_PLATFORMS=cpu MLSL_LOCK_WITNESS="${MLSL_LOCK_WITNESS:-1}" \
+    python -m pytest tests/test_soak.py tests/test_pod.py \
     -q -m 'soak or pod' -p no:cacheprovider "$@"

@@ -125,6 +125,13 @@ def _reset_supervisor():
 
     codecs.guard_reset()
     stats.reset_codec_counters()
+    # the lock witness's edge/cycle record is process-wide by design (a
+    # soak accumulates across Environment rebuilds); a test that arms it
+    # must not leave later agreement tests reading its synthetic cycles
+    from mlsl_tpu.analysis import witness
+
+    witness.reset()
+    stats.reset_lock_witness_counters()
 
 
 @pytest.fixture(autouse=True)

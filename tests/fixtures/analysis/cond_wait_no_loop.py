@@ -1,0 +1,30 @@
+"""Known-bad fixture for lock rule A213 (tests/test_concurrency.py):
+``Condition.wait`` guarded by an ``if`` instead of a ``while``. Wakeups are
+spurious and racy by contract — notify_all with two waiters, or a third
+thread consuming the item first, runs the body on a stale predicate. The
+shipped dispatchers (comm/request.py) all re-check in a loop."""
+
+import threading
+
+EXPECTED_CODE = "MLSL-A213"
+
+
+class OneShotMailbox:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._cv = threading.Condition(self._lock)
+        self._item = None
+
+    def take(self):
+        with self._cv:
+            # A213: `if` check — a spurious wakeup falls through with
+            # _item still None
+            if self._item is None:
+                self._cv.wait()
+            item, self._item = self._item, None
+            return item
+
+    def put(self, item):
+        with self._cv:
+            self._item = item
+            self._cv.notify()

@@ -1,0 +1,24 @@
+"""Known-bad fixture for lock rule A214 (tests/test_concurrency.py, warn
+severity): a ``daemon=True`` thread that no code in its module ever joins.
+At interpreter exit daemon threads are killed wherever they stand — mid
+critical section, mid file write — leaking locks and half-written state.
+The shipped spawns all join with a timeout in their shutdown paths (or
+carry a same-line pragma stating why they cannot)."""
+
+import threading
+import time
+
+EXPECTED_CODE = "MLSL-A214"
+
+
+class FireAndForgetFlusher:
+    def __init__(self, sink):
+        self.sink = sink
+        # A214: daemon spawn, and no .join() anywhere in this module
+        self._flusher = threading.Thread(target=self._flush_loop, daemon=True)
+        self._flusher.start()
+
+    def _flush_loop(self):
+        while True:
+            time.sleep(0.1)
+            self.sink.flush()

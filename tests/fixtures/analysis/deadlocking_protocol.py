@@ -1,0 +1,41 @@
+"""Known-bad fixture for protocol rule A150 (tests/test_concurrency.py):
+the textbook AB/BA deadlock as a declarative model. Two processes each
+acquire two shared locks in opposite orders; the interleaving where P0
+holds A and P1 holds B reaches a state with no enabled transition that is
+not a completed run — exactly what ``protocol.explore`` must report. (The
+same bug as the static A210 fixture, seen by the dynamic-semantics half of
+the suite.)"""
+
+from mlsl_tpu.analysis.protocol import Model
+
+EXPECTED_CODE = "MLSL-A150"
+
+_FREE = -1
+
+# state: (pc0, pc1, owner_a, owner_b); pc: 0 idle, 1 holds first lock,
+# 2 holds both, 3 done. P0 takes A then B; P1 takes B then A.
+
+
+def _transitions(state):
+    pc0, pc1, a, b = state
+    out = []
+    if pc0 == 0 and a == _FREE:
+        out.append(("p0_acquire_a", (1, pc1, 0, b)))
+    if pc0 == 1 and b == _FREE:
+        out.append(("p0_acquire_b", (2, pc1, a, 0)))
+    if pc0 == 2:
+        out.append(("p0_release_both", (3, pc1, _FREE, _FREE)))
+    if pc1 == 0 and b == _FREE:
+        out.append(("p1_acquire_b", (pc0, 1, a, 1)))
+    if pc1 == 1 and a == _FREE:
+        out.append(("p1_acquire_a", (pc0, 2, 1, b)))
+    if pc1 == 2:
+        out.append(("p1_release_both", (pc0, 3, _FREE, _FREE)))
+    return out
+
+
+def build_model() -> Model:
+    return Model("fixture.ab_ba_deadlock",
+                 [(0, 0, _FREE, _FREE)],
+                 _transitions,
+                 done=lambda s: s[0] == 3 and s[1] == 3)

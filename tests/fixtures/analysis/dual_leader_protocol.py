@@ -1,0 +1,44 @@
+"""Known-bad fixture for protocol rule A151 (tests/test_concurrency.py):
+split-brain leadership. Two nodes; a partition lets each time the other
+out and commit *itself* as leader at the same epoch — the dual-coordinator
+state the shipped membership model proves unreachable (its epoch fence
+requires the committer to lead the world net of its own removals, so a
+non-lowest rank can never commit leadership that a live lower rank would
+accept). This toy has no fence: suspicion alone confers authority."""
+
+from mlsl_tpu.analysis.protocol import Model
+
+EXPECTED_CODE = "MLSL-A151"
+
+# state: (partitioned, leader0, leader1, epoch0, epoch1)
+# node 0 starts as the committed leader; both epochs 0.
+
+
+def _transitions(state):
+    part, l0, l1, e0, e1 = state
+    out = []
+    if not part:
+        out.append(("partition", (True, l0, l1, e0, e1)))
+    if part and not l0:
+        # node 0 times node 1 out and self-elects — no fence
+        out.append(("self_elect(0)", (part, True, l1, e0 + 1, e1)))
+    if part and not l1:
+        out.append(("self_elect(1)", (part, l0, True, e0, e1 + 1)))
+    return out
+
+
+def _invariant(state):
+    _, l0, l1, e0, e1 = state
+    if l0 and l1 and e0 == e1:
+        return ("A151",
+                f"dual coordinator: both nodes hold committed leadership "
+                f"at epoch {e0}")
+    return None
+
+
+def build_model() -> Model:
+    return Model("fixture.split_brain",
+                 [(False, True, False, 1, 0)],
+                 _transitions,
+                 invariant=_invariant,
+                 done=lambda s: True)

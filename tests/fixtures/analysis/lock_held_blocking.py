@@ -1,0 +1,31 @@
+"""Known-bad fixture for lock rule A211 (tests/test_concurrency.py): a
+lock held across unbounded blocking operations. Every consumer thread that
+needs ``_lock`` stalls for the full duration of the no-timeout ``get()``
+(and the sleep) — the control plane's canonical failure: a held lock
+across slow I/O gets the *holder* declared dead. The shipped tree computes
+under the lock and blocks outside it."""
+
+import queue
+import threading
+import time
+
+EXPECTED_CODE = "MLSL-A211"
+
+
+class GreedyWorker:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._q = queue.Queue()
+        self._last = None
+
+    def pump(self):
+        with self._lock:
+            # A211: unbounded Queue.get while _lock is held
+            item = self._q.get()
+            self._last = item
+
+    def backoff_under_lock(self):
+        with self._lock:
+            # A211: sleep inside the critical section
+            time.sleep(0.5)
+            self._last = None

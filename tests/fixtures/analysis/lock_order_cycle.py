@@ -1,0 +1,31 @@
+"""Known-bad fixture for lock rule A210 (tests/test_concurrency.py): the
+classic AB/BA acquisition-order cycle. ``flush`` nests queue-lock inside
+state-lock; ``snapshot`` nests state-lock inside queue-lock — two threads
+running one each deadlock. The shipped tree passes A210 by construction
+(every multi-lock path orders locks one way); this module is the shape
+that contract forbids."""
+
+import threading
+
+EXPECTED_CODE = "MLSL-A210"
+
+
+class DualLockBuffer:
+    def __init__(self):
+        self._state_lock = threading.Lock()
+        self._queue_lock = threading.Lock()
+        self._state = 0
+        self._queue = []
+
+    def flush(self):
+        # order 1: state -> queue
+        with self._state_lock:
+            with self._queue_lock:
+                self._queue.append(self._state)
+                self._state = 0
+
+    def snapshot(self):
+        # order 2: queue -> state — closes the cycle
+        with self._queue_lock:
+            with self._state_lock:
+                return (self._state, list(self._queue))

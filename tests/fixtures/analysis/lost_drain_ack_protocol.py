@@ -1,0 +1,53 @@
+"""Known-bad fixture for protocol rule A152 (tests/test_concurrency.py):
+a drain protocol whose ack is sent exactly once with no re-send. A lossy
+channel (one ``drop`` transition per message — TCP to a dying host, a GC'd
+frame) can consume the only ack, so the run completes with the noticed
+rank stuck at drained-but-never-acked. The shipped model survives the same
+lossy channel because the drained rank re-sends its status every heartbeat
+tick until acked — removing those re-send transitions reproduces exactly
+this fixture."""
+
+from mlsl_tpu.analysis.protocol import Model
+
+EXPECTED_CODE = "MLSL-A152"
+
+# drain states (mirroring protocol._D_*)
+_UNSERVED, _ORDERED, _DRAINED, _ACKED = 0, 1, 2, 3
+
+# state: (drain_state, msgs frozenset of one-shot frames)
+
+
+def _transitions(state):
+    drain, msgs = state
+    out = []
+    if drain == _UNSERVED and "notice" not in msgs:
+        out.append(("send_notice", (drain, msgs | {"notice"})))
+    for m in msgs:
+        rest = msgs - {m}
+        # lossy channel: every frame can be dropped, and none re-sends
+        out.append((f"drop({m})", (drain, rest)))
+        if m == "notice" and drain == _UNSERVED:
+            out.append(("order_drain", (_ORDERED, rest | {"drain"})))
+        elif m == "drain" and drain == _ORDERED:
+            # the ack goes out ONCE — the bug
+            out.append(("execute_drain", (_DRAINED, rest | {"ack"})))
+        elif m == "ack" and drain == _DRAINED:
+            out.append(("ack_received", (_ACKED, rest)))
+    return out
+
+
+def _quiescence(state):
+    drain, _ = state
+    if drain != _ACKED:
+        return ("A152",
+                f"lost drain-ack: run completed with drain state {drain} "
+                "(the only ack was droppable and never re-sent)")
+    return None
+
+
+def build_model() -> Model:
+    return Model("fixture.lost_drain_ack",
+                 [(_UNSERVED, frozenset())],
+                 _transitions,
+                 done=lambda s: not s[1],
+                 quiescence=_quiescence)

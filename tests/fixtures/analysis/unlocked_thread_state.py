@@ -1,0 +1,28 @@
+"""Known-bad fixture for lock rule A212 (tests/test_concurrency.py):
+module-level mutable state written from a ``threading.Thread`` target with
+no lock held. ``_samples[key] = ...`` from the collector thread races every
+main-thread reader/writer — the GIL serializes bytecodes, not the
+read-modify-write sequence. The shipped registries either hold a lock or
+carry a documented single-writer discipline (core/stats, obs/metrics,
+pinned by A203/A207)."""
+
+import threading
+
+EXPECTED_CODE = "MLSL-A212"
+
+#: the racy registry: no lock anywhere in this module
+_samples = {}
+
+
+def _collector_loop():
+    n = 0
+    while True:
+        n += 1
+        # A212: unlocked write from the thread target
+        _samples["count"] = n
+
+
+def start_collector():
+    t = threading.Thread(target=_collector_loop)
+    t.start()
+    return t

@@ -319,3 +319,56 @@ def test_pod_soak_sequential_kills(pod_factory):
                  re.finditer(r"STEP rank=\d+ step=(\d+)", out)]
         assert steps == list(range(len(steps)))
         assert len(pod.stats_lines(tmp, r, "elections")) == 1
+
+
+def test_ntp_step_does_not_kill():
+    """Regression pin for the wall-clock liveness contract
+    (control/plane.py): heartbeat ``ts`` stamps are display-only, and ALL
+    miss/grace accounting compares the receiver's own ``time.monotonic()``
+    stamps. A ±1h NTP step of ``time.time()`` mid-run — on every member at
+    once, the worst case — must not fabricate a death, an election, or an
+    epoch commit while heartbeats keep flowing.
+
+    In-process planes (like tests/test_control.py) rather than the
+    subprocess pod: the step must hit the *running* interpreter, which
+    monkeypatching ``time.time`` can only do in-process."""
+    from unittest import mock
+
+    from mlsl_tpu.control.plane import ControlPlane
+    from mlsl_tpu.core import stats
+
+    # The miss budget (interval * misses) is real time the scheduler can eat:
+    # on a loaded box a heartbeat thread stalling past it fabricates exactly
+    # the death this test pins to zero. 1s of budget keeps the test about the
+    # wall-clock step, not about CPU contention.
+    interval, misses = 0.25, 4
+    stats.reset_control_counters()
+    planes = [
+        ControlPlane(r, [("127.0.0.1", 0)] * 3,
+                     interval_s=interval, misses=misses)
+        for r in range(3)
+    ]
+    real_time = time.time
+    offset = [0.0]
+    try:
+        for p in planes:
+            p.start()
+        addrs = [("127.0.0.1", p.listen_port) for p in planes]
+        for p in planes:
+            p.addrs = addrs
+        # settle: everyone heartbeating, full membership, epoch 0
+        time.sleep(4 * interval)
+        with mock.patch("time.time", lambda: real_time() + offset[0]):
+            for step_s in (3600.0, -7200.0):  # forward, then back past 0
+                offset[0] += step_s
+                time.sleep((misses + 2) * interval)  # > full miss budget
+        for p in planes:
+            st = p.status()
+            assert st["alive"] == [0, 1, 2], st
+            assert st["epoch"] == 0, st
+    finally:
+        for p in planes:
+            p.stop()
+    assert stats.CONTROL_COUNTERS["deaths_detected"] == 0
+    assert stats.CONTROL_COUNTERS["epochs_committed"] == 0
+    assert stats.CONTROL_COUNTERS["elections"] == 0
